@@ -1,0 +1,30 @@
+"""incubator_mxnet_tpu — a TPU-native deep learning framework with the
+capabilities of Apache MXNet (reference: ymjiang/incubator-mxnet), rebuilt
+from scratch on JAX/XLA/Pallas.
+
+Import surface mirrors `mxnet`:
+
+    import incubator_mxnet_tpu as mx        # or: import mxtpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+import sys as _sys
+
+from . import base, context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+
+from .ndarray import NDArray
+from .ndarray import random as _ndrandom
+
+# `mx.random` surface (seed + samplers)
+random = _ndrandom
+
+__version__ = "0.1.0"
+
+# Short import alias, torch-style: `import mxtpu as mx`.
+_sys.modules.setdefault("mxtpu", _sys.modules[__name__])

@@ -1,0 +1,381 @@
+"""Imperative autograd: tape over per-op `jax.vjp`.
+
+Reference parity: python/mxnet/autograd.py (record/pause/train_mode/
+predict_mode, mark_variables, backward, grad, custom Function). The reference
+records a tape in the C++ executor; here each eager op records a Node whose
+`fn` is the pure jax function that produced it. backward() walks the tape in
+reverse topological order calling `jax.vjp(fn, *saved_inputs)`. Because the
+walk itself emits ops through the same recording machinery, `create_graph=True`
+(higher-order grad) works by simply leaving recording on during the walk.
+
+The hybridized/jitted path does NOT use this tape — `HybridBlock.hybridize`
+differentiates the whole traced graph with `jax.grad` inside one XLA
+computation (see gluon/block.py), which is the performance path on TPU.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "mark_variables", "backward", "grad", "Function",
+]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+class _Scope:
+    def __init__(self, recording, training):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+class Node:
+    """One recorded eager op.
+
+    fn            : pure function raw-arrays -> raw array | tuple of raws
+    input_values  : raw jax arrays at record time (immutable snapshot, so
+                    later in-place NDArray mutation can't corrupt the tape)
+    parents       : per input, (Node, out_index) | None
+    leaf_refs     : per input, the producing NDArray if it was a leaf
+    out_avals     : [(shape, dtype)] per output
+    """
+
+    __slots__ = ("fn", "input_values", "parents", "leaf_refs", "out_avals", "n_out", "name")
+
+    def __init__(self, fn, input_values, parents, leaf_refs, out_avals, name=None):
+        self.fn = fn
+        self.input_values = input_values
+        self.parents = parents
+        self.leaf_refs = leaf_refs
+        self.out_avals = out_avals
+        self.n_out = len(out_avals)
+        self.name = name
+
+
+def _record_op(fn, nd_inputs, raw_inputs, nd_outputs, name=None):
+    """Called by ndarray._apply for every eager op while recording."""
+    parents, leaf_refs = [], []
+    for x in nd_inputs:
+        parents.append(x._node)
+        leaf_refs.append(x if x._grad_req is not None else None)
+    out_avals = [(tuple(o._data.shape), o._data.dtype) for o in nd_outputs]
+    node = Node(fn, tuple(raw_inputs), parents, leaf_refs, out_avals, name)
+    for i, o in enumerate(nd_outputs):
+        o._node = (node, i)
+    return node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: autograd.mark_variables — associate grad buffers with vars."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = None if req == "null" else req
+        v._grad = g
+        v._node = None
+
+
+# ---------------------------------------------------------------------------
+# backward engine
+# ---------------------------------------------------------------------------
+
+def _toposort(roots: Sequence[Node]) -> List[Node]:
+    order, state = [], {}
+
+    def visit(n):
+        stack = [(n, iter([p for p in n.parents if p is not None]))]
+        state[id(n)] = 1
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for (pnode, _pi) in it:
+                s = state.get(id(pnode), 0)
+                if s == 0:
+                    state[id(pnode)] = 1
+                    stack.append((pnode, iter([p for p in pnode.parents if p is not None])))
+                    advanced = True
+                    break
+            if not advanced:
+                state[id(node)] = 2
+                order.append(node)
+                stack.pop()
+
+    for r in roots:
+        if state.get(id(r), 0) == 0:
+            visit(r)
+    return order  # parents before children
+
+
+def _make_vjp_fn(fn, n_in, single_out):
+    """Pure function (inputs..., out_cotangents...) -> input cotangents tuple.
+    Being pure jax, it is itself recordable → higher-order autograd."""
+
+    def vjp_fn(*args):
+        primals, cots = args[:n_in], args[n_in:]
+        _, pullback = jax.vjp(lambda *p: fn(*p), *primals)
+        in_cots = pullback(cots[0] if single_out else tuple(cots))
+        return in_cots[0] if n_in == 1 else in_cots
+
+    return vjp_fn
+
+
+def _is_float(dt) -> bool:
+    return np.issubdtype(np.dtype(dt), np.inexact) or dt == jax.dtypes.bfloat16
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of `heads` w.r.t. all leaves with grads attached,
+    accumulating into each leaf's `.grad` per its grad_req."""
+    grads = _grad_impl(heads, head_grads, variables=None, create_graph=False)
+    return grads
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: autograd.grad — return grads w.r.t. `variables` instead of
+    writing .grad. With create_graph=True the returned grads are themselves
+    recorded, enabling grad-of-grad."""
+    from . import ndarray as _nd
+    single = not isinstance(variables, (list, tuple))
+    varlist = [variables] if single else list(variables)
+    out = _grad_impl(heads, head_grads, variables=varlist, create_graph=create_graph)
+    missing = [i for i, g in enumerate(out) if g is None]
+    if missing:
+        out = [g if g is not None else _nd.zeros_like(varlist[i])
+               for i, g in enumerate(out)]
+    return out[0] if single else out
+
+
+def _grad_impl(heads, head_grads, variables, create_graph):
+    from . import ndarray as _nd
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # Seed cotangents, keyed by (id(node), out_index).
+    cot = {}
+    node_by_id = {}
+    roots = []
+    # Pre-read variables index so a head that IS a variable seeds directly.
+    pre_var_index = None
+    if variables is not None:
+        pre_var_index = {id(v): i for i, v in enumerate(variables)}
+    pre_var_seeds = {}
+
+    for h, hg in zip(heads, head_grads):
+        if h._node is None:
+            seed = hg if hg is not None else _nd.ones_like(h)
+            if variables is None:
+                # head is itself a leaf: d head/d head = head_grad
+                if h._grad_req is not None:
+                    _accumulate_leaf(h, seed)
+            elif id(h) in pre_var_index:
+                j = pre_var_index[id(h)]
+                pre_var_seeds[j] = seed if j not in pre_var_seeds else pre_var_seeds[j] + seed
+            continue
+        node, oi = h._node
+        seed = hg if hg is not None else _nd.ones_like(h)
+        key = (id(node), oi)
+        cot[key] = seed if key not in cot else cot[key] + seed
+        node_by_id[id(node)] = node
+        roots.append(node)
+
+    if not roots and variables is None:
+        return None
+
+    order = _toposort(roots)
+
+    # Collected grads for explicit `variables` mode.
+    var_index = None
+    var_grads = None
+    if variables is not None:
+        var_index = pre_var_index
+        var_grads = [None] * len(variables)
+        for j, seed in pre_var_seeds.items():
+            var_grads[j] = seed
+    # Per-leaf accumulation within this walk (grad_req only governs how the
+    # final total is combined with any pre-existing .grad).
+    leaf_acc = {}
+
+    rec_scope = record() if create_graph else pause()
+    with rec_scope:
+        for node in reversed(order):
+            outs = []
+            have_any = False
+            for oi in range(node.n_out):
+                c = cot.pop((id(node), oi), None)
+                if c is None:
+                    shape, dt = node.out_avals[oi]
+                    c = _nd.zeros(shape, dtype=dt)
+                else:
+                    have_any = True
+                outs.append(c)
+            if not have_any:
+                continue
+            n_in = len(node.input_values)
+            if isinstance(node.fn, _CustomFn):
+                in_cots = node.fn.func.backward(*outs)
+                if not isinstance(in_cots, (list, tuple)):
+                    in_cots = (in_cots,)
+            else:
+                vjp = _make_vjp_fn(node.fn, n_in, node.n_out == 1)
+                in_shells = []
+                for i in range(n_in):
+                    leaf = node.leaf_refs[i]
+                    if leaf is not None and leaf._data is node.input_values[i]:
+                        # Reuse the original leaf so a create_graph walk
+                        # records it (identity matters for grad routing).
+                        in_shells.append(leaf)
+                    else:
+                        in_shells.append(
+                            _nd.NDArray(node.input_values[i], _node=node.parents[i]))
+                in_cots = _nd._apply(vjp, in_shells + outs, n_out=n_in,
+                                     name=(node.name or "op") + "_backward")
+                if n_in == 1:
+                    in_cots = (in_cots,)
+            for i, g in enumerate(in_cots):
+                if not _is_float(node.input_values[i].dtype):
+                    continue
+                parent = node.parents[i]
+                leaf = node.leaf_refs[i]
+                if parent is not None:
+                    pnode, pi = parent
+                    key = (id(pnode), pi)
+                    cot[key] = g if key not in cot else cot[key] + g
+                elif leaf is not None:
+                    if var_index is not None and id(leaf) in var_index:
+                        j = var_index[id(leaf)]
+                        var_grads[j] = g if var_grads[j] is None else var_grads[j] + g
+                    elif var_index is None:
+                        k = id(leaf)
+                        if k in leaf_acc:
+                            leaf_acc[k] = (leaf, leaf_acc[k][1] + g)
+                        else:
+                            leaf_acc[k] = (leaf, g)
+                # else: constant input, discard
+
+        for leaf, g in leaf_acc.values():
+            _accumulate_leaf(leaf, g)
+
+    return var_grads
+
+
+def _accumulate_leaf(leaf, g):
+    if leaf._grad_req == "add" and leaf._grad is not None:
+        leaf._grad._data = (leaf._grad._data + g._data).astype(leaf._grad._data.dtype)
+    else:  # 'write'
+        if leaf._grad is None:
+            from . import ndarray as _nd
+            leaf._grad = _nd.zeros_like(leaf)
+        leaf._grad._data = g._data.astype(leaf._grad._data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable Function (parity: mx.autograd.Function)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined op with explicit forward/backward.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays; call the
+    instance. Saved tensors go through ``self.save_for_backward``.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrs):
+        self._saved = arrs
+
+    def __call__(self, *inputs):
+        from . import ndarray as _nd
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+            # Record a node whose vjp is supplied by the user's backward().
+            parents, leaf_refs = [], []
+            for x in inputs:
+                parents.append(x._node)
+                leaf_refs.append(x if x._grad_req is not None else None)
+            out_avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+            node = Node(None, tuple(x._data for x in inputs), parents,
+                        leaf_refs, out_avals, type(self).__name__)
+            node.fn = _CustomFn(func, len(inputs))
+            for i, o in enumerate(outs):
+                o._node = (node, i)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+
+class _CustomFn:
+    """Adapter so the backward engine can vjp a user Function: jax.vjp is
+    bypassed — the user's backward computes input cotangents directly."""
+
+    def __init__(self, func, n_in):
+        self.func = func
+        self.n_in = n_in
+
+    def __call__(self, *raws):  # only used if someone re-runs forward
+        raise RuntimeError("custom Function cannot be re-executed from the tape")

@@ -1,0 +1,88 @@
+"""Shared helpers: dtype normalization, registries, name management.
+
+Reference parity: python/mxnet/base.py (registries, name manager) — minus the
+ctypes handle plumbing, which XLA makes unnecessary on the compute path.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+    "uint16": jnp.uint16, "uint32": jnp.uint32, "uint64": jnp.uint64,
+    "int16": jnp.int16,
+}
+
+
+def normalize_dtype(dtype):
+    """Accept strings, numpy dtypes, jnp dtypes; return a numpy dtype object."""
+    if dtype is None:
+        return np.dtype("float32")
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+    return np.dtype(dtype)
+
+
+class _Registry:
+    """String-keyed registry with `register` decorator and `create` factory
+    (parity with mx.operator/optimizer/initializer registries)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._map = {}
+
+    def register(self, name=None):
+        def deco(cls):
+            key = (name or cls.__name__).lower()
+            self._map[key] = cls
+            return cls
+        return deco
+
+    def create(self, name, *args, **kwargs):
+        if not isinstance(name, str):
+            return name  # already an instance
+        key = name.lower()
+        if key not in self._map:
+            raise ValueError(f"Unknown {self.kind} {name!r}. Registered: {sorted(self._map)}")
+        return self._map[key](*args, **kwargs)
+
+    def get(self, name):
+        return self._map[name.lower()]
+
+    def __contains__(self, name):
+        return isinstance(name, str) and name.lower() in self._map
+
+
+class NameManager:
+    """Auto-generates unique names per prefix (parity: mx.name.NameManager)."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self._counts = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        idx = self._counts.get(hint, 0)
+        self._counts[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    @classmethod
+    def current(cls):
+        if not hasattr(cls._tls, "nm"):
+            cls._tls.nm = NameManager()
+        return cls._tls.nm
+
+
+_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    return _SNAKE_RE.sub("_", name).lower()

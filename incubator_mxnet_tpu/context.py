@@ -1,0 +1,144 @@
+"""Device contexts — the TPU-native analogue of MXNet's mx.cpu()/mx.gpu().
+
+Reference parity: python/mxnet/context.py (Context, cpu, gpu, num_gpus,
+current_context, context scope via `with`). Here a Context wraps a
+`jax.Device`; `tpu(i)` is a first-class device alongside `cpu(i)`, per the
+north star. Placement happens through `jax.device_put`; compute launched on
+arrays resident on a device runs there (XLA), so MXNet's stream semantics
+map onto XLA's async dispatch.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_DEVTYPE_ALIASES = {
+    "cpu": "cpu",
+    "tpu": "tpu",
+    # On this stack the accelerator platform may register as an experimental
+    # name (e.g. "axon" tunnels a TPU); `gpu` maps to CUDA when present.
+    "gpu": "gpu",
+}
+
+
+class Context:
+    """A device context. ``with ctx:`` scopes the default context."""
+
+    _tls = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = str(device_type)
+        self.device_id = int(device_id)
+        self._device = None  # resolved lazily
+
+    # -- resolution -------------------------------------------------------
+    @property
+    def device(self) -> jax.Device:
+        if self._device is None:
+            self._device = _resolve_device(self.device_type, self.device_id)
+        return self._device
+
+    # -- identity ---------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    # -- scoping ----------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._tls, "stack"):
+            Context._tls.stack = []
+        Context._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._tls.stack.pop()
+        return False
+
+    @classmethod
+    def current(cls) -> "Context":
+        stack = getattr(cls._tls, "stack", None)
+        if stack:
+            return stack[-1]
+        return default_context()
+
+
+def _platform_devices(platform: str):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+def _resolve_device(device_type: str, device_id: int) -> jax.Device:
+    platform = _DEVTYPE_ALIASES.get(device_type, device_type)
+    devs = _platform_devices(platform)
+    if not devs and platform == "tpu":
+        # TPU may surface under an experimental platform name; fall back to
+        # whatever the default backend exposes if it is not plain CPU, else
+        # (CPU-only test envs) use CPU so `tpu()` code still runs.
+        devs = [d for d in jax.devices() if d.platform != "cpu"] or _platform_devices("cpu")
+    if not devs:
+        raise ValueError(
+            f"No device of type {device_type!r} available (jax platforms: "
+            f"{[d.platform for d in jax.devices()]})"
+        )
+    if device_id >= len(devs):
+        raise ValueError(f"{device_type}({device_id}) out of range: {len(devs)} available")
+    return devs[device_id]
+
+
+_default_ctx = None
+
+
+def default_context() -> Context:
+    """Default context: the first device of JAX's default backend (TPU on a
+    TPU host, CPU in the test environment)."""
+    global _default_ctx
+    if _default_ctx is None:
+        dev = jax.devices()[0]
+        devtype = "tpu" if dev.platform not in ("cpu", "gpu", "cuda") else dev.platform
+        ctx = Context(devtype, 0)
+        ctx._device = dev
+        _default_ctx = ctx
+    return _default_ctx
+
+
+def current_context() -> Context:
+    return Context.current()
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def num_tpus() -> int:
+    return len([d for d in jax.devices() if d.platform not in ("cpu", "gpu", "cuda")])
+
+
+def num_gpus() -> int:
+    return len(_platform_devices("gpu"))
+
+
+def ctx_from_device(dev: jax.Device) -> Context:
+    devtype = "cpu" if dev.platform == "cpu" else ("gpu" if dev.platform in ("gpu", "cuda") else "tpu")
+    ctx = Context(devtype, dev.id)
+    ctx._device = dev
+    return ctx

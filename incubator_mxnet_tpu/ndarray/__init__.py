@@ -1,0 +1,1058 @@
+"""NDArray: the imperative array type, backed by `jax.Array`.
+
+Reference parity: python/mxnet/ndarray/ndarray.py + src/ndarray/. The
+reference NDArray is a mutable chunk scheduled on the threaded engine; here
+the storage is an immutable `jax.Array` and mutation swaps the underlying
+buffer (functional update via `.at[]`), while XLA's async dispatch plays the
+role of the engine (`wait_to_read` == `block_until_ready`). Every eager op
+funnels through `_apply`, which records a tape Node while
+`autograd.record()` is active — so the same op surface works eagerly, under
+the tape, and under `jax.jit` tracing (HybridBlock), where `_data` is a
+tracer.
+
+Design choice vs reference: numpy-style implicit broadcasting everywhere
+(like mx.np), with the legacy `broadcast_*` names kept as aliases.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import autograd
+from ..base import normalize_dtype
+from ..context import Context, ctx_from_device, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "concatenate", "stack", "split", "dot", "batch_dot",
+           "save", "load", "waitall"]
+
+
+def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None):
+    """Run a pure jax function on NDArray inputs; record on the tape if
+    autograd is recording. The single funnel for all eager ops."""
+    raws = [x._data for x in inputs]
+    outs = fn(*raws)
+    outs_t = (outs,) if n_out == 1 else tuple(outs)
+    results = [NDArray(o) for o in outs_t]
+    if autograd.is_recording():
+        autograd._record_op(fn, inputs, raws, results, name)
+    return results[0] if n_out == 1 else tuple(results)
+
+
+def _as_nd(x, ref: Optional["NDArray"] = None):
+    if isinstance(x, NDArray):
+        return x
+    dtype = ref._data.dtype if ref is not None and not isinstance(x, (bool, np.bool_)) else None
+    return NDArray(jnp.asarray(x, dtype=dtype))
+
+
+def _binary(jfn, x, y, name=None):
+    if isinstance(x, NDArray) and isinstance(y, NDArray):
+        return _apply(jfn, [x, y], name=name)
+    if isinstance(x, NDArray):
+        return _apply(lambda a: jfn(a, y), [x], name=name)
+    return _apply(lambda b: jfn(x, b), [y], name=name)
+
+
+def _unary(jfn, x, name=None, **kw):
+    if kw:
+        return _apply(lambda a: jfn(a, **kw), [x], name=name)
+    return _apply(jfn, [x], name=name)
+
+
+class NDArray:
+    """An n-dimensional array on a device (TPU-first)."""
+
+    __slots__ = ("_data", "_node", "_grad", "_grad_req", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None, _node=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array) or dtype is not None:
+            dt = None if dtype is None else normalize_dtype(dtype)
+            data = jnp.asarray(data, dtype=dt)
+        if ctx is not None and isinstance(data, jax.Array) and not _is_tracer(data):
+            dev = ctx.device
+            if _device_of(data) is not dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._node = _node
+        self._grad = None
+        self._grad_req = None
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 else self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if _is_tracer(self._data):
+            return current_context()
+        return ctx_from_device(_device_of(self._data))
+
+    ctx = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # -- materialization --------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self._data.reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def wait_to_read(self):
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+        return self
+
+    def jax(self) -> jax.Array:
+        """Raw backing jax.Array (escape hatch for interop)."""
+        return self._data
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write"):
+        if grad_req == "null":
+            self._grad_req = None
+            self._grad = None
+        else:
+            self._grad_req = grad_req
+            self._grad = zeros_like(self)
+        self._node = None  # becomes a fresh leaf (parity: attach_grad detaches)
+        return self
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph, train_mode)
+
+    def detach(self) -> "NDArray":
+        return NDArray(self._data)
+
+    # -- movement / casting ----------------------------------------------
+    def astype(self, dtype, copy=True):
+        dt = normalize_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return _apply(lambda a: a.astype(dt), [self], name="astype")
+
+    def copy(self) -> "NDArray":
+        return _apply(lambda a: a + 0 if a.dtype != jnp.bool_ else jnp.copy(a), [self], name="copy")
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        other._data = jax.device_put(self._data.astype(other._data.dtype),
+                                     _device_of(other._data))
+        other._node = self._node
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if not _is_tracer(self._data) and _device_of(self._data) is ctx.device:
+            return self
+        out = NDArray(jax.device_put(self._data, ctx.device))
+        out._node = self._node
+        return out
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    # -- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        key = _fix_index(key)
+        return _apply(lambda a: a[key], [self], name="getitem")
+
+    def __setitem__(self, key, value):
+        key = _fix_index(key)
+        if isinstance(value, NDArray):
+            new = _apply(lambda a, v: a.at[key].set(v.astype(a.dtype)), [self, value],
+                         name="setitem")
+        else:
+            new = _apply(lambda a: a.at[key].set(value), [self], name="setitem")
+        self._data = new._data
+        self._node = new._node
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of multi-element NDArray is ambiguous")
+        return bool(self._data.reshape(()).item())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    __hash__ = object.__hash__  # identity hash; __eq__ below is elementwise
+
+    # -- arithmetic -------------------------------------------------------
+    def __add__(self, o): return _binary(jnp.add, self, o, "add")
+    def __radd__(self, o): return _binary(jnp.add, o, self, "add")
+    def __sub__(self, o): return _binary(jnp.subtract, self, o, "sub")
+    def __rsub__(self, o): return _binary(jnp.subtract, o, self, "sub")
+    def __mul__(self, o): return _binary(jnp.multiply, self, o, "mul")
+    def __rmul__(self, o): return _binary(jnp.multiply, o, self, "mul")
+    def __truediv__(self, o): return _binary(jnp.divide, self, o, "div")
+    def __rtruediv__(self, o): return _binary(jnp.divide, o, self, "div")
+    def __floordiv__(self, o): return _binary(jnp.floor_divide, self, o, "floordiv")
+    def __rfloordiv__(self, o): return _binary(jnp.floor_divide, o, self, "floordiv")
+    def __mod__(self, o): return _binary(jnp.mod, self, o, "mod")
+    def __rmod__(self, o): return _binary(jnp.mod, o, self, "mod")
+    def __pow__(self, o): return _binary(jnp.power, self, o, "pow")
+    def __rpow__(self, o): return _binary(jnp.power, o, self, "pow")
+    def __matmul__(self, o): return _binary(jnp.matmul, self, o, "matmul")
+    def __neg__(self): return _unary(jnp.negative, self, "neg")
+    def __abs__(self): return _unary(jnp.abs, self, "abs")
+
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._data, self._node = r._data, r._node
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._data, self._node = r._data, r._node
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._data, self._node = r._data, r._node
+        return self
+
+    def __itruediv__(self, o):
+        r = self.__truediv__(o)
+        self._data, self._node = r._data, r._node
+        return self
+
+    # -- comparisons (elementwise, parity with mx.nd) ---------------------
+    def __eq__(self, o): return _binary(jnp.equal, self, o, "eq")
+    def __ne__(self, o): return _binary(jnp.not_equal, self, o, "ne")
+    def __lt__(self, o): return _binary(jnp.less, self, o, "lt")
+    def __le__(self, o): return _binary(jnp.less_equal, self, o, "le")
+    def __gt__(self, o): return _binary(jnp.greater, self, o, "gt")
+    def __ge__(self, o): return _binary(jnp.greater_equal, self, o, "ge")
+
+    # -- shape manipulation ----------------------------------------------
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        return _apply(lambda a: a.reshape(shape), [self], name="reshape")
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, axes=None):
+        return _apply(lambda a: jnp.transpose(a, axes), [self], name="transpose")
+
+    def swapaxes(self, a1, a2):
+        return _apply(lambda a: jnp.swapaxes(a, a1, a2), [self], name="swapaxes")
+
+    def flatten(self):
+        """MXNet semantics: collapse all but the first axis → (N, -1)."""
+        return _apply(lambda a: a.reshape(a.shape[0], -1), [self], name="flatten")
+
+    def ravel(self):
+        return _apply(lambda a: a.reshape(-1), [self], name="ravel")
+
+    def expand_dims(self, axis):
+        return _apply(lambda a: jnp.expand_dims(a, axis), [self], name="expand_dims")
+
+    def squeeze(self, axis=None):
+        return _apply(lambda a: jnp.squeeze(a, axis), [self], name="squeeze")
+
+    def broadcast_to(self, shape):
+        return _apply(lambda a: jnp.broadcast_to(a, shape), [self], name="broadcast_to")
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps):
+        return _apply(lambda a: jnp.tile(a, reps), [self], name="tile")
+
+    def repeat(self, repeats, axis=None):
+        return _apply(lambda a: jnp.repeat(a, repeats, axis), [self], name="repeat")
+
+    def flip(self, axis):
+        return _apply(lambda a: jnp.flip(a, axis), [self], name="flip")
+
+    def split(self, num_outputs, axis=0):
+        return split(self, num_outputs, axis)
+
+    def slice_axis(self, axis, begin, end):
+        return slice_axis(self, axis, begin, end)
+
+    # -- math methods (delegate to module fns) ----------------------------
+    def sum(self, axis=None, keepdims=False): return sum(self, axis, keepdims)
+    def mean(self, axis=None, keepdims=False): return mean(self, axis, keepdims)
+    def max(self, axis=None, keepdims=False): return max(self, axis, keepdims)
+    def min(self, axis=None, keepdims=False): return min(self, axis, keepdims)
+    def prod(self, axis=None, keepdims=False): return prod(self, axis, keepdims)
+    def argmax(self, axis=None, keepdims=False): return argmax(self, axis, keepdims)
+    def argmin(self, axis=None, keepdims=False): return argmin(self, axis, keepdims)
+    def norm(self, ord=2, axis=None, keepdims=False): return norm(self, ord, axis, keepdims)
+    def var(self, axis=None, keepdims=False): return var(self, axis, keepdims)
+    def std(self, axis=None, keepdims=False): return std(self, axis, keepdims)
+    def abs(self): return _unary(jnp.abs, self, "abs")
+    def exp(self): return _unary(jnp.exp, self, "exp")
+    def log(self): return _unary(jnp.log, self, "log")
+    def sqrt(self): return _unary(jnp.sqrt, self, "sqrt")
+    def square(self): return _unary(jnp.square, self, "square")
+    def sign(self): return _unary(jnp.sign, self, "sign")
+    def round(self): return _unary(jnp.round, self, "round")
+    def floor(self): return _unary(jnp.floor, self, "floor")
+    def ceil(self): return _unary(jnp.ceil, self, "ceil")
+    def clip(self, a_min=None, a_max=None): return clip(self, a_min, a_max)
+    def relu(self): return _unary(jax.nn.relu, self, "relu")
+    def sigmoid(self): return _unary(jax.nn.sigmoid, self, "sigmoid")
+    def tanh(self): return _unary(jnp.tanh, self, "tanh")
+    def softmax(self, axis=-1): return softmax(self, axis)
+    def log_softmax(self, axis=-1): return log_softmax(self, axis)
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return dot(self, other, transpose_a, transpose_b)
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return one_hot(self, depth, on_value, off_value)
+    def take(self, indices, axis=0):
+        return take(self, indices, axis)
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return topk(self, axis, k, ret_typ, is_ascend)
+    def sort(self, axis=-1, is_ascend=True): return sort(self, axis, is_ascend)
+    def argsort(self, axis=-1, is_ascend=True): return argsort(self, axis, is_ascend)
+    def cumsum(self, axis=None): return _unary(jnp.cumsum, self, "cumsum", axis=axis)
+
+    # -- misc -------------------------------------------------------------
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray tracer {self.shape} {self._data.dtype}>"
+        vals = np.array2string(self.asnumpy(), precision=4, suppress_small=True,
+                               threshold=20)
+        return f"{vals}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context} {self._data.dtype}>"
+
+    def zeros_like(self): return zeros_like(self)
+    def ones_like(self): return ones_like(self)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _device_of(arr: jax.Array):
+    try:
+        return next(iter(arr.devices()))
+    except Exception:
+        return None
+
+
+def _fix_index(key):
+    """Unwrap NDArray indices to raw arrays."""
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+# ===========================================================================
+# creation
+# ===========================================================================
+
+def array(source, ctx=None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        source = source._data
+    dt = normalize_dtype(dtype) if dtype is not None else None
+    if dt is None and not isinstance(source, jax.Array):
+        a = np.asarray(source)
+        # mx defaults: float64 literals → float32; int64 → int32 (x64 is off)
+        dt = {np.dtype("float64"): np.float32,
+              np.dtype("int64"): np.int32}.get(a.dtype, a.dtype)
+        source = a
+    return NDArray(jnp.asarray(source, dtype=dt), ctx=ctx or current_context())
+
+
+def zeros(shape, ctx=None, dtype="float32") -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, normalize_dtype(dtype)), ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype="float32") -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, normalize_dtype(dtype)), ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype="float32") -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, val, normalize_dtype(dtype)), ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros_like(x: NDArray) -> NDArray:
+    return _apply(jnp.zeros_like, [x], name="zeros_like")
+
+
+def ones_like(x: NDArray) -> NDArray:
+    return _apply(jnp.ones_like, [x], name="ones_like")
+
+
+def full_like(x: NDArray, val) -> NDArray:
+    return _apply(lambda a: jnp.full_like(a, val), [x], name="full_like")
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    a = jnp.arange(start, stop, step, normalize_dtype(dtype))
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(a, ctx=ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=normalize_dtype(dtype)), ctx=ctx or current_context())
+
+
+def eye(N, M=None, k=0, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.eye(N, M, k, dtype=normalize_dtype(dtype)), ctx=ctx or current_context())
+
+
+identity = eye
+
+
+# ===========================================================================
+# elementwise / math
+# ===========================================================================
+
+def _make_unary(jfn, name):
+    def f(x, out=None):
+        r = _unary(jfn, _as_nd(x), name)
+        if out is not None:
+            out._data, out._node = r._data, r._node
+            return out
+        return r
+    f.__name__ = name
+    return f
+
+
+exp = _make_unary(jnp.exp, "exp")
+expm1 = _make_unary(jnp.expm1, "expm1")
+log = _make_unary(jnp.log, "log")
+log2 = _make_unary(jnp.log2, "log2")
+log10 = _make_unary(jnp.log10, "log10")
+log1p = _make_unary(jnp.log1p, "log1p")
+sqrt = _make_unary(jnp.sqrt, "sqrt")
+rsqrt = _make_unary(lambda a: 1.0 / jnp.sqrt(a), "rsqrt")
+cbrt = _make_unary(jnp.cbrt, "cbrt")
+rcbrt = _make_unary(lambda a: 1.0 / jnp.cbrt(a), "rcbrt")
+square = _make_unary(jnp.square, "square")
+abs = _make_unary(jnp.abs, "abs")
+sign = _make_unary(jnp.sign, "sign")
+floor = _make_unary(jnp.floor, "floor")
+ceil = _make_unary(jnp.ceil, "ceil")
+round = _make_unary(jnp.round, "round")
+rint = _make_unary(jnp.rint, "rint")
+trunc = _make_unary(jnp.trunc, "trunc")
+fix = _make_unary(jnp.trunc, "fix")
+negative = _make_unary(jnp.negative, "negative")
+reciprocal = _make_unary(lambda a: 1.0 / a, "reciprocal")
+sin = _make_unary(jnp.sin, "sin")
+cos = _make_unary(jnp.cos, "cos")
+tan = _make_unary(jnp.tan, "tan")
+arcsin = _make_unary(jnp.arcsin, "arcsin")
+arccos = _make_unary(jnp.arccos, "arccos")
+arctan = _make_unary(jnp.arctan, "arctan")
+sinh = _make_unary(jnp.sinh, "sinh")
+cosh = _make_unary(jnp.cosh, "cosh")
+tanh = _make_unary(jnp.tanh, "tanh")
+arcsinh = _make_unary(jnp.arcsinh, "arcsinh")
+arccosh = _make_unary(jnp.arccosh, "arccosh")
+arctanh = _make_unary(jnp.arctanh, "arctanh")
+erf = _make_unary(jax.scipy.special.erf, "erf")
+erfinv = _make_unary(jax.scipy.special.erfinv, "erfinv")
+gammaln = _make_unary(jax.scipy.special.gammaln, "gammaln")
+relu = _make_unary(jax.nn.relu, "relu")
+sigmoid = _make_unary(jax.nn.sigmoid, "sigmoid")
+softsign = _make_unary(jax.nn.soft_sign, "softsign")
+logical_not = _make_unary(jnp.logical_not, "logical_not")
+isnan = _make_unary(jnp.isnan, "isnan")
+isinf = _make_unary(jnp.isinf, "isinf")
+isfinite = _make_unary(jnp.isfinite, "isfinite")
+
+
+def softrelu(x):
+    return _unary(jax.nn.softplus, _as_nd(x), "softrelu")
+
+
+def gelu(x, approximate=True):
+    return _unary(lambda a: jax.nn.gelu(a, approximate=approximate), _as_nd(x), "gelu")
+
+
+def leaky_relu(x, slope=0.25):
+    return _unary(lambda a: jax.nn.leaky_relu(a, slope), _as_nd(x), "leaky_relu")
+
+
+def elu(x, alpha=1.0):
+    return _unary(lambda a: jax.nn.elu(a, alpha), _as_nd(x), "elu")
+
+
+def selu(x):
+    return _unary(jax.nn.selu, _as_nd(x), "selu")
+
+
+def silu(x):
+    return _unary(jax.nn.silu, _as_nd(x), "silu")
+
+
+swish = silu
+
+
+def softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        return _unary(lambda a: jax.nn.softmax(a / temperature, axis=axis), x, "softmax")
+    return _unary(lambda a: jax.nn.softmax(a, axis=axis), x, "softmax")
+
+
+def log_softmax(x, axis=-1):
+    return _unary(lambda a: jax.nn.log_softmax(a, axis=axis), x, "log_softmax")
+
+
+def clip(x, a_min=None, a_max=None):
+    return _unary(lambda a: jnp.clip(a, a_min, a_max), x, "clip")
+
+
+def power(x, y): return _binary(jnp.power, x, y, "power")
+def add(x, y): return _binary(jnp.add, x, y, "add")
+def subtract(x, y): return _binary(jnp.subtract, x, y, "subtract")
+def multiply(x, y): return _binary(jnp.multiply, x, y, "multiply")
+def divide(x, y): return _binary(jnp.divide, x, y, "divide")
+def modulo(x, y): return _binary(jnp.mod, x, y, "modulo")
+def maximum(x, y): return _binary(jnp.maximum, x, y, "maximum")
+def minimum(x, y): return _binary(jnp.minimum, x, y, "minimum")
+def hypot(x, y): return _binary(jnp.hypot, x, y, "hypot")
+def arctan2(x, y): return _binary(jnp.arctan2, x, y, "arctan2")
+def equal(x, y): return _binary(jnp.equal, x, y, "equal")
+def not_equal(x, y): return _binary(jnp.not_equal, x, y, "not_equal")
+def greater(x, y): return _binary(jnp.greater, x, y, "greater")
+def greater_equal(x, y): return _binary(jnp.greater_equal, x, y, "greater_equal")
+def lesser(x, y): return _binary(jnp.less, x, y, "lesser")
+def less(x, y): return _binary(jnp.less, x, y, "less")
+def lesser_equal(x, y): return _binary(jnp.less_equal, x, y, "lesser_equal")
+def less_equal(x, y): return _binary(jnp.less_equal, x, y, "less_equal")
+def logical_and(x, y): return _binary(jnp.logical_and, x, y, "logical_and")
+def logical_or(x, y): return _binary(jnp.logical_or, x, y, "logical_or")
+def logical_xor(x, y): return _binary(jnp.logical_xor, x, y, "logical_xor")
+
+# legacy explicit-broadcast aliases (the rebuild broadcasts implicitly)
+broadcast_add = add
+broadcast_sub = subtract
+broadcast_minus = subtract
+broadcast_mul = multiply
+broadcast_div = divide
+broadcast_mod = modulo
+broadcast_power = power
+broadcast_maximum = maximum
+broadcast_minimum = minimum
+broadcast_equal = equal
+broadcast_not_equal = not_equal
+broadcast_greater = greater
+broadcast_greater_equal = greater_equal
+broadcast_lesser = lesser
+broadcast_lesser_equal = lesser_equal
+broadcast_logical_and = logical_and
+broadcast_logical_or = logical_or
+broadcast_logical_xor = logical_xor
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+elemwise_div = divide
+
+
+def where(cond, x, y):
+    cond, x, y = _as_nd(cond), _as_nd(x), _as_nd(y)
+    return _apply(jnp.where, [cond, x, y], name="where")
+
+
+# ===========================================================================
+# reductions
+# ===========================================================================
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def sum(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.sum(a, axis=_norm_axis(axis), keepdims=keepdims), x, "sum")
+
+
+def nansum(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.nansum(a, axis=_norm_axis(axis), keepdims=keepdims), x, "nansum")
+
+
+def mean(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.mean(a, axis=_norm_axis(axis), keepdims=keepdims), x, "mean")
+
+
+def max(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.max(a, axis=_norm_axis(axis), keepdims=keepdims), x, "max")
+
+
+def min(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.min(a, axis=_norm_axis(axis), keepdims=keepdims), x, "min")
+
+
+def prod(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.prod(a, axis=_norm_axis(axis), keepdims=keepdims), x, "prod")
+
+
+def var(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.var(a, axis=_norm_axis(axis), keepdims=keepdims), x, "var")
+
+
+def std(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.std(a, axis=_norm_axis(axis), keepdims=keepdims), x, "std")
+
+
+def argmax(x, axis=None, keepdims=False):
+    def f(a):
+        r = jnp.argmax(a, axis=axis, keepdims=keepdims).astype(jnp.float32)
+        return r
+    return _unary(f, x, "argmax")
+
+
+def argmin(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdims).astype(jnp.float32),
+                  x, "argmin")
+
+
+def norm(x, ord=2, axis=None, keepdims=False):
+    def f(a):
+        if axis is None:
+            # mx.nd.norm: entrywise norm over all elements (not spectral)
+            r = jnp.linalg.norm(a.reshape(-1), ord=ord)
+            return r.reshape((1,) * a.ndim) if keepdims else r
+        return jnp.linalg.norm(a, ord=ord, axis=_norm_axis(axis), keepdims=keepdims)
+    return _unary(f, x, "norm")
+
+
+def all(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.all(a, axis=_norm_axis(axis), keepdims=keepdims), x, "all")
+
+
+def any(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.any(a, axis=_norm_axis(axis), keepdims=keepdims), x, "any")
+
+
+def cumsum(x, axis=None, dtype=None):
+    return _unary(lambda a: jnp.cumsum(a, axis=axis, dtype=dtype), x, "cumsum")
+
+
+# ===========================================================================
+# shape manipulation
+# ===========================================================================
+
+def reshape(x, shape):
+    return x.reshape(shape)
+
+
+def transpose(x, axes=None):
+    return x.transpose(axes)
+
+
+def swapaxes(x, a1, a2):
+    return x.swapaxes(a1, a2)
+
+
+def expand_dims(x, axis):
+    return x.expand_dims(axis)
+
+
+def squeeze(x, axis=None):
+    return x.squeeze(axis)
+
+
+def flatten(x):
+    return x.flatten()
+
+
+def flip(x, axis):
+    return x.flip(axis)
+
+
+def tile(x, reps):
+    return x.tile(reps)
+
+
+def repeat(x, repeats, axis=None):
+    return x.repeat(repeats, axis)
+
+
+def broadcast_to(x, shape):
+    return x.broadcast_to(shape)
+
+
+def broadcast_like(x, other):
+    return x.broadcast_to(other.shape)
+
+
+def broadcast_axis(x, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+
+    def f(a):
+        shape = list(a.shape)
+        for ax, s in zip(axes, sizes):
+            shape[ax] = s
+        return jnp.broadcast_to(a, shape)
+    return _unary(f, x, "broadcast_axis")
+
+
+def concat(*args, dim=1, axis=None):
+    # MXNet's nd.concat defaults to dim=1 (channel axis) — keep that contract.
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ax = axis if axis is not None else dim
+    return _apply(lambda *xs: jnp.concatenate(xs, axis=ax), list(args), name="concat")
+
+
+def concatenate(arrays, axis=0):
+    return concat(*arrays, dim=axis)
+
+
+def stack(*args, axis=0):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _apply(lambda *xs: jnp.stack(xs, axis=axis), list(args), name="stack")
+
+
+def split(x, num_outputs, axis=0, squeeze_axis=False):
+    if num_outputs == 1:
+        # parity: mx.nd.split with one output returns the array itself
+        return _apply(lambda a: jnp.squeeze(a, axis) if squeeze_axis else a,
+                      [x], name="split")
+
+    def f(a):
+        parts = jnp.split(a, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return _apply(f, [x], n_out=num_outputs, name="split")
+
+
+SliceChannel = split
+
+
+def slice_axis(x, axis, begin, end):
+    def f(a):
+        n = a.shape[axis]
+        b = begin if begin >= 0 else n + begin
+        e = n if end is None else (end if end >= 0 else n + end)
+        return lax.slice_in_dim(a, b, e, axis=axis)
+    return _unary(f, x, "slice_axis")
+
+
+def slice(x, begin, end, step=None):
+    def f(a):
+        idx = tuple(builtins_slice(b, e, s) for b, e, s in
+                    zip(begin, end, step or [None] * len(begin)))
+        return a[idx]
+    return _unary(f, x, "slice")
+
+
+from builtins import slice as builtins_slice  # noqa: E402
+
+
+def slice_like(x, shape_like, axes=None):
+    def f(a, b):
+        idx = []
+        for ax in range(a.ndim):
+            if axes is None or ax in axes:
+                idx.append(builtins_slice(0, b.shape[ax]))
+            else:
+                idx.append(builtins_slice(None))
+        return a[tuple(idx)]
+    return _apply(f, [x, shape_like], name="slice_like")
+
+
+def pad(x, mode="constant", pad_width=None, constant_value=0):
+    """MXNet pad: pad_width is a flat tuple (before0, after0, before1, ...)."""
+    def f(a):
+        pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(a.ndim)]
+        jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pw, mode=jmode, constant_values=constant_value)
+        return jnp.pad(a, pw, mode=jmode)
+    return _unary(f, x, "pad")
+
+
+def diag(x, k=0):
+    return _unary(lambda a: jnp.diag(a, k) if a.ndim <= 2 else jnp.diagonal(a, k, -2, -1),
+                  x, "diag")
+
+
+def tril(x, k=0):
+    return _unary(lambda a: jnp.tril(a, k), x, "tril")
+
+
+def triu(x, k=0):
+    return _unary(lambda a: jnp.triu(a, k), x, "triu")
+
+
+def roll(x, shift, axis=None):
+    return _unary(lambda a: jnp.roll(a, shift, axis), x, "roll")
+
+
+# ===========================================================================
+# indexing-ish ops
+# ===========================================================================
+
+def take(x, indices, axis=0, mode="clip"):
+    indices = _as_nd(indices)
+    return _apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis, mode=mode),
+                  [x, indices], name="take")
+
+
+def pick(x, index, axis=-1, keepdims=False):
+    index = _as_nd(index)
+
+    def f(a, i):
+        r = jnp.take_along_axis(a, jnp.expand_dims(i.astype(jnp.int32), axis), axis=axis)
+        return r if keepdims else jnp.squeeze(r, axis)
+    return _apply(f, [x, index], name="pick")
+
+
+def gather_nd(x, indices):
+    indices = _as_nd(indices)
+
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        m = idx.shape[0]
+        return a[tuple(idx[i] for i in range(m))]
+    return _apply(f, [x, indices], name="gather_nd")
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    indices = _as_nd(indices)
+
+    def f(i):
+        oh = jax.nn.one_hot(i.astype(jnp.int32), depth, dtype=normalize_dtype(dtype))
+        if on_value != 1.0 or off_value != 0.0:
+            oh = oh * (on_value - off_value) + off_value
+        return oh
+    return _unary(f, indices, "one_hot")
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    """Parity: nd.Embedding — lookup rows of `weight` by integer `data`."""
+    data = _as_nd(data)
+    return _apply(lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
+                  [data, weight], name="embedding")
+
+
+Embedding = embedding
+
+
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    def move(a):
+        return jnp.moveaxis(a, axis, -1)
+
+    if ret_typ not in ("indices", "value", "both", "mask"):
+        raise ValueError(f"topk ret_typ must be indices|value|both|mask, got {ret_typ!r}")
+
+    def f(a):
+        m = move(a)
+        vals, idx = lax.top_k(jnp.negative(m) if is_ascend else m, k)
+        if is_ascend:
+            vals = -vals
+        if ret_typ == "mask":
+            oh = jax.nn.one_hot(idx, m.shape[-1], dtype=normalize_dtype(dtype))
+            return jnp.moveaxis(oh.sum(-2), -1, axis)
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "both":
+            return (vals, idx.astype(normalize_dtype(dtype)))
+        return idx.astype(normalize_dtype(dtype))
+
+    n_out = 2 if ret_typ == "both" else 1
+    return _apply(f, [x], n_out=n_out, name="topk")
+
+
+def sort(x, axis=-1, is_ascend=True):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return _unary(f, x, "sort")
+
+
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    def f(a):
+        s = jnp.argsort(a, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(normalize_dtype(dtype))
+    return _unary(f, x, "argsort")
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    """Parity: nd.SequenceMask — mask positions beyond each sequence length.
+    `data` layout: (seq, batch, ...) for axis=0, (batch, seq, ...) for axis=1."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    sequence_length = _as_nd(sequence_length)
+
+    def f(a, sl):
+        seq = a.shape[axis]
+        pos = jnp.arange(seq)
+        mask = pos[None, :] < sl[:, None].astype(jnp.int32)  # (batch, seq)
+        if axis == 0:
+            mask = mask.T  # (seq, batch)
+        mask = mask.reshape(mask.shape + (1,) * (a.ndim - 2))
+        return jnp.where(mask, a, jnp.asarray(value, a.dtype))
+    return _apply(f, [data, sequence_length], name="sequence_mask")
+
+
+SequenceMask = sequence_mask
+
+
+# ===========================================================================
+# linear algebra
+# ===========================================================================
+
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """MXNet dot: contract last axis of a with first axis of b."""
+    def f(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+        if transpose_b:
+            y = jnp.swapaxes(y, 0, 1) if y.ndim > 1 else y
+        if x.ndim == 1 and y.ndim == 1:
+            return jnp.dot(x, y)
+        return jnp.tensordot(x, y, axes=1)
+    return _apply(f, [a, b], name="dot")
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    def f(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+    return _apply(f, [a, b], name="batch_dot")
+
+
+def matmul(a, b):
+    return _binary(jnp.matmul, a, b, "matmul")
+
+
+def einsum(subscripts, *operands):
+    return _apply(lambda *xs: jnp.einsum(subscripts, *xs), list(operands), name="einsum")
+
+
+def outer(a, b):
+    return _apply(jnp.outer, [a, b], name="outer")
+
+
+# ===========================================================================
+# persistence (parity: mx.nd.save / mx.nd.load)
+# ===========================================================================
+
+def save(fname, data):
+    """Save NDArray | list[NDArray] | dict[str, NDArray]."""
+    if isinstance(data, NDArray):
+        payload = ("single", np.asarray(data._data))
+    elif isinstance(data, (list, tuple)):
+        payload = ("list", [np.asarray(x._data) for x in data])
+    elif isinstance(data, dict):
+        payload = ("dict", {k: np.asarray(v._data) for k, v in data.items()})
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+def load(fname):
+    with open(fname, "rb") as f:
+        kind, payload = pickle.load(f)
+    if kind == "single":
+        return array(payload)
+    if kind == "list":
+        return [array(x) for x in payload]
+    return {k: array(v) for k, v in payload.items()}
+
+
+def waitall():
+    """Parity: mx.nd.waitall — barrier on all outstanding async work."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def moveaxis(x, source, destination):
+    return _unary(lambda a: jnp.moveaxis(a, source, destination), x, "moveaxis")
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+Cast = cast
+
+
+def stop_gradient(x):
+    return _unary(lax.stop_gradient, x, "stop_gradient")
+
+
+BlockGrad = stop_gradient
+block_grad = stop_gradient
+
+from . import random  # noqa: E402  (registers nd.random namespace)
+from .random import shuffle  # noqa: E402

@@ -1,0 +1,151 @@
+"""Stateful random sampling (parity: mx.nd.random + mx.random.seed).
+
+MXNet keeps per-device RNG state; here a process-global PRNG key is split on
+every draw, so eager sampling is stateful like the reference while each draw
+itself is a pure jax op. Inside jitted code (hybridized blocks), layers that
+need randomness (Dropout) thread keys explicitly instead — this module is the
+eager/imperative surface.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import normalize_dtype
+from ..context import current_context
+from . import NDArray
+
+# Process-global key (parity: mx.random.seed seeds every consumer, including
+# worker threads); a lock keeps split() race-free across threads.
+_lock = threading.Lock()
+_global_key = None
+
+
+def _key():
+    global _global_key
+    with _lock:
+        if _global_key is None:
+            _global_key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**63))
+        _global_key, sub = jax.random.split(_global_key)
+    return sub
+
+
+def seed(seed_state, ctx="all"):
+    global _global_key
+    with _lock:
+        _global_key = jax.random.PRNGKey(int(seed_state))
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _wrap(raw, ctx):
+    return NDArray(raw, ctx=ctx or current_context())
+
+
+def _fill_out(out, r):
+    """Overwrite `out` in place: keep its dtype, detach any stale tape node."""
+    out._data = r.astype(out._data.dtype)
+    out._node = None
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    if out is not None and shape is None:
+        shape = out.shape
+    r = jax.random.uniform(_key(), _shape(shape), normalize_dtype(dtype),
+                           minval=low, maxval=high)
+    if out is not None:
+        return _fill_out(out, r)
+    return _wrap(r, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    if out is not None and shape is None:
+        shape = out.shape
+    r = loc + scale * jax.random.normal(_key(), _shape(shape), normalize_dtype(dtype))
+    if out is not None:
+        return _fill_out(out, r)
+    return _wrap(r, ctx)
+
+
+randn = lambda *shape, **kw: normal(shape=shape, **kw)  # noqa: E731
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    r = jax.random.randint(_key(), _shape(shape), low, high, normalize_dtype(dtype))
+    return _wrap(r, ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None):
+    r = jax.random.bernoulli(_key(), prob, _shape(shape)).astype(normalize_dtype(dtype))
+    return _wrap(r, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    r = jax.random.gamma(_key(), alpha, _shape(shape), normalize_dtype(dtype)) * beta
+    return _wrap(r, ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
+    r = jax.random.exponential(_key(), _shape(shape), normalize_dtype(dtype)) * scale
+    return _wrap(r, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    r = jax.random.poisson(_key(), lam, _shape(shape)).astype(normalize_dtype(dtype))
+    return _wrap(r, ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None):
+    g = jax.random.gamma(_key(), k, _shape(shape)) * (1 - p) / p
+    r = jax.random.poisson(_key(), g).astype(normalize_dtype(dtype))
+    return _wrap(r, ctx)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """Sample category indices from (batched) probability rows. With
+    get_prob=True also return log-prob of each sample (parity: used for
+    REINFORCE-style estimators)."""
+    n = shape if isinstance(shape, int) else int(np.prod(shape))
+    logp = jnp.log(jnp.clip(data._data, 1e-20, None))
+    if logp.ndim == 1:
+        idx = jax.random.categorical(_key(), logp, shape=(n,))
+        sample_logp = jnp.take(logp, idx)
+        if n == 1:
+            idx, sample_logp = idx[0], sample_logp[0]
+    else:
+        idx = jax.random.categorical(_key(), logp[:, None, :].repeat(n, 1), axis=-1)
+        sample_logp = jnp.take_along_axis(logp, idx, axis=-1)
+        if n == 1:
+            idx, sample_logp = idx[:, 0], sample_logp[:, 0]
+    out = NDArray(idx.astype(normalize_dtype(dtype)))
+    if get_prob:
+        return out, NDArray(sample_logp)
+    return out
+
+
+categorical = multinomial
+
+
+def shuffle(data):
+    perm = jax.random.permutation(_key(), data._data.shape[0])
+    return NDArray(jnp.take(data._data, perm, axis=0))
+
+
+def permutation(n):
+    return NDArray(jax.random.permutation(_key(), int(n)).astype(jnp.int32))
+
+
+def truncated_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
+    r = loc + scale * jax.random.truncated_normal(_key(), -2.0, 2.0, _shape(shape),
+                                                  normalize_dtype(dtype))
+    return _wrap(r, ctx)

@@ -1,0 +1,26 @@
+"""Test config: force the CPU backend with 8 virtual devices so mesh/
+collective tests run without TPU hardware (SURVEY.md §4). Must run before
+jax is imported anywhere."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# sitecustomize may have already pinned an accelerator platform at interpreter
+# startup; override before any backend is materialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import incubator_mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,159 @@
+"""Tape autograd semantics (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_close(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_shared_input():
+    w = nd.array([2.0, 3.0])
+    w.attach_grad()
+    with autograd.record():
+        y = (w * w * w).sum()
+    y.backward()
+    assert_close(w.grad.asnumpy(), 3 * np.array([2.0, 3.0]) ** 2)
+
+
+def test_multi_leaf():
+    a = nd.array([1.0, 2.0]); a.attach_grad()
+    b = nd.array([3.0, 4.0]); b.attach_grad()
+    with autograd.record():
+        y = (a * b + a).sum()
+    y.backward()
+    assert_close(a.grad.asnumpy(), [4, 5])
+    assert_close(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0]); x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_close(x.grad.asnumpy(), [30, 60])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert autograd.is_recording()
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_pause_stops_tape():
+    x = nd.array([1.0]); x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 100  # not recorded
+        w = y * 3
+    w.backward()
+    assert_close(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_function():
+    x = nd.array([3.0]); x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x)
+    assert_close(g.asnumpy(), [6.0])
+    assert x.grad.asnumpy()[0] == 0.0  # .grad untouched by grad()
+
+
+def test_higher_order():
+    x = nd.array([2.0]); x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad(y, x, create_graph=True)  # 3x^2
+        g2 = autograd.grad(g1, x, create_graph=True)  # 6x
+    assert_close(g1.asnumpy(), [12.0])
+    assert_close(g2.asnumpy(), [12.0])
+
+
+def test_detach():
+    x = nd.array([2.0]); x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert_close(x.grad.asnumpy(), [4.0])  # detach blocks the y path
+
+
+def test_grad_req_add():
+    x = nd.array([1.0]); x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            (x * 3).backward()
+    assert_close(x.grad.asnumpy(), [6.0])
+    x.attach_grad()  # reset to write
+    with autograd.record():
+        (x * 3).backward()
+    assert_close(x.grad.asnumpy(), [3.0])
+
+
+def test_grad_through_reshape_indexing():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)); x.attach_grad()
+    with autograd.record():
+        y = x.reshape(3, 2)[1:].sum()
+    y.backward()
+    assert_close(x.grad.asnumpy(), [[0, 0, 1], [1, 1, 1]])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self._saved
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0]); x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    assert_close(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros(1)
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        (x * 5).backward()
+    assert_close(g.asnumpy(), [5.0])
+
+
+def test_backward_through_concat_split():
+    a = nd.ones((2, 2)); a.attach_grad()
+    b = nd.ones((2, 2)); b.attach_grad()
+    with autograd.record():
+        c = nd.concat(a * 2, b * 3, dim=0)
+        p, q = nd.split(c, 2, axis=0)
+        (p.sum() + 2 * q.sum()).backward()
+    assert_close(a.grad.asnumpy(), np.full((2, 2), 2.0))
+    assert_close(b.grad.asnumpy(), np.full((2, 2), 6.0))
