@@ -17,6 +17,12 @@ from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
+from . import ops
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import kvstore
+from . import gluon
 
 from .ndarray import NDArray
 from .ndarray import random as _ndrandom

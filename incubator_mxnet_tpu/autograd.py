@@ -89,10 +89,13 @@ class Node:
     out_avals     : [(shape, dtype)] per output
     """
 
-    __slots__ = ("fn", "input_values", "parents", "leaf_refs", "out_avals", "n_out", "name")
+    __slots__ = ("fn", "fn_vjp", "input_values", "parents", "leaf_refs",
+                 "out_avals", "n_out", "name")
 
-    def __init__(self, fn, input_values, parents, leaf_refs, out_avals, name=None):
+    def __init__(self, fn, input_values, parents, leaf_refs, out_avals,
+                 name=None, fn_vjp=None):
         self.fn = fn
+        self.fn_vjp = fn_vjp  # optional precompiled pullback (CachedOp path)
         self.input_values = input_values
         self.parents = parents
         self.leaf_refs = leaf_refs
@@ -101,14 +104,14 @@ class Node:
         self.name = name
 
 
-def _record_op(fn, nd_inputs, raw_inputs, nd_outputs, name=None):
+def _record_op(fn, nd_inputs, raw_inputs, nd_outputs, name=None, fn_vjp=None):
     """Called by ndarray._apply for every eager op while recording."""
     parents, leaf_refs = [], []
     for x in nd_inputs:
         parents.append(x._node)
         leaf_refs.append(x if x._grad_req is not None else None)
     out_avals = [(tuple(o._data.shape), o._data.dtype) for o in nd_outputs]
-    node = Node(fn, tuple(raw_inputs), parents, leaf_refs, out_avals, name)
+    node = Node(fn, tuple(raw_inputs), parents, leaf_refs, out_avals, name, fn_vjp)
     for i, o in enumerate(nd_outputs):
         o._node = (node, i)
     return node
@@ -271,7 +274,7 @@ def _grad_impl(heads, head_grads, variables, create_graph):
                 if not isinstance(in_cots, (list, tuple)):
                     in_cots = (in_cots,)
             else:
-                vjp = _make_vjp_fn(node.fn, n_in, node.n_out == 1)
+                vjp = node.fn_vjp or _make_vjp_fn(node.fn, n_in, node.n_out == 1)
                 in_shells = []
                 for i in range(n_in):
                     leaf = node.leaf_refs[i]

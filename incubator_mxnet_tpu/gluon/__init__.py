@@ -1,0 +1,8 @@
+"""gluon: the imperative/hybrid high-level API (parity: python/mxnet/gluon)."""
+from . import loss, nn
+from .block import Block, HybridBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+
+__all__ = ["Block", "HybridBlock", "Parameter", "ParameterDict", "Constant",
+           "Trainer", "nn", "loss"]

@@ -1,0 +1,333 @@
+"""Block / HybridBlock (parity: python/mxnet/gluon/block.py).
+
+Block = imperative module tree. HybridBlock adds `hybridize()`: the forward
+is traced ONCE per (input-signature, training-mode) into a single `jax.jit`
+executable — the TPU-native CachedOp. Parameters enter the compiled function
+as arguments (no retrace on update); BatchNorm-style aux state comes back as
+extra outputs and is written back after the call; dropout keys are threaded
+in so compiled randomness differs per step. Under the eager tape, one cached
+call records as ONE node whose vjp re-enters XLA — so loss.backward() on a
+hybridized net runs forward+backward as compiled XLA computations, matching
+the reference's CachedOp forward/backward graph pair.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+
+from .. import autograd
+from ..base import NameManager, camel_to_snake
+from ..ndarray import NDArray, _apply
+from ..ndarray import random as ndrandom
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
+                        _ParamTraceScope, _trace)
+
+__all__ = ["Block", "HybridBlock"]
+
+
+class _NameScope:
+    """Parity shim for `with self.name_scope():` — naming is automatic here."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Block:
+    def __init__(self, prefix=None, params=None):
+        hint = camel_to_snake(type(self).__name__) + "_"
+        self._prefix = NameManager.current().get(prefix, hint)
+        self._params = ParameterDict(self._prefix)
+        if params is not None:
+            self._params.update(params.items() if isinstance(params, ParameterDict)
+                                else params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- registration -----------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    # -- properties -------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_")
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        return _NameScope()
+
+    # -- parameter collection --------------------------------------------
+    def collect_params(self, select=None) -> ParameterDict:
+        out = ParameterDict(self._prefix)
+        out.update({p.name: p for p in self._params.values()})
+        out.update({p.name: p for p in self._reg_params.values()})
+        for child in self._children.values():
+            out.update(child.collect_params().items())
+        if select is not None:
+            import re
+            pat = re.compile(select)
+            filtered = ParameterDict(self._prefix)
+            filtered.update({k: v for k, v in out.items() if pat.search(k)})
+            return filtered
+        return out
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx, verbose=verbose,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already covered via collect_params
+        self._dtype = dtype
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- persistence ------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        self.collect_params().save(filename)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        self.collect_params().load(filename, ctx=ctx, allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra)
+
+    # -- execution --------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self._invoke(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def _invoke(self, *args, **kwargs):
+        try:
+            return self.forward(*args, **kwargs)
+        except DeferredInitializationError:
+            self._deferred_infer(*args, **kwargs)
+            return self.forward(*args, **kwargs)
+
+    def _deferred_infer(self, *args, **kwargs):
+        """Complete deferred shapes: per-layer infer_shape if provided."""
+        self.infer_shape(*args, **kwargs)
+        for p in self.collect_params().values():
+            p.finish_deferred_init()
+
+    def infer_shape(self, *args, **kwargs):
+        """Layers with deferred params override this; containers recurse by
+        just re-running forward (children infer on their own calls)."""
+        raise DeferredInitializationError(
+            f"{type(self).__name__} has uninitialized parameters and no "
+            f"infer_shape; initialize with explicit shapes")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}("]
+        for name, child in self._children.items():
+            lines.append(f"  ({name}): {type(child).__name__}")
+        lines.append(")")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        mods = "\n".join(f"  ({k}): {type(v).__name__}" for k, v in self._children.items())
+        return f"{type(self).__name__}(\n{mods}\n)" if mods else f"{type(self).__name__}()"
+
+
+class _CacheEntry:
+    """One compiled signature: jitted forward + (lazily) jitted pullback —
+    the forward/backward executable pair of the reference's CachedOp."""
+
+    __slots__ = ("raw_fn", "jitted", "_vjp_jit", "n_real", "n_aux",
+                 "aux_params", "out_treedef")
+
+    def __init__(self, raw_fn, jitted, n_real, n_aux, aux_params, out_treedef):
+        self.raw_fn = raw_fn      # (key, *raws) -> flat outputs, UNJITTED
+        self.jitted = jitted      # jax.jit(raw_fn)
+        self._vjp_jit = None
+        self.n_real = n_real
+        self.n_aux = n_aux
+        self.aux_params = aux_params
+        self.out_treedef = out_treedef
+
+    def vjp_jit(self):
+        # jax 0.9 cannot linearize some primitives (reduce_window) through an
+        # inner pjit, so the pullback is built from the UNJITTED fn and jitted
+        # as a whole: one compiled backward executable per signature.
+        if self._vjp_jit is None:
+            raw_fn = self.raw_fn
+
+            def vjp_core(key, n_in_args):
+                primals, cots = n_in_args
+                _, pull = jax.vjp(lambda *p: raw_fn(key, *p), *primals)
+                return pull(tuple(cots))
+
+            self._vjp_jit = jax.jit(vjp_core)
+        return self._vjp_jit
+
+
+def _flatten_out(out):
+    """Forward outputs → (list of NDArray, treedef). Supports NDArray or
+    (possibly nested) tuple/list of NDArrays."""
+    leaves = []
+
+    def walk(o):
+        if isinstance(o, NDArray):
+            leaves.append(o)
+            return ("leaf", len(leaves) - 1)
+        if isinstance(o, (tuple, list)):
+            return ("seq", type(o).__name__, [walk(i) for i in o])
+        raise TypeError(f"hybridized forward must return NDArrays, got {type(o)}")
+
+    tree = walk(out)
+    return leaves, tree
+
+
+def _unflatten_out(tree, leaves):
+    kind = tree[0]
+    if kind == "leaf":
+        return leaves[tree[1]]
+    _, tname, children = tree
+    seq = [_unflatten_out(c, leaves) for c in children]
+    return tuple(seq) if tname == "tuple" else seq
+
+
+class HybridBlock(Block):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cache = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cache = {}
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child.hybridize(active, **kwargs)
+
+    def _invoke(self, *args, **kwargs):
+        if self._active and not _trace.active and not kwargs:
+            if all(isinstance(a, NDArray) for a in args):
+                return self._call_cached(*args)
+        return super()._invoke(*args, **kwargs)
+
+    # -- the TPU CachedOp -------------------------------------------------
+    def _call_cached(self, *args):
+        params = list(self.collect_params().values())
+        try:
+            param_nds = [p.data() for p in params]
+        except DeferredInitializationError:
+            with autograd.pause(False):  # one shape-inference pass, no aux drift
+                super()._invoke(*args)
+            params = list(self.collect_params().values())
+            param_nds = [p.data() for p in params]
+
+        training = autograd.is_training()
+        sig = (tuple((tuple(a.shape), str(a._data.dtype)) for a in args), training)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build_cache(params, args, training)
+            self._cache[sig] = entry
+
+        key_raw = ndrandom._key()
+        n_total = entry.n_real + entry.n_aux
+        n_in = len(params) + len(args)
+
+        def node_fn(*raws):  # unjitted: stays on the tape for any re-derivation
+            flat = entry.raw_fn(key_raw, *raws)
+            return flat[0] if n_total == 1 else tuple(flat)
+
+        def fwd_fn(*raws):  # compiled forward executable
+            flat = entry.jitted(key_raw, *raws)
+            return flat[0] if n_total == 1 else tuple(flat)
+
+        def vjp_fn(*raws_and_cots):  # compiled backward executable
+            primals = tuple(raws_and_cots[:n_in])
+            cots = tuple(raws_and_cots[n_in:])
+            in_cots = entry.vjp_jit()(key_raw, (primals, cots))
+            return in_cots[0] if n_in == 1 else tuple(in_cots)
+
+        outs = _apply(node_fn, param_nds + list(args), n_out=n_total,
+                      name=self.name + "_cachedop", fn_fwd=fwd_fn, fn_vjp=vjp_fn)
+        if n_total == 1:
+            outs = (outs,)
+        real, aux = outs[:entry.n_real], outs[entry.n_real:]
+        for p, new in zip(entry.aux_params, aux):
+            p._data._data = new._data  # write back outside the tape
+        return _unflatten_out(entry.out_treedef, list(real))
+
+    def _build_cache(self, params, args, training):
+        sub_ids = [id(p) for p in params]
+        n_p = len(params)
+        out_info = {}
+
+        def raw_fn(key_raw, *raws):
+            p_raws, a_raws = raws[:n_p], raws[n_p:]
+            sub = dict(zip(sub_ids, p_raws))
+            with _ParamTraceScope(sub), autograd._Scope(False, training), \
+                    ndrandom._TraceKeyScope(key_raw):
+                nd_args = [NDArray(r) for r in a_raws]
+                out = self.forward(*nd_args)
+                leaves, tree = _flatten_out(out)
+                aux_items = [(_trace.params_seen[i], raw)
+                             for i, raw in _trace.aux_updates.items()]
+            out_info["tree"] = tree
+            out_info["aux_params"] = [p for p, _ in aux_items]
+            return tuple(x._data for x in leaves) + tuple(raw for _, raw in aux_items)
+
+        jitted = jax.jit(raw_fn)
+        # Abstract trace once to learn output structure (no device work).
+        p_raws = [p.data()._data for p in params]
+        dummy_key = jax.random.PRNGKey(0)
+        shapes = jax.eval_shape(raw_fn, dummy_key, *p_raws,
+                                *[a._data for a in args])
+        n_aux = len(out_info["aux_params"])
+        n_real = len(shapes) - n_aux
+        return _CacheEntry(raw_fn, jitted, n_real, n_aux,
+                           out_info["aux_params"], out_info["tree"])
+
+    def export(self, path, epoch=0):
+        """Parity: HybridBlock.export — here saves params (graph is re-derived
+        from code; the compiled artifact lives in XLA's compilation cache)."""
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,529 @@
+"""gluon.nn layers (parity: python/mxnet/gluon/nn/{basic_layers,conv_layers}.py).
+
+Every layer is a HybridBlock whose forward runs through the recordable op
+funnel, so the same code serves eager, taped, and jit-compiled execution.
+Conv/pool accept `layout=` with NCHW default (API parity) — pass NHWC for the
+TPU-preferred channels-last path (model zoo does this on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import autograd  # noqa: F401 (re-export convenience)
+from ...ndarray import NDArray, _apply
+from ... import ndarray as nd
+from ... import ops
+from ...ops import _raw
+from ..block import Block, HybridBlock
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
+           "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
+           "SiLU", "Embedding", "BatchNorm", "LayerNorm", "InstanceNorm",
+           "GroupNorm", "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+           "Conv2DTranspose", "Conv3DTranspose", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
+           "Lambda", "HybridLambda", "Identity", "Concatenate"]
+
+
+def _pair(x, n):
+    if isinstance(x, (tuple, list)):
+        assert len(x) == n
+        return tuple(int(v) for v in x)
+    return (int(x),) * n
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+class Sequential(Block):
+    def __init__(self, *blocks, prefix=None, params=None):
+        super().__init__(prefix, params)
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, idx):
+        vals = list(self._children.values())
+        if isinstance(idx, slice):
+            out = type(self)()
+            out.add(*vals[idx])
+            return out
+        return vals[idx]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self, *blocks, prefix=None, params=None):
+        HybridBlock.__init__(self, prefix, params)
+        for b in blocks:
+            self.add(b)
+
+
+# ---------------------------------------------------------------------------
+# basic layers
+# ---------------------------------------------------------------------------
+
+class Dense(HybridBlock):
+    """FullyConnected layer; weight (units, in_units) like the reference."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._units = units
+        self._flatten = flatten
+        self.act = activation
+        self.weight = self.params.get("weight", shape=(units, in_units),
+                                      dtype=dtype, init=weight_initializer)
+        self.bias = (self.params.get("bias", shape=(units,), dtype=dtype,
+                                     init=bias_initializer) if use_bias else None)
+
+    def infer_shape(self, x):
+        in_units = int(np.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+
+    def forward(self, x):
+        out = ops.FullyConnected(x, self.weight.data(),
+                                 None if self.bias is None else self.bias.data(),
+                                 flatten=self._flatten)
+        if self.act:
+            out = ops.Activation(out, self.act)
+        return out
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._act = activation
+
+    def forward(self, x):
+        return ops.Activation(x, self._act)
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return ops.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.flatten()
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix)
+        self._fn = function if callable(function) else getattr(nd, function)
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class HybridLambda(HybridBlock, Lambda):
+    def __init__(self, function, prefix=None):
+        HybridBlock.__init__(self, prefix)
+        self._fn = function if callable(function) else getattr(nd, function)
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class Concatenate(HybridSequential):
+    """Run children on the same input, concat outputs along `axis`."""
+
+    def __init__(self, axis=-1, prefix=None):
+        super().__init__(prefix=prefix)
+        self._axis = axis
+
+    def forward(self, x):
+        return nd.concat(*[child(x) for child in self._children.values()],
+                         dim=self._axis)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.leaky_relu(x, self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, prefix=None, params=None):
+        super().__init__(prefix, params)
+        from ... import initializer as init_mod
+        self.alpha = self.params.get(
+            "alpha", shape=(in_channels,),
+            init=alpha_initializer or init_mod.Constant(0.25))
+
+    def forward(self, x):
+        a = self.alpha.data()
+        return _apply(lambda xr, ar: jnp.where(xr >= 0, xr, ar * xr),
+                      [x, a], name="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.elu(x, self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return nd.selu(x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._approx = approximation != "erf"
+
+    def forward(self, x):
+        return nd.gelu(x, approximate=self._approx)
+
+
+class Swish(HybridBlock):
+    def forward(self, x):
+        return nd.silu(x)
+
+
+SiLU = Swish
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                      dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return nd.embedding(x, self.weight.data())
+
+
+# ---------------------------------------------------------------------------
+# normalization layers
+# ---------------------------------------------------------------------------
+
+class BatchNorm(HybridBlock):
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    grad_req="write" if center else "null")
+        self.running_mean = self.params.get("running_mean", shape=(in_channels,),
+                                            init=running_mean_initializer,
+                                            grad_req="null")
+        self.running_var = self.params.get("running_var", shape=(in_channels,),
+                                           init=running_variance_initializer,
+                                           grad_req="null")
+
+    def infer_shape(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        training = autograd.is_training() and not self._use_global_stats
+        axis, eps, mom = self._axis, self._eps, self._momentum
+        fix_gamma = not self._scale
+
+        def f(xr, gr, br, mmr, mvr):
+            return _raw.batch_norm(xr, gr, br, mmr, mvr, axis=axis, eps=eps,
+                                   momentum=mom, training=training,
+                                   use_global_stats=self._use_global_stats,
+                                   fix_gamma=fix_gamma)
+
+        y, nm, nv = _apply(f, [x, self.gamma.data(), self.beta.data(),
+                               self.running_mean.data(), self.running_var.data()],
+                           n_out=3, name="BatchNorm")
+        if training:
+            self.running_mean.update_aux(nm._data)
+            self.running_var.update_aux(nv._data)
+        return y
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    grad_req="write" if center else "null")
+
+    def infer_shape(self, x):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return ops.LayerNorm(x, self.gamma.data(), self.beta.data(),
+                             axis=self._axis, eps=self._eps)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    grad_req="write" if center else "null")
+
+    def infer_shape(self, x):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def forward(self, x):
+        return ops.InstanceNorm(x, self.gamma.data(), self.beta.data(), eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._ng = num_groups
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    grad_req="write" if center else "null")
+
+    def infer_shape(self, x):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def forward(self, x):
+        return ops.GroupNorm(x, self.gamma.data(), self.beta.data(),
+                             num_groups=self._ng, eps=self._eps)
+
+
+# ---------------------------------------------------------------------------
+# convolution layers
+# ---------------------------------------------------------------------------
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op=ops.Convolution, adj=None, prefix=None, params=None):
+        super().__init__(prefix, params)
+        nsp = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = _pair(strides, nsp)
+        self._pad = _pair(padding, nsp)
+        self._dilate = _pair(dilation, nsp)
+        self._groups = groups
+        self._layout = layout
+        self._op = op
+        self._adj = adj
+        self.act = activation
+        self.weight = self.params.get("weight",
+                                      shape=self._weight_shape(in_channels),
+                                      init=weight_initializer)
+        self.bias = (self.params.get("bias", shape=(channels,),
+                                     init=bias_initializer) if use_bias else None)
+
+    def _weight_shape(self, in_channels):
+        k = tuple(self._kernel)
+        if self._op is ops.Deconvolution:
+            if self._layout.startswith("NC"):
+                return (in_channels, self._channels // self._groups) + k
+            return k + (self._channels // self._groups, in_channels)
+        if self._layout.startswith("NC"):
+            return (self._channels, in_channels // self._groups if in_channels else 0) + k
+        return k + (in_channels // self._groups if in_channels else 0, self._channels)
+
+    def infer_shape(self, x):
+        c_axis = 1 if self._layout.startswith("NC") else x.ndim - 1
+        self._in_channels = x.shape[c_axis]
+        self.weight.shape = self._weight_shape(self._in_channels)
+
+    def forward(self, x):
+        kw = dict(kernel=self._kernel, stride=self._stride, pad=self._pad,
+                  dilate=self._dilate, num_group=self._groups,
+                  layout=self._layout)
+        if self._op is ops.Deconvolution:
+            kw.pop("kernel")
+            kw["adj"] = self._adj
+        out = self._op(x, self.weight.data(),
+                       None if self.bias is None else self.bias.data(), **kw)
+        if self.act:
+            out = ops.Activation(out, self.act)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCHW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCDHW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, op=ops.Deconvolution,
+                         adj=_pair(output_padding, 1), **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCHW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, op=ops.Deconvolution,
+                         adj=_pair(output_padding, 2), **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCDHW", **kwargs):
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, op=ops.Deconvolution,
+                         adj=_pair(output_padding, 3), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# pooling layers
+# ---------------------------------------------------------------------------
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_type, pool_size, strides, padding, global_pool,
+                 layout, count_include_pad=True, ceil_mode=False,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._type = pool_type
+        self._kernel = pool_size
+        self._stride = strides
+        self._pad = padding
+        self._global = global_pool
+        self._layout = layout
+        self._cip = count_include_pad
+        self._ceil = ceil_mode
+
+    def forward(self, x):
+        return ops.Pooling(x, pool_type=self._type, kernel=self._kernel,
+                           stride=self._stride, pad=self._pad,
+                           global_pool=self._global,
+                           count_include_pad=self._cip, layout=self._layout,
+                           ceil_mode=self._ceil)
+
+
+def _mkpool(name, ptype, ndim, global_pool):
+    default_layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[ndim]
+
+    class P(_Pool):
+        def __init__(self, pool_size=2, strides=None, padding=0,
+                     layout=default_layout, count_include_pad=True,
+                     ceil_mode=False, prefix=None, params=None):
+            ks = _pair(pool_size, ndim)
+            st = None if strides is None else _pair(strides, ndim)
+            pd = _pair(padding, ndim)
+            super().__init__(ptype, ks, st, pd, global_pool, layout,
+                             count_include_pad, ceil_mode, prefix, params)
+
+    P.__name__ = P.__qualname__ = name
+    return P
+
+
+MaxPool1D = _mkpool("MaxPool1D", "max", 1, False)
+MaxPool2D = _mkpool("MaxPool2D", "max", 2, False)
+MaxPool3D = _mkpool("MaxPool3D", "max", 3, False)
+AvgPool1D = _mkpool("AvgPool1D", "avg", 1, False)
+AvgPool2D = _mkpool("AvgPool2D", "avg", 2, False)
+AvgPool3D = _mkpool("AvgPool3D", "avg", 3, False)
+GlobalMaxPool1D = _mkpool("GlobalMaxPool1D", "max", 1, True)
+GlobalMaxPool2D = _mkpool("GlobalMaxPool2D", "max", 2, True)
+GlobalMaxPool3D = _mkpool("GlobalMaxPool3D", "max", 3, True)
+GlobalAvgPool1D = _mkpool("GlobalAvgPool1D", "avg", 1, True)
+GlobalAvgPool2D = _mkpool("GlobalAvgPool2D", "avg", 2, True)
+GlobalAvgPool3D = _mkpool("GlobalAvgPool3D", "avg", 3, True)

@@ -1,0 +1,110 @@
+"""gluon.Trainer (parity: python/mxnet/gluon/trainer.py).
+
+step() = allreduce_grads() (kvstore) + update() (optimizer), as in the
+reference. Each parameter's update is one jitted XLA kernel; the fully-fused
+single-computation train step (forward+backward+psum+update in one jit) lives
+in parallel/ and is what bench/dryrun use.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+from .. import kvstore as kvs_mod
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+        self._kvstore = None
+        if kvstore is not None:
+            self._kvstore = (kvstore if isinstance(kvstore, kvs_mod.KVStore)
+                             else kvs_mod.create(kvstore))
+        self._scale = 1.0
+
+    # -- properties -------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- core -------------------------------------------------------------
+    def _init_state(self, i, p):
+        if not self._states_created[i]:
+            self._states[i] = self._optimizer.create_state_multi_precision(
+                i, p.data()._data)
+            self._states_created[i] = True
+
+    def allreduce_grads(self):
+        """Aggregate gradients across devices/workers. Single-chip: no-op.
+        The mesh path does this inside the compiled step via psum."""
+        if self._kvstore is not None and self._kvstore.num_workers > 1:
+            for i, p in enumerate(self._params):
+                g = p.grad()
+                key = f"grad{i}"
+                self._kvstore.pushpull(key, g, out=g)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update()
+
+    def _update(self):
+        for i, p in enumerate(self._params):
+            self._init_state(i, p)
+            w = p.data()
+            g = p.grad()
+            self._states[i] = self._optimizer.update(i, w, g, self._states[i])
+
+    # -- persistence ------------------------------------------------------
+    def save_states(self, fname):
+        blob = {
+            "num_update": self._optimizer.num_update,
+            "index_update_count": dict(self._optimizer._index_update_count),
+            "states": [jax.tree_util.tree_map(lambda a: np.asarray(a), s)
+                       for s in self._states],
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+
+    def load_states(self, fname):
+        import jax.numpy as jnp
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer._index_update_count = dict(blob.get("index_update_count", {}))
+        self._states = [jax.tree_util.tree_map(jnp.asarray, s)
+                        for s in blob["states"]]
+        self._states_created = [s is not None for s in self._states]

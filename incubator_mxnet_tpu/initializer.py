@@ -1,0 +1,175 @@
+"""Weight initializers (parity: python/mxnet/initializer.py).
+
+Each initializer produces a raw jax array for a (shape, dtype) given a PRNG
+key — pure, so deferred initialization can run inside or outside jit. The
+string registry mirrors mx.init.* names (`initializer.create("xavier")`).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import _Registry, normalize_dtype
+
+registry = _Registry("initializer")
+register = registry.register
+create = registry.create
+
+
+class Initializer:
+    """Base class. Subclasses implement _init(key, shape, dtype)."""
+
+    def __call__(self, key, shape, dtype="float32"):
+        return self._init(key, tuple(shape), normalize_dtype(dtype))
+
+    def _init(self, key, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+@register("zeros")
+@register("zero")
+class Zero(Initializer):
+    def _init(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+@register("one")
+class One(Initializer):
+    def _init(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@register()
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register()
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def _init(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, -self.scale, self.scale)
+
+
+@register()
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def _init(self, key, shape, dtype):
+        return self.sigma * jax.random.normal(key, shape, dtype)
+
+
+def _fans(shape, factor_type):
+    # Convention (matches reference mxnet Xavier): shape[0]=out, shape[1:]=in
+    hw = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return float(fan_in)
+    if factor_type == "out":
+        return float(fan_out)
+    raise ValueError(f"bad factor_type {factor_type}")
+
+
+@register()
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init(self, key, shape, dtype):
+        factor = _fans(shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            return jax.random.uniform(key, shape, dtype, -scale, scale)
+        if self.rnd_type == "gaussian":
+            return scale * jax.random.normal(key, shape, dtype)
+        raise ValueError(f"bad rnd_type {self.rnd_type}")
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+
+
+@register()
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init(self, key, shape, dtype):
+        nout = shape[0]
+        nin = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+@register()
+class Bilinear(Initializer):
+    """Upsampling deconv weights (parity: mx.init.Bilinear)."""
+
+    def _init(self, key, shape, dtype):
+        weight = np.zeros(shape, dtype=np.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (gate order i, f, g, o)."""
+
+    def __init__(self, forget_bias=1.0):
+        self.forget_bias = forget_bias
+
+    def _init(self, key, shape, dtype):
+        b = jnp.zeros(shape, dtype)
+        n = shape[0] // 4
+        return b.at[n:2 * n].set(self.forget_bias)
+
+
+@register()
+class Mixed(Initializer):
+    """Pattern-matched initializer selection by parameter name."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = [(re.compile(p), init) for p, init in zip(patterns, initializers)]
+
+    def init_for(self, name):
+        for pat, init in self.map:
+            if pat.search(name):
+                return init
+        raise ValueError(f"no initializer pattern matches {name!r}")
+
+    def _init(self, key, shape, dtype):
+        raise RuntimeError("Mixed must be resolved per-parameter via init_for()")

@@ -33,15 +33,21 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "save", "load", "waitall"]
 
 
-def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None):
+def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None,
+           fn_fwd=None, fn_vjp=None):
     """Run a pure jax function on NDArray inputs; record on the tape if
-    autograd is recording. The single funnel for all eager ops."""
+    autograd is recording. The single funnel for all eager ops.
+
+    fn_fwd: optional compiled variant used for execution (fn stays on the
+    tape for differentiation); fn_vjp: optional precompiled pullback
+    (primals..., out_cots...) -> input cots (HybridBlock CachedOp path).
+    """
     raws = [x._data for x in inputs]
-    outs = fn(*raws)
+    outs = (fn_fwd or fn)(*raws)
     outs_t = (outs,) if n_out == 1 else tuple(outs)
     results = [NDArray(o) for o in outs_t]
     if autograd.is_recording():
-        autograd._record_op(fn, inputs, raws, results, name)
+        autograd._record_op(fn, inputs, raws, results, name, fn_vjp=fn_vjp)
     return results[0] if n_out == 1 else tuple(results)
 
 

@@ -23,8 +23,38 @@ from . import NDArray
 _lock = threading.Lock()
 _global_key = None
 
+# While tracing a hybridized block, randomness must derive from a traced key
+# (a concrete key would bake one dropout mask into the compiled executable).
+# HybridBlock pushes the per-call key here; _key() then splits it functionally.
+# Thread-local: another thread's eager sampling must not see this trace's key.
+_trace_keys = threading.local()
+
+
+def _tk_stack():
+    if not hasattr(_trace_keys, "stack"):
+        _trace_keys.stack = []
+    return _trace_keys.stack
+
+
+class _TraceKeyScope:
+    def __init__(self, raw_key):
+        self._raw = raw_key
+
+    def __enter__(self):
+        _tk_stack().append(self._raw)
+        return self
+
+    def __exit__(self, *exc):
+        _tk_stack().pop()
+        return False
+
 
 def _key():
+    stack = _tk_stack()
+    if stack:
+        nxt, sub = jax.random.split(stack[-1])
+        stack[-1] = nxt
+        return sub
     global _global_key
     with _lock:
         if _global_key is None:

@@ -1,0 +1,164 @@
+"""NDArray-level operator namespace (parity: mx.nd.Convolution etc.).
+
+Thin recordable wrappers over ops/_raw.py. Gluon layers call these in eager
+mode; under hybridize the same code runs with tracers and compiles into one
+XLA computation. `from incubator_mxnet_tpu import ops` or use the mirrored
+names on `mx.nd`.
+"""
+from __future__ import annotations
+
+from .. import autograd
+from ..ndarray import NDArray, _apply, _as_nd
+from ..ndarray import random as ndrandom
+from . import _raw
+
+__all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
+           "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Activation",
+           "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
+           "UpSampling", "multihead_attention"]
+
+
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True):
+    if no_bias or bias is None:
+        return _apply(lambda x, w: _raw.dense(x, w, None, flatten),
+                      [data, weight], name="FullyConnected")
+    return _apply(lambda x, w, b: _raw.dense(x, w, b, flatten),
+                  [data, weight, bias], name="FullyConnected")
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
+                dilate=None, num_filter=None, num_group=1, no_bias=False,
+                layout="NCHW"):
+    kw = dict(kernel=kernel, stride=stride, pad=pad, dilate=dilate,
+              num_group=num_group, layout=layout)
+    if no_bias or bias is None:
+        return _apply(lambda x, w: _raw.conv(x, w, None, **kw),
+                      [data, weight], name="Convolution")
+    return _apply(lambda x, w, b: _raw.conv(x, w, b, **kw),
+                  [data, weight, bias], name="Convolution")
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
+                  dilate=None, adj=None, num_filter=None, num_group=1,
+                  no_bias=False, layout="NCHW"):
+    kw = dict(stride=stride, pad=pad, dilate=dilate, adj=adj,
+              num_group=num_group, layout=layout)
+    if no_bias or bias is None:
+        return _apply(lambda x, w: _raw.conv_transpose(x, w, None, **kw),
+                      [data, weight], name="Deconvolution")
+    return _apply(lambda x, w, b: _raw.conv_transpose(x, w, b, **kw),
+                  [data, weight, bias], name="Deconvolution")
+
+
+def Pooling(data, pool_type="max", kernel=(2, 2), stride=None, pad=None,
+            global_pool=False, count_include_pad=True, layout="NCHW",
+            ceil_mode=False):
+    return _apply(lambda x: _raw.pooling(x, pool_type, kernel, stride, pad,
+                                         global_pool, count_include_pad, layout,
+                                         ceil_mode),
+                  [data], name="Pooling")
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              output_mean_var=False):
+    """Eager BatchNorm. In training mode (autograd.is_training) uses batch
+    stats and updates moving_mean/var NDArrays in place (outside the tape),
+    like the reference's in-place aux update. Single pass: y and new moving
+    stats come from one recorded op."""
+    training = autograd.is_training()
+
+    def fwd(x, g, b, mm, mv):
+        return _raw.batch_norm(x, g, b, mm, mv, axis=axis, eps=eps,
+                               momentum=momentum, training=training,
+                               use_global_stats=use_global_stats,
+                               fix_gamma=fix_gamma)
+
+    out, nm, nv = _apply(fwd, [data, gamma, beta, moving_mean, moving_var],
+                         n_out=3, name="BatchNorm")
+    if training and not use_global_stats:
+        moving_mean._data = nm._data
+        moving_var._data = nv._data
+    return out
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _apply(lambda x, g, b: _raw.layer_norm(x, g, b, axis, eps),
+                  [data, gamma, beta], name="LayerNorm")
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-5):
+    return _apply(lambda x, g, b: _raw.instance_norm(x, g, b, eps),
+                  [data, gamma, beta], name="InstanceNorm")
+
+
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5):
+    return _apply(lambda x, g, b: _raw.group_norm(x, g, b, num_groups, eps),
+                  [data, gamma, beta], name="GroupNorm")
+
+
+def Activation(data, act_type="relu"):
+    return _apply(lambda x: _raw.activation(x, act_type), [data], name="Activation")
+
+
+def Dropout(data, p=0.5, mode="training", axes=()):
+    training = autograd.is_training() or mode == "always"
+    if not training or p == 0.0:
+        return data
+    key = ndrandom._key()
+    return _apply(lambda x: _raw.dropout(x, key, p, True, axes), [data],
+                  name="Dropout")
+
+
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    return _apply(lambda x: _raw.l2_normalization(x, eps, mode), [data],
+                  name="L2Normalization")
+
+
+def softmax_cross_entropy(data, label, axis=-1, sparse_label=True):
+    label = _as_nd(label)
+    return _apply(lambda x, l: _raw.softmax_cross_entropy(x, l, axis, sparse_label),
+                  [data, label], name="softmax_cross_entropy")
+
+
+def smooth_l1(data, scalar=1.0):
+    return _apply(lambda x: _raw.smooth_l1(x, scalar), [data], name="smooth_l1")
+
+
+def UpSampling(data, scale=2, sample_type="nearest", layout="NCHW"):
+    import jax.numpy as jnp
+
+    def f(x):
+        if layout == "NCHW":
+            r = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        else:
+            r = jnp.repeat(jnp.repeat(x, scale, axis=1), scale, axis=2)
+        return r
+    if sample_type != "nearest":
+        raise NotImplementedError("bilinear UpSampling: use Deconvolution with "
+                                  "Bilinear init (parity with reference usage)")
+    return _apply(f, [data], name="UpSampling")
+
+
+def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0):
+    training = autograd.is_training()
+    key = ndrandom._key() if (dropout_rate > 0.0 and training) else None
+    inputs = [q, k, v] + ([mask] if mask is not None else [])
+
+    def f(qq, kk, vv, *rest):
+        m = rest[0] if rest else None
+        return _raw.multihead_attention(qq, kk, vv, num_heads, m, dropout_rate,
+                                        key, training)
+    return _apply(f, inputs, name="multihead_attention")
+
+
+# Mirror the op namespace onto mx.nd for reference-style calls.
+def _mirror_into_nd():
+    import sys
+    nd_mod = sys.modules["incubator_mxnet_tpu.ndarray"]
+    for name in __all__:
+        setattr(nd_mod, name, globals()[name])
+
+
+_mirror_into_nd()

@@ -1,0 +1,372 @@
+"""Pure-jax NN kernels (reference parity: src/operator/nn/*).
+
+These are the XLA-native replacements for the reference's mshadow/cuDNN
+kernels: conv/pool lower to lax convolution/reduce_window (MXU/VPU on TPU),
+norms are fused elementwise chains XLA consolidates into single kernels.
+All functions are pure (state in, state out) so they compose with jit/grad/
+shard_map. Layouts: MXNet's default NCHW is supported everywhere, NHWC is
+offered because it is the faster layout on TPU (channels-last feeds the MXU
+without relayout); model zoo defaults to NHWC on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# dense / linear
+# ---------------------------------------------------------------------------
+
+def dense(x, weight, bias=None, flatten=True):
+    """FullyConnected (reference src/operator/nn/fully_connected.cc):
+    weight layout (out_units, in_units); flatten=True collapses trailing dims."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+def _conv_dn(ndim, layout):
+    if layout == "NCHW" or (layout is None and ndim == 4):
+        return ("NCHW", "OIHW", "NCHW")
+    if layout == "NHWC":
+        return ("NHWC", "HWIO", "NHWC")
+    if layout == "NCW" or (layout is None and ndim == 3):
+        return ("NCH", "OIH", "NCH")  # 1D as H
+    if layout == "NWC":
+        return ("NHC", "HIO", "NHC")
+    if layout == "NCDHW" or (layout is None and ndim == 5):
+        return ("NCDHW", "OIDHW", "NCDHW")
+    if layout == "NDHWC":
+        return ("NDHWC", "DHWIO", "NDHWC")
+    raise ValueError(f"unsupported conv layout {layout}")
+
+
+def conv(x, weight, bias=None, kernel=None, stride=None, pad=None, dilate=None,
+         num_group=1, layout="NCHW"):
+    """Convolution (reference src/operator/nn/convolution.cc). `weight` is
+    OIHW-ordered for NCHW (out, in/group, *k); HWIO for NHWC."""
+    nsp = x.ndim - 2
+    stride = stride or (1,) * nsp
+    pad = pad or (0,) * nsp
+    dilate = dilate or (1,) * nsp
+    dn = _conv_dn(x.ndim, layout)
+    y = lax.conv_general_dilated(
+        x, weight,
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if bias is not None:
+        if layout.endswith("C") and layout[0] == "N" and "C" != layout[1]:
+            y = y + bias  # channels-last broadcasts directly
+        else:
+            y = y + bias.reshape((1, -1) + (1,) * nsp)
+    return y
+
+
+def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
+                   adj=None, num_group=1, layout="NCHW"):
+    """Deconvolution (reference src/operator/nn/deconvolution.cc): gradient of
+    conv w.r.t. input, implemented as lax.conv_transpose with IOHW weights."""
+    nsp = x.ndim - 2
+    stride = tuple(stride or (1,) * nsp)
+    pad = tuple(pad or (0,) * nsp)
+    dilate = tuple(dilate or (1,) * nsp)
+    adj = tuple(adj or (0,) * nsp)
+    if layout == "NCHW":
+        dn = ("NCHW", "IOHW", "NCHW")
+        kshape = weight.shape[2:]
+    elif layout == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        kshape = weight.shape[:-2]
+    else:
+        raise ValueError(f"unsupported deconv layout {layout}")
+    # MXNet output size: (in-1)*s - 2p + dilate*(k-1) + 1 + adj
+    pads = []
+    for i in range(nsp):
+        k_eff = dilate[i] * (kshape[i] - 1) + 1
+        lo = k_eff - 1 - pad[i]
+        hi = k_eff - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    if num_group != 1:
+        xs = jnp.split(x, num_group, axis=1 if layout == "NCHW" else -1)
+        ws = jnp.split(weight, num_group, axis=0 if layout == "NCHW" else -2)
+        ys = [lax.conv_transpose(xi, wi, stride, pads, rhs_dilation=dilate,
+                                 dimension_numbers=dn)
+              for xi, wi in zip(xs, ws)]
+        y = jnp.concatenate(ys, axis=1 if layout == "NCHW" else -1)
+    else:
+        y = lax.conv_transpose(x, weight, stride, pads, rhs_dilation=dilate,
+                               dimension_numbers=dn)
+    if bias is not None:
+        y = y + (bias if layout == "NHWC" else bias.reshape((1, -1) + (1,) * nsp))
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _maxpool_ncs(x, kernel, stride, pad, hi_extra=None):
+    """Max pool on (N, C, *spatial) via dilated patches (jit-differentiable)."""
+    import numpy as _np
+    nsp = x.ndim - 2
+    hi_extra = hi_extra or [0] * nsp
+    if any(pad) or any(hi_extra):
+        neg = jnp.asarray(jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.iinfo(x.dtype).min, x.dtype)
+        pw = [(0, 0), (0, 0)] + [(p, p + h) for p, h in zip(pad, hi_extra)]
+        x = jnp.pad(x, pw, constant_values=neg)
+    patches = lax.conv_general_dilated_patches(x, tuple(kernel), tuple(stride), "VALID")
+    c = x.shape[1]
+    k = int(_np.prod(kernel))
+    out_sp = patches.shape[2:]
+    return patches.reshape((x.shape[0], c, k) + out_sp).max(axis=2)
+
+
+def pooling(x, pool_type="max", kernel=(2, 2), stride=None, pad=None,
+            global_pool=False, count_include_pad=True, layout="NCHW",
+            ceil_mode=False):
+    """Pooling (reference src/operator/nn/pooling.cc) via lax.reduce_window."""
+    nsp = x.ndim - 2
+    channels_last = layout.endswith("C") and len(layout) == x.ndim and layout[1] != "C"
+    sp_axes = tuple(range(1, 1 + nsp)) if channels_last else tuple(range(2, 2 + nsp))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(x, axis=sp_axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(x, axis=sp_axes, keepdims=True)
+            if pool_type == "avg":
+                cnt = 1
+                for a in sp_axes:
+                    cnt *= x.shape[a]
+                r = r / cnt
+            return r
+        raise ValueError(pool_type)
+    stride = tuple(stride or kernel)
+    pad = tuple(pad or (0,) * nsp)
+    # ceil_mode: extend the high-side padding so the last partial window is
+    # kept (MXNet ceil((in + 2p - k)/s) + 1 output size).
+    hi_extra = [0] * nsp
+    if ceil_mode:
+        for i, a in enumerate(sp_axes):
+            size = x.shape[a] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            if rem:
+                hi_extra[i] = stride[i] - rem
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    pads = [(0, 0)] * x.ndim
+    for i, a in enumerate(sp_axes):
+        window[a] = kernel[i]
+        strides[a] = stride[i]
+        pads[a] = (pad[i], pad[i] + hi_extra[i])
+    if pool_type == "max":
+        # Patch-extraction + max: reduce_window(max) has no linearization
+        # rule under jit in this jax, and patches feed the same XLA fusion.
+        if channels_last:
+            perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+            xc = jnp.transpose(x, perm)
+            y = _maxpool_ncs(xc, kernel, stride, pad, hi_extra)
+            back = (0,) + tuple(range(2, x.ndim)) + (1,)
+            return jnp.transpose(y, back)
+        return _maxpool_ncs(x, kernel, stride, pad, hi_extra)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                              window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            cnt = 1
+            for i in range(nsp):
+                cnt *= kernel[i]
+            return s / cnt
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+                                window, strides, pads)
+        return s / cnt
+    raise ValueError(f"unsupported pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
+               momentum=0.9, training=True, use_global_stats=False,
+               fix_gamma=False):
+    """BatchNorm (reference src/operator/nn/batch_norm.cc). Returns
+    (y, new_moving_mean, new_moving_var); caller threads state."""
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    red = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        new_mm = momentum * moving_mean + (1 - momentum) * mean
+        new_mv = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    y = (x - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape)
+    y = y * gamma.reshape(bshape).astype(x.dtype) + beta.reshape(bshape).astype(x.dtype)
+    return y, new_mm, new_mv
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """LayerNorm (reference src/operator/nn/layer_norm.cc). Stats in f32 for
+    bf16 stability, one fused XLA chain."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    y = y * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """InstanceNorm: normalize over spatial dims per (N, C)."""
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def group_norm(x, gamma, beta, num_groups, eps=1e-5):
+    """GroupNorm over channel groups (NCHW)."""
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    """L2Normalization (reference src/operator/l2_normalization.cc)."""
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    elif mode == "spatial":
+        red = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    else:
+        raise ValueError(mode)
+    return x / n
+
+
+# ---------------------------------------------------------------------------
+# regularization / activations
+# ---------------------------------------------------------------------------
+
+def dropout(x, key, rate=0.5, training=True, axes=()):
+    """Dropout; `axes` = broadcast axes (one shared mask along them, parity
+    with mx.nd.Dropout axes= for spatial/channel dropout)."""
+    if not training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mshape = list(x.shape)
+    for a in axes:
+        mshape[a] = 1
+    mask = jax.random.bernoulli(key, keep, tuple(mshape))
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "gelu": jax.nn.gelu,
+    "gelu_tanh": lambda a: jax.nn.gelu(a, approximate=True),
+    "erf_gelu": lambda a: jax.nn.gelu(a, approximate=False),
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "mish": lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+    "relu6": lambda a: jnp.clip(a, 0, 6),
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "hard_swish": jax.nn.hard_swish,
+    "leaky": lambda a: jax.nn.leaky_relu(a, 0.25),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "log_softmax": jax.nn.log_softmax,
+    "softmax": jax.nn.softmax,
+}
+
+
+def activation(x, act_type):
+    try:
+        return _ACTIVATIONS[act_type](x)
+    except KeyError:
+        raise ValueError(f"unknown activation {act_type!r}; "
+                         f"known: {sorted(_ACTIVATIONS)}") from None
+
+
+# ---------------------------------------------------------------------------
+# losses / classification heads
+# ---------------------------------------------------------------------------
+
+def softmax_cross_entropy(logits, labels, axis=-1, sparse_label=True):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if sparse_label:
+        lab = labels.astype(jnp.int32)
+        return -jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis).squeeze(axis)
+    return -jnp.sum(labels * logp, axis=axis)
+
+
+def smooth_l1(x, scalar=1.0):
+    """smooth_l1 (reference: used by SSD loc loss)."""
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * jnp.square(x), absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# attention (XLA path; pallas kernel in ops/pallas/ for the TPU fast path)
+# ---------------------------------------------------------------------------
+
+def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
+                        key=None, training=False, scale=None):
+    """Batched MHA on (B, L, D) inputs already projected; splits heads,
+    scaled-dot-product, merges heads. Reference: src/operator/contrib/
+    transformer.cc (interleaved_matmul_*)."""
+    b, lq, d = q.shape
+    lk = k.shape[1]
+    hd = d // num_heads
+    scale = scale if scale is not None else 1.0 / (hd ** 0.5)
+
+    def split(x, l):
+        return x.reshape(b, l, num_heads, hd).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q, lq), split(k, lk), split(v, lk)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and training and key is not None:
+        w = dropout(w, key, dropout_rate, training)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+    return out.transpose(0, 2, 1, 3).reshape(b, lq, d)

@@ -1,0 +1,379 @@
+"""Optimizers (parity: python/mxnet/optimizer/optimizer.py).
+
+Each optimizer defines a PURE update rule `_update(weight, grad, state,
+lr, wd, mult) -> (new_weight, new_state)` over raw jax arrays. The eager
+Trainer path jit-compiles the rule per (shape, dtype) — XLA fuses the whole
+update into one kernel — and the fused train-step path (parallel/) inlines
+the same rule inside the global jit. Multi-precision: `multi_precision=True`
+keeps a float32 master copy for bf16/fp16 weights, like the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import _Registry
+from ..ndarray import NDArray
+from . import lr_scheduler  # noqa: F401
+
+registry = _Registry("optimizer")
+register = registry.register
+
+
+def create(name, **kwargs):
+    return registry.create(name, **kwargs)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None, lr_scheduler=None, multi_precision=False,
+                 param_dict=None, begin_num_update=0):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.param_dict = param_dict or {}
+        self._jit_cache = {}
+
+    # -- hyper access -----------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler(self.num_update))
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def _get_lr_wd(self, index):
+        lr, wd = self.learning_rate, self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= p.lr_mult
+            wd *= p.wd_mult
+        return lr, wd
+
+    def _update_count(self, index):
+        self._index_update_count[index] = self._index_update_count.get(index, 0) + 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight_raw):
+        return ()
+
+    def create_state_multi_precision(self, index, weight_raw):
+        if self.multi_precision and weight_raw.dtype in (jnp.float16, jnp.bfloat16):
+            master = weight_raw.astype(jnp.float32)
+            return (master,) + tuple(self.create_state(index, master))
+        return self.create_state(index, weight_raw)
+
+    # -- pure rule (subclasses implement) ---------------------------------
+    def _update(self, weight, grad, state, lr, wd, t):
+        raise NotImplementedError
+
+    def update_step(self, weight, grad, state, lr, wd, t, rescale=None, clip=None):
+        """Pure entry incl. rescale/clip/multi-precision — safe inside jit.
+        rescale/clip are runtime args so a jitted wrapper must pass them as
+        tracers (Trainer.step changes rescale_grad with the batch size)."""
+        rescale = self.rescale_grad if rescale is None else rescale
+        grad = grad.astype(jnp.float32) * rescale
+        clip = self.clip_gradient if clip is None else clip
+        if clip is not None:
+            grad = jnp.clip(grad, -clip, clip)
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, inner = state[0], state[1:]
+            new_master, new_inner = self._update(master, grad, inner, lr, wd, t)
+            return new_master.astype(weight.dtype), (new_master,) + tuple(new_inner)
+        new_w, new_state = self._update(weight.astype(jnp.float32), grad, state,
+                                        lr, wd, t)
+        return new_w.astype(weight.dtype), new_state
+
+    # -- eager path (Trainer / KVStore server-side update) ----------------
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        self._update_count(index)
+        lr, wd = self._get_lr_wd(index)
+        t = self._index_update_count[index]
+        has_clip = self.clip_gradient is not None
+        key = (weight.shape, str(weight._data.dtype), bool(self.multi_precision),
+               has_clip)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            if has_clip:
+                fn = jax.jit(lambda w, g, s, lr_, wd_, t_, rs_, cl_:
+                             self.update_step(w, g, s, lr_, wd_, t_, rs_, cl_))
+            else:
+                fn = jax.jit(lambda w, g, s, lr_, wd_, t_, rs_:
+                             self.update_step(w, g, s, lr_, wd_, t_, rs_))
+            self._jit_cache[key] = fn
+        extra = (jnp.float32(self.rescale_grad),)
+        if has_clip:
+            extra += (jnp.float32(self.clip_gradient),)
+        new_w, new_state = fn(weight._data, grad._data, state,
+                              jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
+                              *extra)
+        weight._data = new_w
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        return self.update(index, weight, grad, state)
+
+
+@register("sgd")
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight_raw):
+        if self.momentum != 0.0:
+            return (jnp.zeros(weight_raw.shape, jnp.float32),)
+        return ()
+
+    def _update(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum != 0.0:
+            (mom,) = state
+            mom = self.momentum * mom - lr * g
+            return w + mom, (mom,)
+        return w - lr * g, ()
+
+
+@register("nag")
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: mx.optimizer.NAG)."""
+
+    def _update(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum != 0.0:
+            (mom,) = state
+            mom = self.momentum * mom - lr * g
+            return w + self.momentum * mom - lr * g, (mom,)
+        return w - lr * g, ()
+
+
+@register("sgld")
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: mx.optimizer.SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        # bypass the jit cache: a traced PRNG key would freeze the noise
+        from ..ndarray import random as ndrandom
+        self._update_count(index)
+        lr, wd = self._get_lr_wd(index)
+        g = grad._data.astype(jnp.float32) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data.astype(jnp.float32)
+        noise = jax.random.normal(ndrandom._key(), weight.shape, jnp.float32)
+        new_w = weight._data.astype(jnp.float32) - lr / 2 * g + jnp.sqrt(lr) * noise
+        weight._data = new_w.astype(weight._data.dtype)
+        return state
+
+
+@register("signum")
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight_raw):
+        if self.momentum != 0.0:
+            return (jnp.zeros(weight_raw.shape, jnp.float32),)
+        return ()
+
+    def _update(self, w, g, state, lr, wd, t):
+        if self.momentum != 0.0:
+            (mom,) = state
+            mom = self.momentum * mom + (1 - self.momentum) * (g + wd * w)
+            step = jnp.sign(mom)
+            new_w = w * (1 - lr * self.wd_lh) - lr * step
+            return new_w, (mom,)
+        return w - lr * jnp.sign(g + wd * w), ()
+
+
+@register("adam")
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        return (z, z)
+
+    def _update(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        return w - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+@register("adamw")
+class AdamW(Adam):
+    """Decoupled weight decay (used by BERT; parity: contrib BERTAdam/AdamW)."""
+
+    def _update(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        return w - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w), (m, v)
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight_raw):
+        return (jnp.zeros(weight_raw.shape, jnp.float32),)
+
+    def _update(self, w, g, state, lr, wd, t):
+        (hist,) = state
+        g = g + wd * w
+        hist = hist + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps), (hist,)
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        return (z, z)
+
+    def _update(self, w, g, state, lr, wd, t):
+        acc_g, acc_d = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * jnp.square(delta)
+        return w - lr * delta, (acc_g, acc_d)
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        if self.centered:
+            return (z, z, z)
+        return (z,)
+
+    def _update(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.centered:
+            n, mg, delta = state
+            n = self.gamma1 * n + (1 - self.gamma1) * jnp.square(g)
+            mg = self.gamma1 * mg + (1 - self.gamma1) * g
+            delta = (self.gamma2 * delta -
+                     lr * g / jnp.sqrt(n - jnp.square(mg) + self.epsilon))
+            return w + delta, (n, mg, delta)
+        (n,) = state
+        n = self.gamma1 * n + (1 - self.gamma1) * jnp.square(g)
+        return w - lr * g / (jnp.sqrt(n) + self.epsilon), (n,)
+
+
+@register("ftrl")
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        return (z, z)
+
+    def _update(self, w, g, state, lr, wd, t):
+        z, n = state
+        g = g + wd * w
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        new_w = jnp.where(
+            jnp.abs(z) <= self.lamda1,
+            jnp.zeros_like(w),
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n)) / lr))
+        return new_w, (z, n)
+
+
+@register("lamb")
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (BERT pretraining;
+    parity: mx.optimizer.LAMB)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        return (z, z)
+
+    def _update(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            tf = t.astype(jnp.float32)
+            mhat = m / (1 - self.beta1 ** tf)
+            vhat = v / (1 - self.beta2 ** tf)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return w - lr * ratio * r, (m, v)
+
+
+@register("dcasgd")
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: mx.optimizer.DCASGD)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight_raw):
+        z = jnp.zeros(weight_raw.shape, jnp.float32)
+        return (z, z)  # (momentum, previous_weight)
+
+    def _update(self, w, g, state, lr, wd, t):
+        mom, prev_w = state
+        g = g + wd * w
+        comp = g + self.lamda * g * g * (w - prev_w)
+        mom = self.momentum * mom - lr * comp
+        return w + mom, (mom, w)

@@ -1,0 +1,321 @@
+"""Gluon blocks/layers/trainer (parity model: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def test_dense_shapes_and_values():
+    d = nn.Dense(4, in_units=3, use_bias=True)
+    d.initialize(init=mx.init.One())
+    x = nd.array([[1.0, 2.0, 3.0]])
+    out = d(x)
+    assert out.shape == (1, 4)
+    # per-param init (bias=zeros) takes precedence over the global One()
+    assert_close(out.asnumpy(), np.full((1, 4), 6.0))
+
+
+def test_dense_deferred_init():
+    d = nn.Dense(8)
+    d.initialize()
+    x = nd.ones((2, 5))
+    out = d(x)
+    assert out.shape == (2, 8)
+    assert d.weight.shape == (8, 5)
+
+
+def test_dense_no_flatten():
+    d = nn.Dense(6, flatten=False)
+    d.initialize()
+    out = d(nd.ones((2, 3, 4)))
+    assert out.shape == (2, 3, 6)
+
+
+def test_conv2d():
+    c = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    c.initialize()
+    out = c(nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 8, 16, 16)
+    c2 = nn.Conv2D(4, kernel_size=3, strides=2)
+    c2.initialize()
+    assert c2(nd.ones((1, 3, 9, 9))).shape == (1, 4, 4, 4)
+    # grouped
+    c3 = nn.Conv2D(8, kernel_size=1, groups=2, in_channels=4)
+    c3.initialize()
+    assert c3(nd.ones((1, 4, 5, 5))).shape == (1, 8, 5, 5)
+
+
+def test_conv2d_nhwc():
+    c = nn.Conv2D(8, kernel_size=3, padding=1, layout="NHWC", in_channels=3)
+    c.initialize()
+    out = c(nd.ones((2, 16, 16, 3)))
+    assert out.shape == (2, 16, 16, 8)
+
+
+def test_conv_transpose():
+    c = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    c.initialize()
+    out = c(nd.ones((1, 3, 8, 8)))
+    assert out.shape == (1, 4, 16, 16)
+
+
+def test_pooling():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)
+    assert_close(mp(x).asnumpy().ravel(), [5, 7, 13, 15])
+    ap = nn.AvgPool2D(2)
+    assert_close(ap(x).asnumpy().ravel(), [2.5, 4.5, 10.5, 12.5])
+    g = nn.GlobalAvgPool2D()
+    assert g(x).shape == (1, 1, 1, 1)
+    assert_close(g(x).asnumpy().ravel(), [7.5])
+
+
+def test_batchnorm_train_vs_infer():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    x = nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1)
+    with autograd.record():
+        y = bn(x)
+    # batch-normalized output ~ zero mean unit var per channel
+    yn = y.asnumpy()
+    assert abs(yn.mean()) < 1e-5
+    assert abs(yn.std() - 1) < 1e-2
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).max() > 0  # updated
+    y2 = bn(x)  # inference uses running stats => different from train output
+    assert np.abs(y2.asnumpy() - yn).max() > 1e-3
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = nd.array(np.random.randn(4, 6).astype(np.float32) * 3 + 2)
+    y = ln(x).asnumpy()
+    assert_close(y.mean(-1), np.zeros(4), atol=1e-5)
+    assert_close(y.std(-1), np.ones(4), rtol=1e-2)
+
+
+def test_embedding():
+    e = nn.Embedding(10, 4)
+    e.initialize()
+    out = e(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 4)
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    assert_close(do(x).asnumpy(), np.ones((100, 100)))  # inference = identity
+    with autograd.record():
+        y = do(x)
+    yn = y.asnumpy()
+    assert (yn == 0).mean() > 0.3  # roughly half dropped
+    assert abs(yn.mean() - 1.0) < 0.1  # inverted scaling
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_hybridize_parity_and_caching():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.randn(8, 12).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_close(eager, hybrid, rtol=1e-5, atol=1e-5)
+    # second call hits the cache (same signature)
+    assert len(net._cache) == 1
+    net(x)
+    assert len(net._cache) == 1
+    # new shape => new entry
+    net(nd.array(np.random.randn(4, 12).astype(np.float32)))
+    assert len(net._cache) == 2
+
+
+def test_hybridize_grad_parity():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(1))
+    net.initialize()
+    x = nd.array(np.random.randn(8, 5).astype(np.float32))
+    params = list(net.collect_params().values())
+
+    def grads():
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return [p.grad().asnumpy().copy() for p in params]
+
+    eager = grads()
+    net.hybridize()
+    hybrid = grads()
+    for ge, gh in zip(eager, hybrid):
+        assert_close(ge, gh, rtol=1e-4, atol=1e-5)
+
+
+def test_hybridized_bn_aux_writeback():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.randn(16, 4).astype(np.float32))
+    net(x)  # completes deferred init (inference mode, no aux drift)
+    bn = net[1]
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = bn.running_mean.data().asnumpy()
+    assert np.abs(after - before).max() > 0
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    x = nd.ones((1, 4))
+    ref = net(x).asnumpy()
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    # fresh params differ...
+    net2.initialize()
+    # names differ per-instance prefix; load with mapping by order is out of
+    # scope — reload into the SAME net after perturbing
+    for p in net.collect_params().values():
+        p.set_data(p.data() * 0)
+    assert np.abs(net(x).asnumpy()).max() == 0
+    net.load_parameters(f)
+    assert_close(net(x).asnumpy(), ref)
+
+
+def test_trainer_sgd_momentum():
+    w = gluon.Parameter("w", shape=(2,), init="zeros")
+    w.initialize()
+    w.set_data(nd.array([1.0, 2.0]))
+    tr = gluon.Trainer({"w": w}, "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    for step in range(3):
+        with autograd.record():
+            loss = (w.data() * nd.array([1.0, 1.0])).sum()
+        loss.backward()
+        tr.step(1)
+    # manual: grad=1 each step; mom: m=-0.1, w=0.9; m=-0.19,w=0.71; m=-0.271,w=0.439
+    assert_close(w.data().asnumpy(), [0.439, 1.439], rtol=1e-5)
+
+
+def test_trainer_learning_rate():
+    w = gluon.Parameter("w", shape=(1,), init="ones")
+    w.initialize()
+    tr = gluon.Trainer({"w": w}, "sgd", {"learning_rate": 0.5})
+    assert tr.learning_rate == 0.5
+    tr.set_learning_rate(0.25)
+    assert tr.learning_rate == 0.25
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    w = gluon.Parameter("w", shape=(2,), init="ones")
+    w.initialize()
+    tr = gluon.Trainer({"w": w}, "adam", {"learning_rate": 0.01})
+    with autograd.record():
+        (w.data() ** 2).sum().backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer({"w": w}, "adam", {"learning_rate": 0.01})
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+
+
+def test_lenet_convergence():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(6, kernel_size=5, padding=2, activation="relu"),
+        nn.MaxPool2D(2),
+        nn.Conv2D(16, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(2),
+        nn.Flatten(),
+        nn.Dense(64, activation="relu"),
+        nn.Dense(10),
+    )
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    # separable synthetic "digits": class k = bright blob at position k
+    n, k = 64, 10
+    labels = np.random.randint(0, k, n)
+    X = np.zeros((n, 1, 28, 28), np.float32)
+    for i, l in enumerate(labels):
+        X[i, 0, 2 + l * 2: 6 + l * 2, 4:24] = 1.0
+    X += 0.1 * np.random.randn(*X.shape).astype(np.float32)
+    Xn, yn = nd.array(X), nd.array(labels)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.003})
+    first = None
+    for i in range(40):
+        with autograd.record():
+            loss = L(net(Xn), yn).mean()
+        loss.backward()
+        tr.step(1)
+        if first is None:
+            first = float(loss)
+    last = float(loss)
+    acc = (net(Xn).argmax(axis=1).asnumpy() == labels).mean()
+    assert last < first * 0.2, (first, last)
+    assert acc > 0.9, acc
+
+
+def test_loss_values():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.5], [2.0, 3.0]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    assert_close(l2, [(0.25 + 0.25) / 4, (1 + 1) / 4])
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    assert_close(l1, [0.5, 1.0])
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    logits = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    lab = nd.array([0, 1])
+    assert sce(logits, lab).asnumpy().max() < 1e-3
+    h = gluon.loss.HuberLoss(rho=1.0)(nd.array([[0.0]]), nd.array([[3.0]])).asnumpy()
+    assert_close(h, [2.5])  # |3| - 0.5
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    big = bce(nd.array([[100.0]]), nd.array([[0.0]])).asnumpy()
+    assert_close(big, [100.0], rtol=1e-3)
+
+
+def test_prelu_and_activations():
+    p = nn.PReLU()
+    p.initialize()
+    out = p(nd.array([[-2.0, 3.0]]))
+    assert_close(out.asnumpy(), [[-0.5, 3.0]])
+    for act in ["relu", "sigmoid", "tanh", "softrelu", "gelu", "swish"]:
+        a = nn.Activation(act)
+        assert a(nd.array([0.5])).shape == (1,)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    only_w = net.collect_params(".*weight")
+    assert all("weight" in k for k in only_w.keys())
+    assert len(only_w) == 2
+
+
+def test_constant_param():
+    c = gluon.Constant("const", nd.array([1.0, 2.0]))
+    c.initialize()
+    assert_close(c.data().asnumpy(), [1, 2])
+    assert c.grad_req == "null"

@@ -1,0 +1,144 @@
+"""Optimizer update rules vs hand-computed NumPy (parity: test_optimizer.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, optimizer as opt
+from incubator_mxnet_tpu.optimizer import lr_scheduler as lrs
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def run_steps(o, w0, grads):
+    w = nd.array(np.array(w0, np.float32))
+    state = o.create_state_multi_precision(0, w._data)
+    for g in grads:
+        state = o.update(0, w, nd.array(np.array(g, np.float32)), state)
+    return w.asnumpy()
+
+
+def test_sgd():
+    w = run_steps(opt.create("sgd", learning_rate=0.1), [1.0], [[1.0], [1.0]])
+    assert_close(w, [0.8])
+
+
+def test_sgd_momentum_wd():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.1)
+    w = np.array([1.0]); m = np.zeros(1)
+    ref = w.copy()
+    for _ in range(3):
+        g = np.array([0.5]) + 0.1 * ref
+        m = 0.9 * m - 0.1 * g
+        ref = ref + m
+    got = run_steps(o, [1.0], [[0.5]] * 3)
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_adam():
+    o = opt.create("adam", learning_rate=0.01)
+    w = np.array([1.0]); m = np.zeros(1); v = np.zeros(1)
+    ref = w.copy()
+    for t in range(1, 4):
+        g = np.array([2.0])
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        ref = ref - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+        del t
+    got = run_steps(o, [1.0], [[2.0]] * 3)
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_adamw_decoupled_wd():
+    o = opt.create("adamw", learning_rate=0.01, wd=0.1)
+    got = run_steps(o, [1.0], [[0.0]])
+    # zero grad => update = -lr * wd * w only
+    assert_close(got, [1.0 - 0.01 * 0.1 * 1.0], rtol=1e-6)
+
+
+def test_adagrad():
+    o = opt.create("adagrad", learning_rate=0.1)
+    got = run_steps(o, [1.0], [[2.0], [2.0]])
+    h1 = 4.0
+    w1 = 1.0 - 0.1 * 2 / (np.sqrt(h1) + 1e-7)
+    h2 = 8.0
+    w2 = w1 - 0.1 * 2 / (np.sqrt(h2) + 1e-7)
+    assert_close(got, [w2], rtol=1e-5)
+
+
+def test_rmsprop():
+    o = opt.create("rmsprop", learning_rate=0.01, gamma1=0.9)
+    got = run_steps(o, [1.0], [[1.0]])
+    n = 0.1
+    assert_close(got, [1.0 - 0.01 / (np.sqrt(n) + 1e-8)], rtol=1e-5)
+
+
+def test_lamb_runs():
+    o = opt.create("lamb", learning_rate=0.01)
+    got = run_steps(o, [1.0, 2.0], [[0.1, 0.2]] * 2)
+    assert got.shape == (2,)
+    assert np.all(np.isfinite(got))
+
+
+def test_clip_and_rescale():
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.4)
+    got = run_steps(o, [1.0], [[2.0]])  # 2*0.5=1 -> clip 0.4 -> w=0.6
+    assert_close(got, [0.6])
+
+
+def test_multi_precision():
+    o = opt.create("sgd", learning_rate=0.1, multi_precision=True)
+    w = nd.array(np.array([1.0], np.float32)).astype("bfloat16")
+    state = o.create_state_multi_precision(0, w._data)
+    assert state[0].dtype == np.float32  # master weights
+    state = o.update(0, w, nd.array([0.001]).astype("bfloat16"), state)
+    # master tracks small updates below bf16 resolution
+    assert float(state[0][0]) < 1.0
+
+
+def test_nag():
+    o = opt.create("nag", learning_rate=0.1, momentum=0.9)
+    got = run_steps(o, [1.0], [[1.0]])
+    # m=-0.1; w = 1 + 0.9*(-0.1) - 0.1 = 0.81
+    assert_close(got, [0.81], rtol=1e-5)
+
+
+def test_registry_create():
+    for name in ["sgd", "nag", "adam", "adamw", "adagrad", "adadelta",
+                 "rmsprop", "ftrl", "lamb", "signum", "dcasgd", "sgld"]:
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer)
+
+
+def test_lr_schedulers():
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    m = lrs.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-9
+    assert abs(m(11) - 0.01) < 1e-9
+    p = lrs.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-6
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(c(50) - 0.5) < 1e-6
+    assert c(100) == 0.0
+    w = lrs.CosineScheduler(max_update=100, base_lr=1.0, warmup_steps=10)
+    assert w(5) == 0.5  # linear warmup
+    sched = lrs.FactorScheduler(step=1000, base_lr=0.0)
+    o = opt.create("sgd", lr_scheduler=lrs.FactorScheduler(step=10, base_lr=2.0))
+    assert o.learning_rate == 2.0
+
+
+def test_optimizer_with_scheduler_in_trainer():
+    from incubator_mxnet_tpu import autograd, gluon
+    w = gluon.Parameter("w", shape=(1,), init="ones")
+    w.initialize()
+    sched = lrs.FactorScheduler(step=1, factor=0.1, base_lr=1.0)
+    tr = gluon.Trainer({"w": w}, "sgd", {"lr_scheduler": sched, "learning_rate": 1.0})
+    with autograd.record():
+        (w.data() * 1.0).sum().backward()
+    tr.step(1)
+    assert np.isfinite(w.data().asnumpy()).all()
