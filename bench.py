@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput on one
+TPU chip (BASELINE.json: images/sec/chip vs MXNet-on-V100 reference).
+
+Prints exactly one JSON line:
+  {"metric": "...", "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline: published MXNet ResNet-50 fp32 V100 throughput ~390 img/s
+(BASELINE.json north star: target >=70% of that on one v5e chip).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+# Persistent compilation cache: the axon remote-compile path is slow; cache
+# makes repeat bench runs start fast.
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, nd  # noqa: E402
+from incubator_mxnet_tpu.models import get_model  # noqa: E402
+from incubator_mxnet_tpu.parallel import FusedTrainStep  # noqa: E402
+
+V100_BASELINE_IMG_S = 390.0  # MXNet ResNet-50 fp32, single V100 (published)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    net = get_model("resnet50_v1", classes=1000, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
+                              multi_precision=(dtype == "bfloat16"))
+    step = FusedTrainStep(net, L, opt)
+
+    x = nd.array(np.random.randn(batch, 224, 224, 3).astype(np.float32))
+    if dtype == "bfloat16":
+        x = x.astype("bfloat16")
+    y = nd.array(np.random.randint(0, 1000, batch))
+
+    # compile + warmup. NOTE: through the axon relay block_until_ready() does
+    # not synchronize; a host value fetch is the only true barrier. Steps
+    # chain through updated params, so fetching the final loss times them all.
+    float(step(x, y))
+    float(step(x, y))
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss_val = float(loss)
+    dt = time.time() - t0
+
+    img_s = batch * steps / dt
+    # MFU: ResNet-50 fwd+bwd ~3x 4.09 GFLOPs/img on 224x224
+    flops_per_img = 3 * 4.09e9
+    peak = 197e12 if dtype == "bfloat16" else 99e12  # v5e chip
+    mfu = img_s * flops_per_img / peak
+
+    print(json.dumps({
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / V100_BASELINE_IMG_S, 3),
+        "extra": {"batch": batch, "dtype": dtype, "steps": steps,
+                  "mfu": round(mfu, 4), "final_loss": round(loss_val, 4),
+                  "device": str(jax.devices()[0])},
+    }))
+
+
+if __name__ == "__main__":
+    main()

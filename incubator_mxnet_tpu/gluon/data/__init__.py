@@ -1,0 +1,213 @@
+"""gluon.data: Dataset / Sampler / DataLoader (parity: python/mxnet/gluon/data).
+
+DataLoader's multi-worker path uses a host-side prefetch pipeline (threads
+now, the C++ runtime engine underneath once built) — on TPU the goal is to
+keep the input pipeline off the critical path so the chip never starves.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from . import sampler as _sampler
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "RecordFileDataset",
+           "DataLoader", "BatchSampler", "RandomSampler", "SequentialSampler"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def transform(self, fn, lazy=True):
+        return _LazyTransformDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        def first(*items):
+            if len(items) == 1:
+                return fn(items[0])
+            return (fn(items[0]),) + items[1:]
+        return self.transform(first, lazy)
+
+    def filter(self, fn):
+        idx = [i for i in range(len(self)) if fn(self[i])]
+        return _SubsetDataset(self, idx)
+
+    def shard(self, num_shards, index):
+        idx = list(range(index, len(self), num_shards))
+        return _SubsetDataset(self, idx)
+
+    def take(self, count):
+        return _SubsetDataset(self, list(range(min(count, len(self)))))
+
+
+class _SubsetDataset(Dataset):
+    def __init__(self, base, indices):
+        self._base = base
+        self._indices = indices
+
+    def __getitem__(self, idx):
+        return self._base[self._indices[idx]]
+
+    def __len__(self):
+        return len(self._indices)
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, base, fn):
+        self._base = base
+        self._fn = fn
+
+    def __getitem__(self, idx):
+        item = self._base[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+    def __len__(self):
+        return len(self._base)
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for a in args:
+            assert len(a) == self._length
+            self._data.append(a)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+    def __len__(self):
+        return len(self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Reads a MXNet .rec record file (reference: src/io/ recordio). Format:
+    [magic(4) | lrecord(4) | data...] per record, magic=0xced7230a."""
+
+    MAGIC = 0xCED7230A
+
+    def __init__(self, filename):
+        self._filename = filename
+        self._offsets = []
+        idx_file = filename[:-4] + ".idx" if filename.endswith(".rec") else None
+        import os
+        if idx_file and os.path.exists(idx_file):
+            with open(idx_file) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        self._offsets.append(int(parts[1]))
+        else:
+            self._scan()
+
+    def _scan(self):
+        import struct
+        with open(self._filename, "rb") as f:
+            pos = 0
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                magic, lrec = struct.unpack("<II", header)
+                if magic != self.MAGIC:
+                    raise IOError(f"bad record magic at {pos}")
+                length = lrec & ((1 << 29) - 1)
+                self._offsets.append(pos)
+                pad = (4 - length % 4) % 4
+                f.seek(length + pad, 1)
+                pos = f.tell()
+
+    def __getitem__(self, idx):
+        import struct
+        with open(self._filename, "rb") as f:
+            f.seek(self._offsets[idx])
+            magic, lrec = struct.unpack("<II", f.read(8))
+            length = lrec & ((1 << 29) - 1)
+            return f.read(length)
+
+    def __len__(self):
+        return len(self._offsets)
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: gluon.data.DataLoader default)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(items)) for items in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return nd.array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(1, prefetch if prefetch is not None
+                             else 2 * max(num_workers, 1))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded prefetch pipeline (native engine handles scheduling when
+        # built; python threads release the GIL during numpy/jax work)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(self._num_workers) as pool:
+            batches = list(self._batch_sampler)
+            futures = []
+            it = iter(batches)
+            for _ in range(min(self._prefetch, len(batches))):
+                futures.append(pool.submit(self._load_batch, next(it)))
+            consumed = len(futures)
+            i = 0
+            while i < len(batches):
+                yield futures[i % len(futures)].result()
+                if consumed < len(batches):
+                    futures[i % len(futures)] = pool.submit(
+                        self._load_batch, batches[consumed])
+                    consumed += 1
+                i += 1

@@ -1,0 +1,190 @@
+"""Vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms are HybridBlocks operating on HWC images (float or uint8-valued
+NDArrays), mirroring the reference semantics: ToTensor converts HWC [0,255]
+→ CHW [0,1]."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .... import ndarray as nd
+from ....ndarray import NDArray, _apply
+from ....ndarray import random as ndrandom
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "CropResize"]
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC [0,255] → CHW [0,1] float32 (batch: NHWC → NCHW)."""
+
+    def forward(self, x):
+        def f(a):
+            a = a.astype(jnp.float32) / 255.0
+            if a.ndim == 3:
+                return jnp.transpose(a, (2, 0, 1))
+            return jnp.transpose(a, (0, 3, 1, 2))
+        return _apply(f, [x], name="to_tensor")
+
+
+class Normalize(HybridBlock):
+    """Channel-wise (x - mean) / std on CHW tensors."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def forward(self, x):
+        mean, std = self._mean, self._std
+
+        def f(a):
+            shape = (-1, 1, 1) if a.ndim == 3 else (1, -1, 1, 1)
+            return (a - mean.reshape(shape)) / std.reshape(shape)
+        return _apply(f, [x], name="normalize")
+
+
+def _resize_hwc(a, size, interp="bilinear"):
+    h, w = (size, size) if isinstance(size, int) else (size[1], size[0])
+    if a.ndim == 3:
+        return jax.image.resize(a, (h, w, a.shape[2]), method=interp)
+    return jax.image.resize(a, (a.shape[0], h, w, a.shape[3]), method=interp)
+
+
+class Resize(HybridBlock):
+    def __init__(self, size, keep_ratio=False, interpolation="bilinear"):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        size = self._size
+        if self._keep and isinstance(size, int):
+            # shorter edge → size, aspect preserved (reference semantics)
+            h, w = (x.shape[0], x.shape[1]) if x.ndim == 3 else (x.shape[1], x.shape[2])
+            if h < w:
+                size = (int(round(w * size / h)), size)  # (W, H)
+            else:
+                size = (size, int(round(h * size / w)))
+        return _apply(lambda a: _resize_hwc(a, size, self._interp), [x],
+                      name="resize")
+
+
+class CenterCrop(HybridBlock):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else (size[1], size[0])
+
+    def forward(self, x):
+        ch, cw = self._size
+
+        def f(a):
+            h, w = (a.shape[0], a.shape[1]) if a.ndim == 3 else (a.shape[1], a.shape[2])
+            y0, x0 = max((h - ch) // 2, 0), max((w - cw) // 2, 0)
+            if a.ndim == 3:
+                return a[y0:y0 + ch, x0:x0 + cw]
+            return a[:, y0:y0 + ch, x0:x0 + cw]
+        return _apply(f, [x], name="center_crop")
+
+
+class CropResize(HybridBlock):
+    def __init__(self, x0, y0, width, height, size=None, interpolation="bilinear"):
+        super().__init__()
+        self._box = (x0, y0, width, height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, x):
+        x0, y0, w, h = self._box
+
+        def f(a):
+            crop = a[y0:y0 + h, x0:x0 + w] if a.ndim == 3 else a[:, y0:y0 + h, x0:x0 + w]
+            if self._size is not None:
+                crop = _resize_hwc(crop, self._size, self._interp)
+            return crop
+        return _apply(f, [x], name="crop_resize")
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if float(ndrandom.uniform(shape=(1,)).asnumpy()[0]) < 0.5:
+            return x.flip(axis=-2 if x.ndim == 3 else -2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if float(ndrandom.uniform(shape=(1,)).asnumpy()[0]) < 0.5:
+            return x.flip(axis=0 if x.ndim == 3 else 1)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = 1.0 + float(ndrandom.uniform(-self._b, self._b, shape=(1,)).asnumpy()[0])
+        return x * f
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = 1.0 + float(ndrandom.uniform(-self._c, self._c, shape=(1,)).asnumpy()[0])
+        mean = x.mean()
+        return x * f + mean * (1 - f)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        super().__init__()
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        h, w = x.shape[0], x.shape[1]
+        area = h * w
+        rng = np.random
+        for _ in range(10):
+            target_area = rng.uniform(*self._scale) * area
+            ar = np.exp(rng.uniform(np.log(self._ratio[0]), np.log(self._ratio[1])))
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                x0 = rng.randint(0, w - cw + 1)
+                y0 = rng.randint(0, h - ch + 1)
+                crop = x[y0:y0 + ch, x0:x0 + cw]
+                return _apply(lambda a: _resize_hwc(a, self._size, self._interp),
+                              [crop], name="rrc_resize")
+        return _apply(lambda a: _resize_hwc(a, self._size, self._interp), [x],
+                      name="rrc_resize")

@@ -1,0 +1,261 @@
+"""Data iterators (parity: python/mxnet/io/ + src/io/).
+
+The reference's DataIter protocol: iter with .next() -> DataBatch carrying
+data/label lists + provide_data/provide_label descriptors. The gluon
+DataLoader (gluon/data) is the modern path; these iterators keep Module/fit
+compatibility."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter"]
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (np.float32, "NCHW")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self):
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: mx.io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = self._normalize(data, data_name)
+        self.label = self._normalize(label, label_name)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self._order = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._order)
+
+    @staticmethod
+    def _normalize(data, default_name):
+        if data is None:
+            return []
+        if isinstance(data, (np.ndarray, NDArray)):
+            data = {default_name: data}
+        if isinstance(data, (list, tuple)):
+            data = {f"{default_name}_{i}" if i else default_name: d
+                    for i, d in enumerate(data)}
+        out = []
+        for k, v in data.items():
+            arr = np.asarray(v) if not isinstance(v, NDArray) else v.asnumpy()
+            out.append((k, arr))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        pad = 0
+        if len(idx) < self.batch_size:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            pad = self.batch_size - len(idx)
+            idx = np.concatenate([idx, self._order[:pad]])
+        data = [nd.array(v[idx]) for _, v in self.data]
+        label = [nd.array(v[idx]) for _, v in self.label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(NDArrayIter):
+    """Parity: mx.io.CSVIter — reads dense CSVs into memory."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """Parity: mx.io.MNISTIter — reads idx-format MNIST files; if the files
+    are absent (zero-egress environments) generates a deterministic synthetic
+    stand-in with the same shapes so example scripts still run."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, num_examples=60000, **kwargs):
+        import os
+        # idx parsing + synthetic fallback shared with gluon.data.vision
+        from ..gluon.data.vision import _read_idx, _synthetic_images
+
+        if image is not None and os.path.exists(image):
+            X = _read_idx(image).astype(np.float32) / 255.0
+            Y = _read_idx(label).astype(np.float32)
+        else:
+            n = min(num_examples, 10000)
+            X, Y = _synthetic_images(n, (28, 28), 10, seed=42)
+            X = X.astype(np.float32) / 255.0
+            Y = Y.astype(np.float32)
+        X = X.reshape(-1, 784) if flat else X.reshape(-1, 1, 28, 28)
+        super().__init__(X, Y, batch_size=batch_size, shuffle=shuffle, **kwargs)
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (parity: mx.io.PrefetchingIter); the C++
+    runtime pipeline (runtime/) backs gluon DataLoader's multi-worker path."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        self.iters = iters
+        super().__init__(iters[0].batch_size)
+        self._queue = queue.Queue(maxsize=4)
+        self._stop = False
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def worker():
+            try:
+                while not self._stop:
+                    try:
+                        batch = self.iters[0].next()
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    self._queue.put(batch)
+            except Exception as e:  # surface async errors at next()
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return self.iters[0].provide_data
+
+    @property
+    def provide_label(self):
+        return self.iters[0].provide_label
+
+    def reset(self):
+        import queue as _queue
+        self._stop = True
+        # Drain until the worker has exited AND the queue is empty — stale
+        # batches or the previous epoch's sentinel must not leak into the
+        # next epoch.
+        while self._thread.is_alive() or not self._queue.empty():
+            try:
+                self._queue.get(timeout=0.05)
+            except _queue.Empty:
+                pass
+        self._thread.join()
+        self.iters[0].reset()
+        self._stop = False
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item

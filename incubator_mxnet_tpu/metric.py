@@ -1,0 +1,310 @@
+"""Evaluation metrics (parity: python/mxnet/metric.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import _Registry
+from .ndarray import NDArray
+
+registry = _Registry("metric")
+register = registry.register
+
+
+def create(name, *args, **kwargs):
+    if isinstance(name, list):
+        c = CompositeEvalMetric()
+        for n in name:
+            c.add(create(n, *args, **kwargs))
+        return c
+    return registry.create(name, *args, **kwargs)
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register("acc")
+@register("accuracy")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kw):
+        self.axis = axis
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(np.int64).ravel()
+            label = label.astype(np.int64).ravel()
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@register("top_k_accuracy")
+@register("topkaccuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kw):
+        self.top_k = top_k
+        super().__init__(f"{name}_{top_k}")
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label).astype(np.int64).ravel()
+            topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+            self.sum_metric += sum(l in t for l, t in zip(label, topk))
+            self.num_inst += len(label)
+
+
+@register("f1")
+class F1(EvalMetric):
+    """average='micro': one F1 from globally pooled counts;
+    'macro' (default, reference semantics): mean of per-update F1 scores."""
+
+    def __init__(self, name="f1", average="macro", **kw):
+        self.average = average
+        super().__init__(name)
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0
+        self._batch_f1 = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    @staticmethod
+    def _f1(tp, fp, fn):
+        prec = tp / max(tp + fp, 1)
+        rec = tp / max(tp + fn, 1)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            label = _np(label).astype(np.int64).ravel()
+            pred = pred.astype(np.int64).ravel()
+            tp = ((pred == 1) & (label == 1)).sum()
+            fp = ((pred == 1) & (label == 0)).sum()
+            fn = ((pred == 0) & (label == 1)).sum()
+            self.tp += tp
+            self.fp += fp
+            self.fn += fn
+            self._batch_f1.append(self._f1(tp, fp, fn))
+            self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        if self.average == "micro":
+            return self.name, self._f1(self.tp, self.fp, self.fn)
+        return self.name, float(np.mean(self._batch_f1))
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kw):
+        super().__init__(name)
+
+    def reset(self):
+        self.tp = self.fp = self.fn = self.tn = 0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            label = _np(label).astype(np.int64).ravel()
+            pred = pred.astype(np.int64).ravel()
+            self.tp += ((pred == 1) & (label == 1)).sum()
+            self.fp += ((pred == 1) & (label == 0)).sum()
+            self.fn += ((pred == 0) & (label == 1)).sum()
+            self.tn += ((pred == 0) & (label == 0)).sum()
+            self.num_inst += 1
+
+    def get(self):
+        num = self.tp * self.tn - self.fp * self.fn
+        den = np.sqrt(float((self.tp + self.fp) * (self.tp + self.fn) *
+                            (self.tn + self.fp) * (self.tn + self.fn)))
+        return self.name, num / den if den else 0.0
+
+
+@register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kw):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += np.abs(label.reshape(pred.shape) - pred).mean()
+            self.num_inst += 1
+
+
+@register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kw):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += np.square(label.reshape(pred.shape) - pred).mean()
+            self.num_inst += 1
+
+
+@register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kw):
+        super().__init__(name)
+
+    def get(self):
+        name, v = super().get()
+        return name, float(np.sqrt(v))
+
+
+@register("ce")
+@register("cross-entropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kw):
+        self.eps = eps
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _np(label).astype(np.int64).ravel()
+            pred = _np(pred)
+            prob = pred[np.arange(len(label)), label]
+            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            self.num_inst += len(label)
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        super().__init__(eps, name)
+
+
+@register("perplexity")
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, name="perplexity", **kw):
+        self.ignore_label = ignore_label
+        super().__init__(name=name)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _np(label).astype(np.int64).ravel()
+            pred = _np(pred).reshape(len(label), -1)
+            mask = (label != self.ignore_label) if self.ignore_label is not None \
+                else np.ones_like(label, bool)
+            prob = pred[np.arange(len(label)), label]
+            self.sum_metric += (-np.log(prob[mask] + 1e-12)).sum()
+            self.num_inst += mask.sum()
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kw):
+        super().__init__(name)
+
+    def reset(self):
+        self._labels = []
+        self._preds = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_np(label).ravel())
+            self._preds.append(_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        l = np.concatenate(self._labels)
+        p = np.concatenate(self._preds)
+        return self.name, float(np.corrcoef(l, p)[0, 1])
+
+
+@register("loss")
+class Loss(EvalMetric):
+    """Average of pre-computed per-batch loss values."""
+
+    def __init__(self, name="loss", **kw):
+        super().__init__(name)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            v = _np(pred)
+            self.sum_metric += v.sum()
+            self.num_inst += v.size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kw):
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+        super().__init__(name)
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str) else metric)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, vals = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            vals.append(v)
+        return names, vals
+
+
+register("composite")(CompositeEvalMetric)

@@ -1,0 +1,25 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/vision + the reference's
+example/ networks). `get_model("resnet50_v1")` mirrors mx model_zoo."""
+from . import lenet as _lenet_mod
+from . import resnet as _resnet_mod
+from .lenet import LeNet, lenet
+from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,
+                     resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2,
+                     resnet50_v2, resnet101_v2, resnet152_v2)
+
+_MODELS = {}
+for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+              "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+              "resnet101_v2", "resnet152_v2", "lenet"]:
+    _MODELS[_name] = globals()[_name]
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _MODELS:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)
+
+
+def register_model(name, fn):
+    _MODELS[name.lower()] = fn

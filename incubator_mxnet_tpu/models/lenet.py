@@ -1,0 +1,29 @@
+"""LeNet-5 (reference: example/image-classification/symbols/lenet.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["LeNet", "lenet"]
+
+
+class LeNet(HybridBlock):
+    def __init__(self, classes=10, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(
+            nn.Conv2D(20, kernel_size=5, activation="tanh", layout=layout),
+            nn.MaxPool2D(2, 2, layout=layout),
+            nn.Conv2D(50, kernel_size=5, activation="tanh", layout=layout),
+            nn.MaxPool2D(2, 2, layout=layout),
+            nn.Flatten(),
+            nn.Dense(500, activation="tanh"),
+        )
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def lenet(classes=10, **kwargs):
+    return LeNet(classes=classes, **kwargs)

@@ -1,0 +1,210 @@
+"""ResNet v1/v2 (parity: python/mxnet/gluon/model_zoo/vision/resnet.py;
+reference example/image-classification resnet).
+
+TPU-first defaults: layout='NHWC' (channels-last feeds the MXU without
+relayout) and optional bf16 compute via net.cast('bfloat16') with f32 BN
+statistics (handled inside _raw.batch_norm/layer_norm). Set layout='NCHW'
+for bitwise API parity with the reference."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BottleneckV1",
+           "BasicBlockV2", "BottleneckV2", "resnet18_v1", "resnet34_v1",
+           "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
+           "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
+           "get_resnet"]
+
+
+def _conv(channels, kernel, stride, pad, layout, in_channels=0):
+    return nn.Conv2D(channels, kernel, strides=stride, padding=pad,
+                     use_bias=False, layout=layout, in_channels=in_channels)
+
+
+def _bn(layout, **kw):
+    return nn.BatchNorm(axis=-1 if layout == "NHWC" else 1, **kw)
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(_conv(channels, 3, stride, 1, layout, in_channels))
+        self.body.add(_bn(layout))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv(channels, 3, 1, 1, layout))
+        self.body.add(_bn(layout))
+        if downsample:
+            self.downsample = nn.HybridSequential()
+            self.downsample.add(_conv(channels, 1, stride, 0, layout, in_channels))
+            self.downsample.add(_bn(layout))
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        out = self.body(x)
+        return (out + residual).relu()
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        self.body = nn.HybridSequential()
+        self.body.add(_conv(mid, 1, stride, 0, layout, in_channels))
+        self.body.add(_bn(layout))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv(mid, 3, 1, 1, layout))
+        self.body.add(_bn(layout))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv(channels, 1, 1, 0, layout))
+        self.body.add(_bn(layout))
+        if downsample:
+            self.downsample = nn.HybridSequential()
+            self.downsample.add(_conv(channels, 1, stride, 0, layout, in_channels))
+            self.downsample.add(_bn(layout))
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        out = self.body(x)
+        return (out + residual).relu()
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = _bn(layout)
+        self.conv1 = _conv(channels, 3, stride, 1, layout, in_channels)
+        self.bn2 = _bn(layout)
+        self.conv2 = _conv(channels, 3, 1, 1, layout)
+        if downsample:
+            self.downsample = _conv(channels, 1, stride, 0, layout, in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        bn1 = self.bn1(x).relu()
+        residual = x if self.downsample is None else self.downsample(bn1)
+        out = self.conv1(bn1)
+        out = self.conv2(self.bn2(out).relu())
+        return out + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        self.bn1 = _bn(layout)
+        self.conv1 = _conv(mid, 1, 1, 0, layout, in_channels)
+        self.bn2 = _bn(layout)
+        self.conv2 = _conv(mid, 3, stride, 1, layout)
+        self.bn3 = _bn(layout)
+        self.conv3 = _conv(channels, 1, 1, 0, layout)
+        if downsample:
+            self.downsample = _conv(channels, 1, stride, 0, layout, in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        bn1 = self.bn1(x).relu()
+        residual = x if self.downsample is None else self.downsample(bn1)
+        out = self.conv1(bn1)
+        out = self.conv2(self.bn2(out).relu())
+        out = self.conv3(self.bn3(out).relu())
+        return out + residual
+
+
+class _ResNetBase(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, layout="NHWC",
+                 thumbnail=False, version=1, **kwargs):
+        super().__init__(**kwargs)
+        self._layout = layout
+        self.features = nn.HybridSequential()
+        if version == 2:
+            self.features.add(_bn(layout, scale=False, center=False))
+        if thumbnail:
+            self.features.add(_conv(channels[0], 3, 1, 1, layout))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, strides=2, padding=3,
+                                        use_bias=False, layout=layout))
+            if version == 1:
+                self.features.add(_bn(layout))
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+        in_ch = channels[0]
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            stage = nn.HybridSequential()
+            stage.add(block(channels[i + 1], stride,
+                            downsample=(channels[i + 1] != in_ch or stride != 1),
+                            in_channels=in_ch, layout=layout))
+            for _ in range(num_layer - 1):
+                stage.add(block(channels[i + 1], 1, in_channels=channels[i + 1],
+                                layout=layout))
+            in_ch = channels[i + 1]
+            self.features.add(stage)
+        if version == 2:
+            self.features.add(_bn(layout))
+            self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D(
+            layout=layout if layout == "NCHW" else "NHWC"))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes, in_units=in_ch)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class ResNetV1(_ResNetBase):
+    def __init__(self, block, layers, channels, **kwargs):
+        super().__init__(block, layers, channels, version=1, **kwargs)
+
+
+class ResNetV2(_ResNetBase):
+    def __init__(self, block, layers, channels, **kwargs):
+        super().__init__(block, layers, channels, version=2, **kwargs)
+
+
+_SPEC = {
+    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+_BLOCKS = {1: {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
+           2: {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2}}
+
+
+def get_resnet(version, num_layers, classes=1000, layout="NHWC", **kwargs):
+    btype, layers, channels = _SPEC[num_layers]
+    cls = ResNetV1 if version == 1 else ResNetV2
+    return cls(_BLOCKS[version][btype], layers, channels, classes=classes,
+               layout=layout, **kwargs)
+
+
+def _make(version, n):
+    def f(classes=1000, layout="NHWC", **kwargs):
+        return get_resnet(version, n, classes=classes, layout=layout, **kwargs)
+    f.__name__ = f"resnet{n}_v{version}"
+    return f
+
+
+resnet18_v1 = _make(1, 18)
+resnet34_v1 = _make(1, 34)
+resnet50_v1 = _make(1, 50)
+resnet101_v1 = _make(1, 101)
+resnet152_v1 = _make(1, 152)
+resnet18_v2 = _make(2, 18)
+resnet34_v2 = _make(2, 34)
+resnet50_v2 = _make(2, 50)
+resnet101_v2 = _make(2, 101)
+resnet152_v2 = _make(2, 152)

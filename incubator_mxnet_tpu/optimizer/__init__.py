@@ -205,8 +205,9 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
-        return (z, z)
+        # fresh buffers (aliased states break XLA buffer donation)
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))
 
     def _update(self, w, g, state, lr, wd, t):
         m, v = state
@@ -256,8 +257,9 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
-        return (z, z)
+        # fresh buffers (aliased states break XLA buffer donation)
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))
 
     def _update(self, w, g, state, lr, wd, t):
         acc_g, acc_d = state
@@ -278,10 +280,9 @@ class RMSProp(Optimizer):
         self.centered = centered
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
         if self.centered:
-            return (z, z, z)
-        return (z,)
+            return tuple(jnp.zeros(weight_raw.shape, jnp.float32) for _ in range(3))
+        return (jnp.zeros(weight_raw.shape, jnp.float32),)
 
     def _update(self, w, g, state, lr, wd, t):
         g = g + wd * w
@@ -304,8 +305,9 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
-        return (z, z)
+        # fresh buffers (aliased states break XLA buffer donation)
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))
 
     def _update(self, w, g, state, lr, wd, t):
         z, n = state
@@ -334,8 +336,9 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
-        return (z, z)
+        # fresh buffers (aliased states break XLA buffer donation)
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))
 
     def _update(self, w, g, state, lr, wd, t):
         m, v = state
@@ -368,8 +371,9 @@ class DCASGD(Optimizer):
         self.lamda = lamda
 
     def create_state(self, index, weight_raw):
-        z = jnp.zeros(weight_raw.shape, jnp.float32)
-        return (z, z)  # (momentum, previous_weight)
+        # fresh buffers (aliased states break XLA buffer donation)
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))  # (momentum, previous_weight)
 
     def _update(self, w, g, state, lr, wd, t):
         mom, prev_w = state

@@ -1,0 +1,19 @@
+"""parallel: mesh-based distributed training (the rebuild of the reference's
+kvstore dist_sync_device / NCCL+ps-lite layer, redesigned for TPU).
+
+Instead of translating NCCL calls, the whole train step — forward, backward,
+gradient aggregation, optimizer — is ONE jitted XLA computation over a
+`jax.sharding.Mesh`. Sharding annotations (in_shardings + Parameter._sharding)
+tell XLA where tensors live; XLA inserts the collectives (all-reduce /
+all-gather / reduce-scatter) over ICI. Axes convention:
+
+    dp  data parallel        (batch dim)
+    tp  tensor parallel      (hidden/heads dims, Megatron-style)
+    pp  pipeline parallel    (layer stages, lax.scan + ppermute)
+    sp  sequence parallel    (sequence dim, ring attention)
+    ep  expert parallel      (MoE experts)
+"""
+from .mesh import make_mesh, data_parallel_spec
+from .trainer_step import FusedTrainStep
+
+__all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep"]

@@ -1,0 +1,40 @@
+"""Device-mesh helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_spec", "replicated", "shard_batch"]
+
+
+def make_mesh(axes: dict | None = None, devices=None) -> Mesh:
+    """make_mesh({'dp': 4, 'tp': 2}) → Mesh over the first 8 devices.
+    A -1 axis absorbs the remaining device count (like reshape)."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"dp": len(devices)})
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_spec(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Batch-dim sharding along the data-parallel mesh axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, arr, axis: str = "dp"):
+    return jax.device_put(arr, data_parallel_spec(mesh, axis))

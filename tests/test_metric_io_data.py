@@ -1,0 +1,139 @@
+"""Metrics, io iterators, gluon.data (SURVEY.md §2.15, §2.17)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, metric, nd
+from incubator_mxnet_tpu.gluon import data as gdata
+from incubator_mxnet_tpu.gluon.data import vision
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    m.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]]))
+    assert abs(m.get()[1] - 2 / 3) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk():
+    m = metric.create("top_k_accuracy", top_k=2)
+    m.update(nd.array([2, 0]), nd.array([[0.3, 0.4, 0.35], [0.1, 0.5, 0.4]]))
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mae_mse_rmse():
+    lab = nd.array([1.0, 2.0])
+    pred = nd.array([2.0, 4.0])
+    m = metric.create("mae"); m.update(lab, pred)
+    assert abs(m.get()[1] - 1.5) < 1e-6
+    m = metric.create("mse"); m.update(lab, pred)
+    assert abs(m.get()[1] - 2.5) < 1e-6
+    m = metric.create("rmse"); m.update(lab, pred)
+    assert abs(m.get()[1] - np.sqrt(2.5)) < 1e-6
+
+
+def test_f1_perplexity_composite():
+    f1 = metric.create("f1")
+    f1.update(nd.array([1, 0, 1, 1]), nd.array([[0.1, 0.9], [0.8, 0.2],
+                                                [0.2, 0.8], [0.9, 0.1]]))
+    assert 0 < f1.get()[1] <= 1
+    c = metric.create(["acc", "ce"])
+    c.update(nd.array([1]), nd.array([[0.2, 0.8]]))
+    names, vals = c.get()
+    assert len(names) == 2
+    p = metric.create("perplexity", ignore_label=None)
+    p.update(nd.array([0]), nd.array([[1.0, 0.0]]))
+    assert abs(p.get()[1] - 1.0) < 1e-6
+
+
+def test_pearson():
+    m = metric.create("pearsonr")
+    m.update(nd.array([1.0, 2.0, 3.0]), nd.array([2.0, 4.0, 6.0]))
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    it = io.NDArrayIter(X, np.arange(10), batch_size=4, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+    desc = it.provide_data[0]
+    assert desc.shape == (4, 2)
+
+
+def test_mnist_iter_synthetic():
+    it = io.MNISTIter(batch_size=32, num_examples=100)
+    b = next(iter(it))
+    assert b.data[0].shape == (32, 1, 28, 28)
+
+
+def test_prefetching_iter():
+    X = np.random.randn(16, 2).astype(np.float32)
+    base = io.NDArrayIter(X, np.arange(16), batch_size=4)
+    pf = io.PrefetchingIter(base)
+    assert len(list(pf)) == 4
+    pf.reset()
+    assert len(list(pf)) == 4
+
+
+def test_array_dataset_and_loader():
+    X = np.random.randn(10, 3).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 10
+    xb, yb = ds[3]
+    loader = gdata.DataLoader(ds, batch_size=4, shuffle=True, last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0][0].shape == (4, 3)
+
+
+def test_loader_workers_match_serial():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    ds = gdata.ArrayDataset(X)
+    serial = [b.asnumpy() for b in gdata.DataLoader(ds, batch_size=4)]
+    threaded = [b.asnumpy() for b in gdata.DataLoader(ds, batch_size=4, num_workers=3)]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_transform_shard_take():
+    ds = gdata.SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    s = ds.shard(3, 1)
+    assert list(s[i] for i in range(len(s))) == [1, 4, 7]
+    assert len(ds.take(4)) == 4
+
+
+def test_vision_mnist_and_transforms():
+    ds = vision.MNIST(train=False)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    t = vision.transforms.Compose([
+        vision.transforms.Resize(32),
+        vision.transforms.CenterCrop(28),
+        vision.transforms.ToTensor(),
+        vision.transforms.Normalize(0.5, 0.5),
+    ])
+    out = t(img)
+    assert out.shape == (1, 28, 28)
+
+
+def test_cifar_synthetic():
+    ds = vision.CIFAR10(train=False)
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert 0 <= int(label) < 10
+
+
+def test_batch_sampler_rollover():
+    s = gdata.BatchSampler(gdata.SequentialSampler(5), 2, "rollover")
+    first = list(s)
+    assert len(first) == 2
+    second = list(s)
+    assert second[0][0] == 4  # rolled-over sample leads

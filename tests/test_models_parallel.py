@@ -1,0 +1,112 @@
+"""Model zoo + fused/distributed train step (SURVEY.md §2.19, §2.22)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.models import get_model
+from incubator_mxnet_tpu.parallel import FusedTrainStep, make_mesh
+
+
+def test_resnet18_shapes():
+    net = get_model("resnet18_v1", classes=10, layout="NHWC")
+    net.initialize()
+    out = net(nd.ones((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet_v2_shapes():
+    net = get_model("resnet18_v2", classes=7, layout="NHWC")
+    net.initialize()
+    assert net(nd.ones((2, 32, 32, 3))).shape == (2, 7)
+
+
+def test_resnet50_param_count():
+    net = get_model("resnet50_v1", classes=1000, layout="NHWC")
+    net.initialize()
+    net(nd.ones((1, 64, 64, 3)))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values()
+                   if p.grad_req != "null")
+    # reference ResNet-50 ~25.5M learnable params
+    assert 25e6 < n_params < 26e6, n_params
+
+
+def test_lenet_forward():
+    net = get_model("lenet")
+    net.initialize()
+    assert net(nd.ones((4, 1, 28, 28))).shape == (4, 10)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        get_model("resnet999")
+
+
+def test_fused_step_single_device():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = get_model("lenet")
+    net.initialize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = FusedTrainStep(net, L, "adam")
+    x = nd.array(np.random.randn(8, 1, 28, 28).astype(np.float32))
+    y = nd.array(np.random.randint(0, 10, 8))
+    l0 = float(step(x, y))
+    for _ in range(25):
+        l = float(step(x, y))
+    assert l < l0 * 0.5
+
+
+def test_fused_step_dp_mesh_matches_single():
+    """dp-sharded fused step must equal the single-device step numerically."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    np.random.seed(0)
+    mx.random.seed(0)
+    x = nd.array(np.random.randn(16, 10).astype(np.float32))
+    y = nd.array(np.random.randint(0, 3, 16))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def train(mesh, steps=5):
+        np.random.seed(1)
+        mx.random.seed(1)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+        net.initialize(init=mx.init.Xavier())
+        step = FusedTrainStep(net, L, mx.optimizer.create(
+            "sgd", learning_rate=0.1, momentum=0.9), mesh=mesh)
+        losses = [float(step(x, y)) for _ in range(steps)]
+        return losses
+
+    single = train(None)
+    dp = train(make_mesh({"dp": 8}))
+    np.testing.assert_allclose(single, dp, rtol=2e-4, atol=1e-5)
+
+
+def test_fused_step_batchnorm_aux():
+    """BatchNorm running stats must update through the fused step."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm())
+    net.initialize()
+    L = gluon.loss.L2Loss()
+    step = FusedTrainStep(net, L, "sgd")
+    x = nd.array(np.random.randn(16, 4).astype(np.float32) + 3)
+    y = nd.array(np.random.randn(16, 8).astype(np.float32))
+    step(x, y)
+    bn = net[1]
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).max() > 0
+
+
+def test_mesh_helpers():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    m = make_mesh({"dp": 2, "tp": -1})
+    assert m.shape["dp"] == 2 and m.shape["tp"] == 4
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 64})
