@@ -23,6 +23,12 @@ from . import initializer as init
 from . import optimizer
 from . import kvstore
 from . import gluon
+from . import symbol
+from . import symbol as sym
+from . import module
+from . import module as mod
+from . import metric
+from . import io
 
 from .ndarray import NDArray
 from .ndarray import random as _ndrandom

@@ -139,13 +139,23 @@ class RecordFileDataset(Dataset):
                 f.seek(length + pad, 1)
                 pos = f.tell()
 
+    def _handle(self):
+        # One handle per (dataset, pid): reopen after fork so DataLoader
+        # workers don't share a seek position (reference IndexedRecordIO
+        # keeps a persistent handle the same way).
+        import os
+        if getattr(self, "_fh_pid", None) != os.getpid():
+            self._fh = open(self._filename, "rb")
+            self._fh_pid = os.getpid()
+        return self._fh
+
     def __getitem__(self, idx):
         import struct
-        with open(self._filename, "rb") as f:
-            f.seek(self._offsets[idx])
-            magic, lrec = struct.unpack("<II", f.read(8))
-            length = lrec & ((1 << 29) - 1)
-            return f.read(length)
+        f = self._handle()
+        f.seek(self._offsets[idx])
+        magic, lrec = struct.unpack("<II", f.read(8))
+        length = lrec & ((1 << 29) - 1)
+        return f.read(length)
 
     def __len__(self):
         return len(self._offsets)

@@ -130,7 +130,7 @@ class CropResize(HybridBlock):
 class RandomFlipLeftRight(Block):
     def forward(self, x):
         if float(ndrandom.uniform(shape=(1,)).asnumpy()[0]) < 0.5:
-            return x.flip(axis=-2 if x.ndim == 3 else -2)
+            return x.flip(axis=-2)  # W axis in both HWC and NHWC
         return x
 
 

@@ -62,10 +62,14 @@ class NDArrayIter(DataIter):
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data", label_name="softmax_label"):
         super().__init__(batch_size)
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise ValueError(f"unknown last_batch_handle {last_batch_handle!r}; "
+                             "expected 'pad', 'discard' or 'roll_over'")
         self.data = self._normalize(data, data_name)
         self.label = self._normalize(label, label_name)
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        self._rollover = np.array([], dtype=np.int64)
         self.num_data = self.data[0][1].shape[0]
         self.cursor = -batch_size
         self._order = np.arange(self.num_data)
@@ -99,12 +103,18 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        order = np.arange(self.num_data)
         if self.shuffle:
-            np.random.shuffle(self._order)
+            np.random.shuffle(order)
+        if self.last_batch_handle == "roll_over" and len(self._rollover):
+            # reference semantics: last epoch's leftover samples lead off
+            order = np.concatenate([self._rollover, order])
+            self._rollover = np.array([], dtype=np.int64)
+        self._order = order
 
     def iter_next(self):
         self.cursor += self.batch_size
-        return self.cursor < self.num_data
+        return self.cursor < len(self._order)
 
     def next(self):
         if not self.iter_next():
@@ -113,6 +123,9 @@ class NDArrayIter(DataIter):
         pad = 0
         if len(idx) < self.batch_size:
             if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle == "roll_over":
+                self._rollover = np.asarray(idx)
                 raise StopIteration
             pad = self.batch_size - len(idx)
             idx = np.concatenate([idx, self._order[:pad]])
@@ -207,6 +220,7 @@ class PrefetchingIter(DataIter):
         super().__init__(iters[0].batch_size)
         self._queue = queue.Queue(maxsize=4)
         self._stop = False
+        self._exhausted = False
         self._thread = None
         self._start()
 
@@ -250,11 +264,15 @@ class PrefetchingIter(DataIter):
         self._thread.join()
         self.iters[0].reset()
         self._stop = False
+        self._exhausted = False
         self._start()
 
     def next(self):
+        if self._exhausted:   # sentinel already consumed; worker is dead
+            raise StopIteration
         item = self._queue.get()
         if item is None:
+            self._exhausted = True
             raise StopIteration
         if isinstance(item, Exception):
             raise item

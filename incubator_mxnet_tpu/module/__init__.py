@@ -1,0 +1,326 @@
+"""Module API (parity: python/mxnet/module/module.py).
+
+Module = intermediate/high-level trainer around a bound Symbol: bind →
+init_params → init_optimizer → per-batch forward/backward/update, plus
+`fit`, `score`, `predict` and checkpoint callbacks — the reference's
+`mod.fit(train_iter, ...)` training loop, running on the jitted Executor
+(forward+backward each one XLA computation).
+
+Checkpoint format mirrors the reference (`prefix-symbol.json` +
+`prefix-NNNN.params`), via `save_checkpoint` / `load_checkpoint`.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import initializer as init_mod
+from .. import metric as metric_mod
+from .. import optimizer as opt_mod
+from ..io import DataBatch, DataDesc
+from ..ndarray import NDArray
+from ..ndarray import random as ndrandom
+from .. import symbol as sym_mod
+
+__all__ = ["Module", "BaseModule", "save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Parity: mx.model.save_checkpoint — symbol json + params file."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    from .. import ndarray as nd
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Parity: mx.model.load_checkpoint → (symbol, arg_params, aux_params)."""
+    from .. import ndarray as nd
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    loaded = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, name = k.split(":", 1)
+        (arg_params if tp == "arg" else aux_params)[name] = v
+    return symbol, arg_params, aux_params
+
+
+class BaseModule:
+    """Shared high-level loop (parity: module/base_module.py)."""
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            n = batch.data[0].shape[0] - batch.pad
+            outputs.append(outs[0].asnumpy()[:n])
+        from .. import ndarray as nd
+        return nd.array(np.concatenate(outputs, axis=0))
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd", optimizer_params=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_init=False, begin_epoch=0,
+            num_epoch=None, validation_metric=None):
+        """Parity: BaseModule.fit — the classic epoch/batch training loop."""
+        assert num_epoch is not None, "num_epoch is required"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params or {})
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self._symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    logging.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 fixed_param_names=None):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed = set(fixed_param_names or [])
+        self._ctx = context
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    # -- bind -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if isinstance(desc, DataDesc) \
+                else (desc[0], desc[1])
+            shapes[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                name, shape = (desc.name, desc.shape) \
+                    if isinstance(desc, DataDesc) else (desc[0], desc[1])
+                shapes[name] = tuple(shape)
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed:
+                req[n] = "null"
+            else:
+                req[n] = grad_req
+        self._exec = self._symbol.simple_bind(self._ctx, grad_req=req, **shapes)
+        self.binded = True
+        self.for_training = for_training
+        self._data_shapes = shapes
+
+    # -- params -----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        assert self.binded, "bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and getattr(self, "_preloaded", None):
+            # Module.load(...) path: checkpoint weights win over re-init so
+            # the reference's load→fit resume workflow keeps them.
+            arg_params, aux_params = self._preloaded
+        initializer = initializer or init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                src = arg_params[name]
+                arr._data = jnp.asarray(
+                    src.asnumpy() if isinstance(src, NDArray) else src,
+                    arr._data.dtype)
+            else:
+                ini = initializer
+                if isinstance(ini, init_mod.Mixed):
+                    ini = ini.init_for(name)
+                elif _is_special(name):
+                    ini = _special_init(name)
+                arr._data = ini(ndrandom._key(), arr.shape, arr._data.dtype)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params and name in aux_params:
+                src = aux_params[name]
+                arr._data = jnp.asarray(
+                    src.asnumpy() if isinstance(src, NDArray) else src,
+                    arr._data.dtype)
+            else:
+                if name.endswith("moving_var") or name.endswith("running_var"):
+                    arr._data = jnp.ones(arr.shape, arr._data.dtype)
+                else:
+                    arr._data = jnp.zeros(arr.shape, arr._data.dtype)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    # -- optimizer --------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._opt_states = {
+            n: optimizer.create_state(i, self._exec.arg_dict[n]._data)
+            for i, n in enumerate(self._param_names)}
+        self._num_update = 0
+        self.optimizer_initialized = True
+
+    # -- execution --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        self._num_update += 1
+        self._optimizer.num_update = self._num_update
+        for i, n in enumerate(self._param_names):
+            w = self._exec.arg_dict[n]
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            lr, wd = self._optimizer._get_lr_wd(i)
+            new_w, new_s = self._optimizer.update_step(
+                w._data, g._data, self._opt_states[n], lr, wd,
+                self._num_update, rescale=self._optimizer.rescale_grad,
+                clip=self._optimizer.clip_gradient)
+            w._data = new_w
+            self._opt_states[n] = new_s
+
+    def get_outputs(self):
+        return self._exec.outputs
+
+    def get_input_grads(self):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpoint -------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        return mod
+
+
+
+def _is_special(name):
+    return name.endswith(("_bias", "_beta", "_gamma", "_moving_mean",
+                          "_moving_var"))
+
+
+def _special_init(name):
+    if name.endswith(("_gamma", "_moving_var")):
+        return init_mod.One()
+    return init_mod.Zero()

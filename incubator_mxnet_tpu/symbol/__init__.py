@@ -1,0 +1,516 @@
+"""Symbol API (parity: python/mxnet/symbol/symbol.py, nnvm graph).
+
+A Symbol is an immutable DAG of pure ops over named Variables. Where the
+reference lowers the graph through nnvm into a C++ Executor, here `bind`
+traces the graph ONCE into a single `jax.jit` computation (forward) and a
+jitted `jax.vjp` pullback (backward) — the whole symbolic program becomes
+one fused XLA executable per signature, which is the TPU-native meaning of
+`simple_bind`.
+
+Key surfaces (reference: python/mxnet/symbol/symbol.py):
+  sym.Variable / sym.var, op mirrors (FullyConnected, Convolution, ...),
+  arithmetic operators, infer_shape / infer_type, list_arguments /
+  list_outputs / list_auxiliary_states, Group, tojson / load_json,
+  bind / simple_bind -> executor.Executor.
+
+Classic output ops (SoftmaxOutput, LinearRegressionOutput, ...) keep their
+reference backward semantics (src/operator/softmax_output.cc: grad =
+p - one_hot(label), ignoring head gradients) via `jax.custom_vjp`.
+"""
+from __future__ import annotations
+
+import json as _json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import normalize_dtype
+from ..ops import _raw
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load_json", "load"]
+
+
+# ---------------------------------------------------------------------------
+# graph model
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "user_attrs")
+
+    def __init__(self, op, name, attrs=None, inputs=(), is_aux=False):
+        self.op = op                    # None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})  # op hyper-params (json-serializable)
+        self.inputs = list(inputs)      # list of (node, out_index)
+        self.is_aux = is_aux            # variable holds auxiliary state
+        self.user_attrs = {}            # __attrs__ from user (lr_mult etc.)
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+
+_NAME_COUNTER = {}
+
+
+def _auto_name(hint):
+    i = _NAME_COUNTER.get(hint, 0)
+    _NAME_COUNTER[hint] = i + 1
+    return f"{hint}{i}"
+
+
+def _topo(entries):
+    """Topological order of nodes reachable from output entries."""
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for n, _ in node.inputs:
+            visit(n)
+        order.append(node)
+
+    for n, _ in entries:
+        visit(n)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# op registry
+# ---------------------------------------------------------------------------
+
+class _OpDef:
+    __slots__ = ("name", "fn", "arg_names", "aux_pos", "n_out", "infer_hint")
+
+    def __init__(self, name, fn, arg_names, aux_pos=(), n_out=None,
+                 infer_hint=None):
+        self.name = name
+        self.fn = fn                  # fn(rt, attrs, *raw_inputs) -> raw | tuple
+        self.arg_names = arg_names    # suffixes for auto-created inputs
+        self.aux_pos = tuple(aux_pos)
+        self.n_out = n_out            # None=1, or callable(attrs)->int
+        self.infer_hint = infer_hint  # (in_shapes, attrs) -> partial fills
+
+
+_OPS: dict[str, _OpDef] = {}
+
+
+def register_op(name, fn, arg_names, aux_pos=(), n_out=None, infer_hint=None):
+    _OPS[name] = _OpDef(name, fn, arg_names, aux_pos, n_out, infer_hint)
+
+
+def _num_outputs(node):
+    od = _OPS[node.op]
+    if od.n_out is None:
+        return 1
+    return od.n_out(node.attrs) if callable(od.n_out) else od.n_out
+
+
+class _Runtime:
+    """Per-execution context threaded through op fns: train flag + rng."""
+
+    __slots__ = ("is_train", "_key", "aux_updates")
+
+    def __init__(self, is_train, key):
+        self.is_train = is_train
+        self._key = key
+        self.aux_updates = {}     # id(var_node) -> new raw value
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+class Symbol:
+    """Handle to one or more output entries of the graph."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, entries):
+        self._entries = list(entries)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def attr(self, key):
+        return self._entries[0][0].user_attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._entries[0][0].user_attrs.update(kwargs)
+        return self
+
+    def list_attr(self):
+        return dict(self._entries[0][0].user_attrs)
+
+    def __repr__(self):
+        outs = ", ".join(self._out_names())
+        return f"<Symbol {outs}>"
+
+    # -- graph queries ----------------------------------------------------
+    def list_arguments(self):
+        return [n.name for n in _topo(self._entries) if n.is_var and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in _topo(self._entries) if n.is_var and n.is_aux]
+
+    def _out_names(self):
+        names = []
+        for node, idx in self._entries:
+            base = node.name
+            if node.is_var:
+                names.append(base)
+            elif _num_outputs(node) > 1:
+                names.append(f"{base}_output{idx}")
+            else:
+                names.append(f"{base}_output")
+        return names
+
+    def list_outputs(self):
+        return self._out_names()
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._entries) if n.is_var]
+
+    def get_internals(self):
+        """All node outputs as a grouped Symbol (parity: sym.get_internals)."""
+        entries = []
+        for node in _topo(self._entries):
+            for i in range(1 if node.is_var else _num_outputs(node)):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._entries[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for node, i in self.get_internals()._entries:
+                names = Symbol([(node, i)])._out_names()
+                if names[0] == index or node.name == index:
+                    return Symbol([(node, i)])
+            raise ValueError(f"no output named {index!r}")
+        return Symbol([self._entries[index]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    # -- arithmetic -------------------------------------------------------
+    def __add__(self, other):
+        return _elemwise("_plus", self, other)
+
+    def __radd__(self, other):
+        return _elemwise("_plus", self, other)
+
+    def __sub__(self, other):
+        return _elemwise("_minus", self, other)
+
+    def __rsub__(self, other):
+        return _elemwise("_rminus", self, other)
+
+    def __mul__(self, other):
+        return _elemwise("_mul", self, other)
+
+    def __rmul__(self, other):
+        return _elemwise("_mul", self, other)
+
+    def __truediv__(self, other):
+        return _elemwise("_div", self, other)
+
+    def __rtruediv__(self, other):
+        return _elemwise("_rdiv", self, other)
+
+    def __pow__(self, other):
+        return _elemwise("_power", self, other)
+
+    def __neg__(self):
+        return _make_op("negative", [self])
+
+    # -- shape / type inference ------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Forward shape inference + parameter-shape filling.
+
+        Mirrors the reference's nnvm InferShape pass: data shapes in, every
+        argument/output/aux shape out (layer hints fill weight shapes the
+        way deferred shape inference does in Gluon).
+        """
+        shapes, dtypes = self._infer(kwargs, {})
+        args = [shapes.get(n) for n in self.list_arguments()]
+        auxs = [shapes.get(n) for n in self.list_auxiliary_states()]
+        outs = [shapes.get(e) for e in self._entry_keys()]
+        return args, outs, auxs
+
+    def infer_type(self, **kwargs):
+        """Dtype propagation without shapes: unknown variables adopt the
+        promoted dtype of their consumers' known inputs (the common
+        same-dtype rule of the reference's InferType pass)."""
+        order = _topo(self._entries)
+        dt = {}
+        for node in order:
+            if node.is_var and node.name in kwargs:
+                dt[id(node)] = np.dtype(normalize_dtype(kwargs[node.name]))
+        for _ in range(len(order) + 1):
+            progress = False
+            for node in order:
+                if node.is_var:
+                    continue
+                in_dts = [dt.get(id(n)) for n, _ in node.inputs]
+                known = [d for d in in_dts if d is not None]
+                if not known:
+                    continue
+                prom = known[0]
+                for d in known[1:]:
+                    prom = np.promote_types(prom, d)
+                for (n, _), d in zip(node.inputs, in_dts):
+                    if d is None and id(n) not in dt:
+                        dt[id(n)] = prom
+                        progress = True
+                if id(node) not in dt:
+                    dt[id(node)] = prom
+                    progress = True
+            if not progress:
+                break
+        name2dt = {n.name: dt.get(id(n)) for n in order if n.is_var}
+        args = [name2dt.get(n) for n in self.list_arguments()]
+        auxs = [name2dt.get(n) for n in self.list_auxiliary_states()]
+        outs = [dt.get(id(n)) for n, _ in self._entries]
+        return args, outs, auxs
+
+    def _entry_keys(self):
+        return [(id(n), i) for n, i in self._entries]
+
+    def _infer(self, shape_kwargs, dtype_kwargs):
+        """Iterate: hint-fill variable shapes, then eval_shape ops whose
+        inputs are fully known. Returns ({name|entrykey: shape}, {...: dtype})."""
+        order = _topo(self._entries)
+        var_shape = dict(shape_kwargs)
+        var_dtype = {k: normalize_dtype(v) for k, v in dtype_kwargs.items()}
+        known = {}   # (id(node), idx) -> jax.ShapeDtypeStruct
+
+        for _ in range(len(order) + 2):   # fixed-point; graph is a DAG
+            progress = False
+            for node in order:
+                if node.is_var:
+                    key = (id(node), 0)
+                    if key not in known and node.name in var_shape:
+                        dt = var_dtype.get(node.name, jnp.float32)
+                        known[key] = jax.ShapeDtypeStruct(
+                            tuple(var_shape[node.name]), dt)
+                        progress = True
+                    continue
+                od = _OPS[node.op]
+                in_specs = [known.get((id(n), i)) for n, i in node.inputs]
+                if any(s is None for s in in_specs) and od.infer_hint:
+                    fills = od.infer_hint(
+                        [None if s is None else s.shape for s in in_specs],
+                        node.attrs)
+                    if fills:
+                        for pos, shape in fills.items():
+                            n, i = node.inputs[pos]
+                            if n.is_var and n.name not in var_shape:
+                                var_shape[n.name] = tuple(shape)
+                                progress = True
+                    in_specs = [known.get((id(n), i)) for n, i in node.inputs]
+                if any(s is None for s in in_specs):
+                    continue
+                if (id(node), 0) in known:
+                    continue
+                rt = _Runtime(False, jax.random.PRNGKey(0))
+                out = jax.eval_shape(
+                    lambda *raws, _n=node, _rt=rt: _OPS[_n.op].fn(_rt, _n.attrs, *raws),
+                    *in_specs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                n_real = _num_outputs(node)
+                for i in range(n_real):
+                    known[(id(node), i)] = outs[i]
+                progress = True
+            if not progress:
+                break
+
+        shapes, dtypes = {}, {}
+        for node in order:
+            if node.is_var:
+                spec = known.get((id(node), 0))
+                if spec is not None:
+                    shapes[node.name] = tuple(spec.shape)
+                    dtypes[node.name] = spec.dtype
+                elif node.name in var_shape:
+                    shapes[node.name] = tuple(var_shape[node.name])
+        for node, i in self._entries:
+            spec = known.get((id(node), i))
+            if spec is not None:
+                shapes[(id(node), i)] = tuple(spec.shape)
+                dtypes[(id(node), i)] = spec.dtype
+        return shapes, dtypes
+
+    # -- evaluation -------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, **kwargs):
+        from .executor import simple_bind
+        return simple_bind(self, ctx, grad_req, type_dict, **kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot evaluation: bind with the given arrays and run forward."""
+        ex = self.bind(ctx, args=kwargs, grad_req="null")
+        return ex.forward(is_train=False)
+
+    # -- serialization ----------------------------------------------------
+    def tojson(self):
+        """Graph JSON (same role as the reference's nnvm::Graph json)."""
+        order = _topo(self._entries)
+        idx = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": "null" if n.is_var else n.op,
+                "name": n.name,
+                "attrs": _jsonable(n.attrs),
+                "inputs": [[idx[id(m)], i] for m, i in n.inputs],
+                "is_aux": n.is_aux,
+                "user_attrs": _jsonable(n.user_attrs),
+            })
+        heads = [[idx[id(n)], i] for n, i in self._entries]
+        return _json.dumps({"nodes": nodes, "heads": heads,
+                            "format": "incubator_mxnet_tpu-symbol-v1"},
+                           indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def _jsonable(d):
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+def load_json(json_str):
+    import re as _re
+    data = _json.loads(json_str)
+    # Bump auto-name counters past loaded names so new ops composed onto a
+    # loaded graph in a fresh process cannot collide with them.
+    for nd_ in data["nodes"]:
+        m = _re.fullmatch(r"([a-z_]+?)(\d+)", nd_["name"])
+        if m:
+            hint, i = m.group(1), int(m.group(2))
+            if _NAME_COUNTER.get(hint, 0) <= i:
+                _NAME_COUNTER[hint] = i + 1
+    nodes = []
+    for nd_ in data["nodes"]:
+        op = None if nd_["op"] == "null" else nd_["op"]
+        attrs = {k: tuple(v) if isinstance(v, list) else v
+                 for k, v in nd_.get("attrs", {}).items()}
+        node = _Node(op, nd_["name"], attrs,
+                     [(nodes[i], j) for i, j in nd_.get("inputs", [])],
+                     is_aux=nd_.get("is_aux", False))
+        node.user_attrs = dict(nd_.get("user_attrs", {}))
+        nodes.append(node)
+    return Symbol([(nodes[i], j) for i, j in data["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def Variable(name, shape=None, dtype=None, init=None, lr_mult=None,
+             wd_mult=None, **kwargs):
+    node = _Node(None, name)
+    if shape is not None:
+        node.user_attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        node.user_attrs["__dtype__"] = str(np.dtype(normalize_dtype(dtype)))
+    if lr_mult is not None:
+        node.user_attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        node.user_attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        node.user_attrs["__init__"] = str(init)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return _make_op("_zeros", [], attrs={"shape": tuple(shape), "dtype": str(dtype)},
+                    name=name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return _make_op("_ones", [], attrs={"shape": tuple(shape), "dtype": str(dtype)},
+                    name=name)
+
+
+# ---------------------------------------------------------------------------
+# op application
+# ---------------------------------------------------------------------------
+
+def _make_op(op, inputs, attrs=None, name=None):
+    """Create an op node. `inputs` are Symbols (single-entry) or None for
+    auto-created parameter variables (named {name}_{argname}, like the
+    reference's auto `fc1_weight`)."""
+    od = _OPS[op]
+    name = name or _auto_name(op.lower().lstrip("_"))
+    entries = []
+    for pos, s in enumerate(inputs):
+        if s is None:
+            argname = od.arg_names[pos] if pos < len(od.arg_names) else f"in{pos}"
+            vnode = _Node(None, f"{name}_{argname}", is_aux=pos in od.aux_pos)
+            entries.append((vnode, 0))
+        else:
+            if len(s._entries) != 1:
+                raise ValueError(f"op {op} input {pos}: expected single-output "
+                                 f"symbol, got {len(s._entries)} outputs")
+            node, idx = s._entries[0]
+            if pos in od.aux_pos and node.is_var:
+                node.is_aux = True
+            entries.append((node, idx))
+    node = _Node(op, name, attrs or {}, entries)
+    n_out = _num_outputs(node)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _elemwise(op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return _make_op(op, [lhs, rhs])
+    return _make_op(op + "_scalar", [lhs], attrs={"scalar": float(rhs)})
+
+
+from . import _register  # noqa: E402,F401  (populates the op registry)
+from .executor import Executor  # noqa: E402

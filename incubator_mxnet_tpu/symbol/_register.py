@@ -1,0 +1,595 @@
+"""Symbol op registry: pure-jax op fns + shape-inference hints + the
+symbol-level builder functions (sym.FullyConnected, ...).
+
+Op fns take (rt, attrs, *raw_inputs) and return a raw array or tuple. Ops
+with auxiliary inputs (BatchNorm moving stats) return (out, *new_aux) and
+declare aux_pos; the executor writes new aux back after forward, matching
+the reference's in-place aux update (src/operator/nn/batch_norm.cc).
+
+Output-layer ops keep classic MXNet backward semantics via jax.custom_vjp:
+SoftmaxOutput's gradient is (p - one_hot(label)) * grad_scale regardless of
+head gradients (src/operator/softmax_output-inl.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import normalize_dtype
+from ..ops import _raw
+from . import Symbol, _make_op, register_op
+
+import sys as _sys
+
+_sym_mod = _sys.modules["incubator_mxnet_tpu.symbol"]
+
+
+# ---------------------------------------------------------------------------
+# elementwise / scalar
+# ---------------------------------------------------------------------------
+
+def _reg_binary(name, jfn):
+    register_op(name, lambda rt, a, x, y: jfn(x, y), ("lhs", "rhs"))
+
+
+def _reg_scalar(name, jfn):
+    register_op(name + "_scalar",
+                lambda rt, a, x: jfn(x, a["scalar"]), ("data",))
+
+
+_reg_binary("_plus", jnp.add)
+_reg_binary("_minus", jnp.subtract)
+_reg_binary("_rminus", lambda x, y: y - x)
+_reg_binary("_mul", jnp.multiply)
+_reg_binary("_div", jnp.divide)
+_reg_binary("_rdiv", lambda x, y: y / x)
+_reg_binary("_power", jnp.power)
+_reg_binary("_rpower", lambda x, y: jnp.power(y, x))
+for _n in ("add", "sub", "mul", "div", "maximum", "minimum", "power",
+           "equal", "not_equal", "greater", "greater_equal", "lesser",
+           "lesser_equal"):
+    _jf = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide, "maximum": jnp.maximum, "minimum": jnp.minimum,
+           "power": jnp.power, "equal": jnp.equal, "not_equal": jnp.not_equal,
+           "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+           "lesser": jnp.less, "lesser_equal": jnp.less_equal}[_n]
+    _is_cmp = _n in ("equal", "not_equal", "greater", "greater_equal",
+                     "lesser", "lesser_equal")
+    _reg_binary("broadcast_" + _n,
+                (lambda x, y, _f=_jf: _f(x, y).astype(x.dtype)) if _is_cmp
+                else (lambda x, y, _f=_jf: _f(x, y)))
+
+_reg_scalar("_plus", lambda x, s: x + s)
+_reg_scalar("_minus", lambda x, s: x - s)
+_reg_scalar("_rminus", lambda x, s: s - x)
+_reg_scalar("_mul", lambda x, s: x * s)
+_reg_scalar("_div", lambda x, s: x / s)
+_reg_scalar("_rdiv", lambda x, s: s / x)
+_reg_scalar("_power", lambda x, s: jnp.power(x, s))
+_reg_scalar("_rpower", lambda x, s: jnp.power(s, x))
+
+
+def _reg_unary(name, jfn):
+    register_op(name, lambda rt, a, x: jfn(x), ("data",))
+
+
+for _name, _fn in {
+    "negative": jnp.negative, "exp": jnp.exp, "log": jnp.log,
+    "sqrt": jnp.sqrt, "square": jnp.square, "abs": jnp.abs,
+    "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu,
+    "erf": jax.lax.erf, "rsqrt": jax.lax.rsqrt,
+    "sin": jnp.sin, "cos": jnp.cos, "sign": jnp.sign,
+    "BlockGrad": jax.lax.stop_gradient, "stop_gradient": jax.lax.stop_gradient,
+    "zeros_like": jnp.zeros_like, "ones_like": jnp.ones_like,
+    "MakeLoss": lambda x: x,
+}.items():
+    _reg_unary(_name, _fn)
+
+register_op("_zeros", lambda rt, a: jnp.zeros(tuple(a["shape"]),
+                                              normalize_dtype(a["dtype"])), ())
+register_op("_ones", lambda rt, a: jnp.ones(tuple(a["shape"]),
+                                            normalize_dtype(a["dtype"])), ())
+register_op("softmax", lambda rt, a, x: jax.nn.softmax(x, axis=a.get("axis", -1)),
+            ("data",))
+register_op("log_softmax",
+            lambda rt, a, x: jax.nn.log_softmax(x, axis=a.get("axis", -1)),
+            ("data",))
+register_op("clip", lambda rt, a, x: jnp.clip(x, a["a_min"], a["a_max"]),
+            ("data",))
+register_op("dot", lambda rt, a, x, y: jnp.dot(x, y), ("lhs", "rhs"))
+register_op("batch_dot", lambda rt, a, x, y: jnp.einsum(
+    "bij,bjk->bik",
+    x if not a.get("transpose_a") else jnp.swapaxes(x, -1, -2),
+    y if not a.get("transpose_b") else jnp.swapaxes(y, -1, -2)),
+    ("lhs", "rhs"))
+
+# -- shape manipulation -----------------------------------------------------
+register_op("Flatten", lambda rt, a, x: x.reshape(x.shape[0], -1), ("data",))
+register_op("Reshape", lambda rt, a, x: _mx_reshape(x, tuple(a["shape"])),
+            ("data",))
+register_op("transpose",
+            lambda rt, a, x: jnp.transpose(x, a.get("axes") or None), ("data",))
+register_op("expand_dims", lambda rt, a, x: jnp.expand_dims(x, a["axis"]),
+            ("data",))
+register_op("squeeze", lambda rt, a, x: jnp.squeeze(x, a.get("axis")), ("data",))
+register_op("Concat",
+            lambda rt, a, *xs: jnp.concatenate(xs, axis=a.get("dim", 1)),
+            ())
+register_op("stack", lambda rt, a, *xs: jnp.stack(xs, axis=a.get("axis", 0)), ())
+register_op("slice_axis",
+            lambda rt, a, x: jax.lax.slice_in_dim(
+                x, a["begin"], x.shape[a["axis"]] if a.get("end") is None else a["end"],
+                axis=a["axis"]),
+            ("data",))
+register_op("SliceChannel",
+            lambda rt, a, x: tuple(
+                jnp.squeeze(p, a.get("axis", 1)) if a.get("squeeze_axis") else p
+                for p in jnp.split(x, a["num_outputs"], axis=a.get("axis", 1))),
+            ("data",), n_out=lambda a: a["num_outputs"])
+
+for _name, _ax in (("sum", None), ("mean", None), ("max", None), ("min", None),
+                   ("prod", None)):
+    register_op(_name, lambda rt, a, x, _f=getattr(jnp, _name): _f(
+        x, axis=a.get("axis"), keepdims=bool(a.get("keepdims", False))),
+        ("data",))
+register_op("argmax", lambda rt, a, x: jnp.argmax(
+    x, axis=a.get("axis")).astype(jnp.float32), ("data",))
+
+
+def _mx_reshape(x, shape):
+    """MXNet Reshape with 0 (copy dim) and -1 (infer) specials."""
+    out = []
+    for i, s in enumerate(shape):
+        out.append(x.shape[i] if s == 0 else s)
+    return x.reshape(tuple(out))
+
+
+# ---------------------------------------------------------------------------
+# NN layers
+# ---------------------------------------------------------------------------
+
+def _fc_hint(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    nh = attrs["num_hidden"]
+    in_units = int(np.prod(d[1:])) if attrs.get("flatten", True) else d[-1]
+    fills = {}
+    if len(in_shapes) > 1 and in_shapes[1] is None:
+        fills[1] = (nh, in_units)
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        fills[2] = (nh,)
+    return fills
+
+
+register_op(
+    "FullyConnected",
+    lambda rt, a, x, w, *b: _raw.dense(x, w, b[0] if b else None,
+                                       a.get("flatten", True)),
+    ("data", "weight", "bias"), infer_hint=_fc_hint)
+
+
+def _conv_hint(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    layout = attrs.get("layout") or "NCHW"
+    c_in = d[1] if layout.startswith("NC") else d[-1]
+    k = tuple(attrs["kernel"])
+    nf = attrs["num_filter"]
+    g = attrs.get("num_group", 1)
+    fills = {}
+    if len(in_shapes) > 1 and in_shapes[1] is None:
+        if layout == "NHWC":
+            fills[1] = k + (c_in // g, nf)
+        else:
+            fills[1] = (nf, c_in // g) + k
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        fills[2] = (nf,)
+    return fills
+
+
+register_op(
+    "Convolution",
+    lambda rt, a, x, w, *b: _raw.conv(
+        x, w, b[0] if b else None, kernel=a.get("kernel"),
+        stride=a.get("stride"), pad=a.get("pad"), dilate=a.get("dilate"),
+        num_group=a.get("num_group", 1), layout=a.get("layout") or "NCHW"),
+    ("data", "weight", "bias"), infer_hint=_conv_hint)
+
+register_op(
+    "Deconvolution",
+    lambda rt, a, x, w, *b: _raw.conv_transpose(
+        x, w, b[0] if b else None, stride=a.get("stride"), pad=a.get("pad"),
+        dilate=a.get("dilate"), adj=a.get("adj"),
+        num_group=a.get("num_group", 1), layout=a.get("layout") or "NCHW"),
+    ("data", "weight", "bias"))
+
+register_op(
+    "Pooling",
+    lambda rt, a, x: _raw.pooling(
+        x, a.get("pool_type", "max"), tuple(a.get("kernel", (2, 2))),
+        a.get("stride"), a.get("pad"), a.get("global_pool", False),
+        a.get("count_include_pad", True), a.get("layout") or "NCHW",
+        a.get("ceil_mode", False)),
+    ("data",))
+
+register_op(
+    "Activation",
+    lambda rt, a, x: _raw.activation(x, a.get("act_type", "relu")), ("data",))
+
+register_op(
+    "LeakyReLU",
+    lambda rt, a, x: jax.nn.leaky_relu(x, a.get("slope", 0.25))
+    if a.get("act_type", "leaky") == "leaky"
+    else _raw.activation(x, a["act_type"]),
+    ("data",))
+
+
+def _channel_hint_at(axis_attr_default):
+    def hint(in_shapes, attrs):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        axis = attrs.get("axis", axis_attr_default)
+        c = d[axis % len(d)]
+        return {i: (c,) for i in range(1, len(in_shapes)) if in_shapes[i] is None}
+    return hint
+
+
+def _batch_norm_fn(rt, a, x, gamma, beta, mm, mv):
+    y, new_mm, new_mv = _raw.batch_norm(
+        x, gamma, beta, mm, mv, axis=a.get("axis", 1), eps=a.get("eps", 1e-5),
+        momentum=a.get("momentum", 0.9), training=rt.is_train,
+        use_global_stats=a.get("use_global_stats", False),
+        fix_gamma=a.get("fix_gamma", False))
+    return y, new_mm, new_mv
+
+
+register_op("BatchNorm", _batch_norm_fn,
+            ("data", "gamma", "beta", "moving_mean", "moving_var"),
+            aux_pos=(3, 4), infer_hint=_channel_hint_at(1))
+
+register_op(
+    "LayerNorm",
+    lambda rt, a, x, g, b: _raw.layer_norm(x, g, b, a.get("axis", -1),
+                                           a.get("eps", 1e-5)),
+    ("data", "gamma", "beta"), infer_hint=_channel_hint_at(-1))
+
+
+def _dropout_fn(rt, a, x):
+    training = rt.is_train or a.get("mode") == "always"
+    if not training or a.get("p", 0.5) == 0.0:
+        return x
+    return _raw.dropout(x, rt.next_key(), a.get("p", 0.5), True,
+                        tuple(a.get("axes", ())))
+
+
+register_op("Dropout", _dropout_fn, ("data",))
+
+
+def _embedding_hint(in_shapes, attrs):
+    if in_shapes[1] is None:
+        return {1: (attrs["input_dim"], attrs["output_dim"])}
+    return None
+
+
+register_op(
+    "Embedding",
+    lambda rt, a, idx, w: jnp.take(w, idx.astype(jnp.int32), axis=0),
+    ("data", "weight"), infer_hint=_embedding_hint)
+
+register_op("smooth_l1",
+            lambda rt, a, x: _raw.smooth_l1(x, a.get("scalar", 1.0)), ("data",))
+register_op("softmax_cross_entropy",
+            lambda rt, a, x, l: _raw.softmax_cross_entropy(x, l), ("data", "label"))
+
+
+# ---------------------------------------------------------------------------
+# classic output ops (custom backward, reference semantics)
+# ---------------------------------------------------------------------------
+
+def _softmax_output_fn(rt, a, x, label):
+    grad_scale = a.get("grad_scale", 1.0)
+    normalization = a.get("normalization", "null")
+    ignore_label = a.get("ignore_label", -1) if a.get("use_ignore") else None
+
+    @jax.custom_vjp
+    def f(x, label):
+        return jax.nn.softmax(x, axis=-1)
+
+    def fwd(x, label):
+        p = jax.nn.softmax(x, axis=-1)
+        return p, (p, label)
+
+    def bwd(res, g):
+        p, label = res
+        lab = label.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, p.shape[-1], dtype=p.dtype)
+        grad = (p - oh) * grad_scale
+        if ignore_label is not None:
+            keep = (lab != ignore_label).astype(p.dtype)[..., None]
+            grad = grad * keep
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid":
+            n = jnp.maximum(jnp.sum((lab != (ignore_label if ignore_label
+                                             is not None else -10**9))
+                                    .astype(p.dtype)), 1.0)
+            grad = grad / n
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f(x, label)
+
+
+register_op("SoftmaxOutput", _softmax_output_fn, ("data", "label"))
+
+
+def _make_regression(tname, pred_fn, grad_fn):
+    def op_fn(rt, a, x, label):
+        grad_scale = a.get("grad_scale", 1.0)
+
+        @jax.custom_vjp
+        def f(x, label):
+            return pred_fn(x)
+
+        def fwd(x, label):
+            p = pred_fn(x)
+            return p, (p, label)
+
+        def bwd(res, g):
+            p, label = res
+            return grad_fn(p, label) * grad_scale, jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f(x, label)
+
+    register_op(tname, op_fn, ("data", "label"))
+
+
+_make_regression("LinearRegressionOutput", lambda x: x, lambda p, l: p - l)
+_make_regression("MAERegressionOutput", lambda x: x, lambda p, l: jnp.sign(p - l))
+_make_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda p, l: p - l)
+
+
+# ---------------------------------------------------------------------------
+# symbol-level builders (the sym.* functions)
+# ---------------------------------------------------------------------------
+
+def _attrs(**kwargs):
+    return {k: v for k, v in kwargs.items() if v is not None}
+
+
+def FullyConnected(data=None, weight=None, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, name=None):
+    ins = [data, weight] + ([] if no_bias else [bias])
+    return _make_op("FullyConnected", ins,
+                    _attrs(num_hidden=num_hidden, flatten=flatten), name)
+
+
+def Convolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                pad=None, dilate=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, name=None):
+    ins = [data, weight] + ([] if no_bias else [bias])
+    return _make_op("Convolution", ins,
+                    _attrs(kernel=kernel, stride=stride, pad=pad, dilate=dilate,
+                           num_filter=num_filter, num_group=num_group,
+                           layout=layout), name)
+
+
+def Deconvolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                  pad=None, dilate=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=False, layout=None, name=None):
+    ins = [data, weight] + ([] if no_bias else [bias])
+    return _make_op("Deconvolution", ins,
+                    _attrs(kernel=kernel, stride=stride, pad=pad, dilate=dilate,
+                           adj=adj, num_filter=num_filter, num_group=num_group,
+                           layout=layout), name)
+
+
+def Pooling(data=None, pool_type="max", kernel=(2, 2), stride=None, pad=None,
+            global_pool=False, count_include_pad=True, layout=None,
+            ceil_mode=False, name=None):
+    return _make_op("Pooling", [data],
+                    _attrs(pool_type=pool_type, kernel=kernel, stride=stride,
+                           pad=pad, global_pool=global_pool,
+                           count_include_pad=count_include_pad, layout=layout,
+                           ceil_mode=ceil_mode), name)
+
+
+def Activation(data=None, act_type="relu", name=None):
+    return _make_op("Activation", [data], {"act_type": act_type}, name)
+
+
+def LeakyReLU(data=None, act_type="leaky", slope=0.25, name=None):
+    return _make_op("LeakyReLU", [data],
+                    {"act_type": act_type, "slope": slope}, name)
+
+
+def BatchNorm(data=None, gamma=None, beta=None, moving_mean=None,
+              moving_var=None, axis=1, eps=1e-5, momentum=0.9,
+              fix_gamma=False, use_global_stats=False, name=None):
+    return _make_op("BatchNorm", [data, gamma, beta, moving_mean, moving_var],
+                    _attrs(axis=axis, eps=eps, momentum=momentum,
+                           fix_gamma=fix_gamma,
+                           use_global_stats=use_global_stats), name)
+
+
+def LayerNorm(data=None, gamma=None, beta=None, axis=-1, eps=1e-5, name=None):
+    return _make_op("LayerNorm", [data, gamma, beta],
+                    _attrs(axis=axis, eps=eps), name)
+
+
+def Dropout(data=None, p=0.5, mode="training", axes=(), name=None):
+    return _make_op("Dropout", [data], _attrs(p=p, mode=mode, axes=axes), name)
+
+
+def Embedding(data=None, weight=None, input_dim=None, output_dim=None,
+              name=None):
+    return _make_op("Embedding", [data, weight],
+                    _attrs(input_dim=input_dim, output_dim=output_dim), name)
+
+
+def SoftmaxOutput(data=None, label=None, grad_scale=1.0, normalization="null",
+                  use_ignore=False, ignore_label=-1, name=None):
+    return _make_op("SoftmaxOutput", [data, label],
+                    _attrs(grad_scale=grad_scale, normalization=normalization,
+                           use_ignore=use_ignore, ignore_label=ignore_label),
+                    name or "softmax")
+
+
+def LinearRegressionOutput(data=None, label=None, grad_scale=1.0, name=None):
+    return _make_op("LinearRegressionOutput", [data, label],
+                    {"grad_scale": grad_scale}, name)
+
+
+def MAERegressionOutput(data=None, label=None, grad_scale=1.0, name=None):
+    return _make_op("MAERegressionOutput", [data, label],
+                    {"grad_scale": grad_scale}, name)
+
+
+def LogisticRegressionOutput(data=None, label=None, grad_scale=1.0, name=None):
+    return _make_op("LogisticRegressionOutput", [data, label],
+                    {"grad_scale": grad_scale}, name)
+
+
+def MakeLoss(data=None, grad_scale=1.0, name=None):
+    return _make_op("MakeLoss", [data], {"grad_scale": grad_scale}, name)
+
+
+def BlockGrad(data=None, name=None):
+    return _make_op("BlockGrad", [data], {}, name)
+
+
+def Flatten(data=None, name=None):
+    return _make_op("Flatten", [data], {}, name)
+
+
+def Reshape(data=None, shape=None, name=None):
+    return _make_op("Reshape", [data], {"shape": tuple(shape)}, name)
+
+
+def transpose(data=None, axes=None, name=None):
+    return _make_op("transpose", [data], _attrs(axes=axes), name)
+
+
+def expand_dims(data=None, axis=0, name=None):
+    return _make_op("expand_dims", [data], {"axis": axis}, name)
+
+
+def squeeze(data=None, axis=None, name=None):
+    return _make_op("squeeze", [data], _attrs(axis=axis), name)
+
+
+def Concat(*args, dim=1, name=None):
+    return _make_op("Concat", list(args), {"dim": dim}, name)
+
+
+concat = Concat
+
+
+def stack(*args, axis=0, name=None):
+    return _make_op("stack", list(args), {"axis": axis}, name)
+
+
+def slice_axis(data=None, axis=0, begin=0, end=None, name=None):
+    return _make_op("slice_axis", [data],
+                    {"axis": axis, "begin": begin, "end": end}, name)
+
+
+def SliceChannel(data=None, num_outputs=None, axis=1, squeeze_axis=False,
+                 name=None):
+    return _make_op("SliceChannel", [data],
+                    {"num_outputs": num_outputs, "axis": axis,
+                     "squeeze_axis": squeeze_axis}, name)
+
+
+split = SliceChannel
+
+
+def softmax(data=None, axis=-1, name=None):
+    return _make_op("softmax", [data], {"axis": axis}, name)
+
+
+def log_softmax(data=None, axis=-1, name=None):
+    return _make_op("log_softmax", [data], {"axis": axis}, name)
+
+
+def clip(data=None, a_min=None, a_max=None, name=None):
+    return _make_op("clip", [data], {"a_min": a_min, "a_max": a_max}, name)
+
+
+def dot(lhs=None, rhs=None, name=None):
+    return _make_op("dot", [lhs, rhs], {}, name)
+
+
+def batch_dot(lhs=None, rhs=None, transpose_a=False, transpose_b=False,
+              name=None):
+    return _make_op("batch_dot", [lhs, rhs],
+                    {"transpose_a": transpose_a, "transpose_b": transpose_b},
+                    name)
+
+
+def smooth_l1(data=None, scalar=1.0, name=None):
+    return _make_op("smooth_l1", [data], {"scalar": scalar}, name)
+
+
+def softmax_cross_entropy(data=None, label=None, name=None):
+    return _make_op("softmax_cross_entropy", [data, label], {}, name)
+
+
+def _make_unary_builder(opname):
+    def builder(data=None, name=None):
+        return _make_op(opname, [data], {}, name)
+    builder.__name__ = opname
+    return builder
+
+
+_UNARY_BUILDERS = ["negative", "exp", "log", "sqrt", "square", "abs", "tanh",
+                   "sigmoid", "relu", "erf", "rsqrt", "sin", "cos", "sign",
+                   "zeros_like", "ones_like", "stop_gradient"]
+for _n in _UNARY_BUILDERS:
+    globals()[_n] = _make_unary_builder(_n)
+
+
+def _make_reduce_builder(opname):
+    def builder(data=None, axis=None, keepdims=False, name=None):
+        return _make_op(opname, [data], _attrs(axis=axis, keepdims=keepdims),
+                        name)
+    builder.__name__ = opname
+    return builder
+
+
+for _n in ["sum", "mean", "max", "min", "prod", "argmax"]:
+    globals()[_n] = _make_reduce_builder(_n)
+
+
+def broadcast_op_builder(opname):
+    def builder(lhs=None, rhs=None, name=None):
+        return _make_op(opname, [lhs, rhs], {}, name)
+    builder.__name__ = opname
+    return builder
+
+
+for _n in ["broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+           "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+           "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+           "broadcast_greater_equal", "broadcast_lesser",
+           "broadcast_lesser_equal"]:
+    globals()[_n] = broadcast_op_builder(_n)
+
+
+# Export the builders onto the `symbol` module namespace.
+_EXPORTS = [n for n in list(globals()) if n[0].isupper() or n in (
+    "concat", "split", "softmax", "log_softmax", "clip", "dot", "batch_dot",
+    "smooth_l1", "softmax_cross_entropy", "transpose", "expand_dims",
+    "squeeze", "slice_axis", "stack",
+) or n in _UNARY_BUILDERS or n in ("sum", "mean", "max", "min", "prod",
+                                   "argmax")
+    or n.startswith("broadcast_")]
+for _n in _EXPORTS:
+    if not _n.startswith("_"):
+        setattr(_sym_mod, _n, globals()[_n])

@@ -1,0 +1,259 @@
+"""Executor: compiled evaluation of a bound Symbol graph.
+
+Parity: python/mxnet/executor.py + src/executor/graph_executor.cc. Where the
+reference interprets the nnvm graph through the threaded engine, `bind` here
+closes the graph over its argument order and compiles ONE jitted forward and
+ONE jitted backward (vjp) executable per training mode — forward+backward
+each run as a single fused XLA computation on the TPU.
+
+The same rng key is threaded into forward and backward so stochastic ops
+(Dropout) use identical masks in both passes, matching the reference's
+cached-mask backward.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..context import current_context
+from ..ndarray import NDArray
+from . import _OPS, _Runtime, _num_outputs, _topo
+
+__all__ = ["Executor", "simple_bind"]
+
+
+def _graph_runner(entries, arg_nodes, aux_nodes):
+    """Build run(rt, arg_raws, aux_raws) -> (outputs, new_aux) over the DAG."""
+    order = _topo(entries)
+    arg_ids = [id(n) for n in arg_nodes]
+    aux_ids = [id(n) for n in aux_nodes]
+
+    def run(rt, arg_raws, aux_raws):
+        env = {}
+        for nid, raw in zip(arg_ids, arg_raws):
+            env[(nid, 0)] = raw
+        for nid, raw in zip(aux_ids, aux_raws):
+            env[(nid, 0)] = raw
+        for node in order:
+            if node.is_var:
+                if (id(node), 0) not in env:
+                    raise ValueError(f"unbound variable {node.name!r}")
+                continue
+            od = _OPS[node.op]
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            res = od.fn(rt, node.attrs, *ins)
+            res = res if isinstance(res, tuple) else (res,)
+            n_real = _num_outputs(node)
+            if od.aux_pos:
+                for pos, new in zip(od.aux_pos, res[n_real:]):
+                    rt.aux_updates[id(node.inputs[pos][0])] = new
+                res = res[:n_real]
+            for i, r in enumerate(res):
+                env[(id(node), i)] = r
+        outs = tuple(env[(id(n), i)] for n, i in entries)
+        new_aux = tuple(rt.aux_updates.get(nid, env[(nid, 0)])
+                        for nid in aux_ids)
+        return outs, new_aux
+
+    return run
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self.arg_dict = OrderedDict()
+        self.aux_dict = OrderedDict()
+
+        if isinstance(args, dict):
+            for n in arg_names:
+                if n not in args:
+                    raise ValueError(f"missing argument {n!r}")
+                self.arg_dict[n] = _as_nd(args[n])
+        elif args is not None:
+            for n, a in zip(arg_names, args):
+                self.arg_dict[n] = _as_nd(a)
+        else:
+            raise ValueError("bind needs args; use simple_bind to allocate")
+
+        if isinstance(aux_states, dict):
+            for n in aux_names:
+                self.aux_dict[n] = _as_nd(aux_states[n])
+        elif aux_states is not None:
+            for n, a in zip(aux_names, aux_states):
+                self.aux_dict[n] = _as_nd(a)
+        else:
+            for n in aux_names:
+                raise ValueError(f"missing auxiliary state {n!r}")
+
+        # grad_req: str | list | dict
+        if isinstance(grad_req, str):
+            self._req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._req = dict(zip(arg_names, grad_req))
+        else:
+            self._req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        self.grad_dict = OrderedDict()
+        if isinstance(args_grad, dict):
+            for n in arg_names:
+                if self._req[n] != "null":
+                    self.grad_dict[n] = _as_nd(
+                        args_grad.get(n, np.zeros(self.arg_dict[n].shape,
+                                                  dtype=np.float32)))
+        else:
+            if args_grad is not None:
+                for n, g in zip(arg_names, args_grad):
+                    if self._req[n] != "null":
+                        self.grad_dict[n] = _as_nd(g)
+            for n in arg_names:
+                if self._req[n] != "null" and n not in self.grad_dict:
+                    a = self.arg_dict[n]
+                    self.grad_dict[n] = NDArray(jnp.zeros(a.shape, a._data.dtype))
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._train_names = [n for n in arg_names if self._req[n] != "null"]
+        self._fixed_names = [n for n in arg_names if self._req[n] == "null"]
+
+        order = _topo(symbol._entries)
+        var_by_name = {n.name: n for n in order if n.is_var}
+        self._run = _graph_runner(symbol._entries,
+                                  [var_by_name[n] for n in arg_names],
+                                  [var_by_name[n] for n in aux_names])
+        self._fwd_jit = {}
+        self._bwd_jit = {}
+        self.outputs = []
+        self._last = None   # (is_train, key) of the last forward
+
+    # -- forward ----------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise ValueError(f"unknown input {k!r}")
+            self.arg_dict[k] = _as_nd(v)
+        is_train = bool(is_train)
+        if is_train not in self._fwd_jit:
+            run = self._run
+
+            def fwd(arg_raws, aux_raws, key, _t=is_train):
+                return run(_Runtime(_t, key), arg_raws, aux_raws)
+
+            self._fwd_jit[is_train] = jax.jit(fwd)
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        arg_raws = [self.arg_dict[n]._data for n in self._arg_names]
+        aux_raws = [self.aux_dict[n]._data for n in self._aux_names]
+        outs, new_aux = self._fwd_jit[is_train](arg_raws, aux_raws, key)
+        if is_train:
+            for n, new in zip(self._aux_names, new_aux):
+                self.aux_dict[n]._data = new
+        self.outputs = [NDArray(o) for o in outs]
+        self._last = (is_train, key)
+        return self.outputs
+
+    # -- backward ---------------------------------------------------------
+    def backward(self, out_grads=None):
+        if self._last is None:
+            raise RuntimeError("call forward(is_train=True) before backward()")
+        is_train, key = self._last
+        if is_train not in self._bwd_jit:
+            run = self._run
+            n_train = len(self._train_names)
+            arg_names, train_names = self._arg_names, self._train_names
+            fixed_names = self._fixed_names
+            train_pos = [arg_names.index(n) for n in train_names]
+            fixed_pos = [arg_names.index(n) for n in fixed_names]
+
+            def bwd(train_raws, fixed_raws, aux_raws, key, cots, _t=is_train):
+                def f(*train_raws_):
+                    raws = [None] * len(arg_names)
+                    for p, r in zip(train_pos, train_raws_):
+                        raws[p] = r
+                    for p, r in zip(fixed_pos, fixed_raws):
+                        raws[p] = r
+                    outs, _ = run(_Runtime(_t, key), raws, aux_raws)
+                    return outs
+
+                _, pull = jax.vjp(f, *train_raws)
+                return pull(tuple(cots))
+
+            self._bwd_jit[is_train] = jax.jit(bwd)
+        if out_grads is None:
+            cots = [jnp.ones(o.shape, o._data.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+        train_raws = [self.arg_dict[n]._data for n in self._train_names]
+        fixed_raws = [self.arg_dict[n]._data for n in self._fixed_names]
+        aux_raws = [self.aux_dict[n]._data for n in self._aux_names]
+        grads = self._bwd_jit[is_train](train_raws, fixed_raws, aux_raws, key,
+                                        cots)
+        for n, g in zip(self._train_names, grads):
+            if self._req[n] == "add":
+                self.grad_dict[n]._data = self.grad_dict[n]._data + g
+            else:
+                self.grad_dict[n]._data = g
+        return [self.grad_dict[n] for n in self._train_names]
+
+    # -- views ------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = _as_nd(v)._data
+            elif not allow_extra_params:
+                raise ValueError(f"unknown argument {k!r}")
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = _as_nd(v)._data
+                elif not allow_extra_params:
+                    raise ValueError(f"unknown aux state {k!r}")
+
+
+def _as_nd(x):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x))
+
+
+def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None, **kwargs):
+    """Infer every argument/aux shape from the given input shapes and
+    allocate zero-filled arrays (parity: Symbol.simple_bind)."""
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    type_dict = type_dict or {}
+    args, auxs = {}, {}
+    for n, s in zip(arg_names, arg_shapes):
+        if s is None:
+            raise ValueError(f"could not infer shape for argument {n!r}; "
+                             f"pass its shape to simple_bind")
+        dt = type_dict.get(n, jnp.float32)
+        args[n] = NDArray(jnp.zeros(s, dt))
+    for n, s in zip(aux_names, aux_shapes):
+        if s is None:
+            raise ValueError(f"could not infer shape for aux state {n!r}")
+        auxs[n] = NDArray(jnp.zeros(s, type_dict.get(n, jnp.float32)))
+    return Executor(symbol, ctx, args, None, grad_req, auxs)

@@ -137,3 +137,26 @@ def test_batch_sampler_rollover():
     assert len(first) == 2
     second = list(s)
     assert second[0][0] == 4  # rolled-over sample leads
+
+
+def test_ndarrayiter_roll_over():
+    import numpy as np
+    from incubator_mxnet_tpu.io import NDArrayIter
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = NDArrayIter(x, None, batch_size=4, last_batch_handle="roll_over")
+    seen1 = [b.data[0].shape[0] for b in it]
+    assert seen1 == [4, 4]          # 2 leftover roll to next epoch
+    it.reset()
+    seen2 = sum(b.data[0].shape[0] for b in it)
+    assert seen2 == 12              # 2 rolled + 10 fresh
+
+def test_prefetching_iter_exhaustion_no_hang():
+    import numpy as np, pytest
+    from incubator_mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    x = np.zeros((8, 2), np.float32)
+    it = PrefetchingIter(NDArrayIter(x, None, batch_size=4))
+    assert len(list(it)) == 2
+    with pytest.raises(StopIteration):
+        it.next()   # must raise again, not hang
+    it.reset()
+    assert len(list(it)) == 2

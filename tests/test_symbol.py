@@ -1,0 +1,200 @@
+"""Symbol API tests (mirrors reference tests/python/unittest/test_symbol.py
+and test_executor.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import symbol as sym
+
+
+def test_variable_and_arguments():
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = sym.dot(x, w)
+    assert y.list_arguments() == ["x", "w"]
+    assert y.list_outputs() == [y.name + "_output"]
+
+
+def test_compose_arithmetic_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2.0 * a + b / 4 - 1.0
+    ex = c.bind(args={"a": np.full((2, 3), 3.0, np.float32),
+                      "b": np.full((2, 3), 8.0, np.float32)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 3), 7.0), rtol=1e-6)
+
+
+def test_mlp_infer_shape():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    out = sym.SoftmaxOutput(fc2, sym.Variable("label"), name="softmax")
+    args = out.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "label"]
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 100),
+                                                         label=(32,))
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (64, 100)
+    assert d["fc1_bias"] == (64,)
+    assert d["fc2_weight"] == (10, 64)
+    assert out_shapes == [(32, 10)]
+
+
+def test_conv_infer_shape():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                         name="conv1")
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2))
+    arg_shapes, out_shapes, _ = p1.infer_shape(data=(2, 3, 32, 32))
+    d = dict(zip(p1.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    assert out_shapes == [(2, 8, 16, 16)]
+
+
+def test_batchnorm_aux_states():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "bn_gamma" in bn.list_arguments()
+    ex = bn.simple_bind(data=(4, 3, 8, 8))
+    x = np.random.randn(4, 3, 8, 8).astype(np.float32)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    mm0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    mm1 = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mm0, mm1)  # train mode updates running stats
+
+
+def test_simple_bind_forward_backward():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = sym.SoftmaxOutput(fc, sym.Variable("label"), name="softmax")
+    ex = out.simple_bind(data=(8, 5), label=(8,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["fc_weight"][:] = rng.randn(4, 5).astype(np.float32) * 0.1
+    x = rng.randn(8, 5).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=x, label=y)
+    p = outs[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    gw = ex.grad_dict["fc_weight"].asnumpy()
+    # reference semantics: dlogits = p - one_hot(label); dW = dlogits^T x
+    oh = np.eye(4)[y.astype(int)]
+    expect = (p - oh).T @ x
+    np.testing.assert_allclose(gw, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_regression_output_grad():
+    x = sym.Variable("x")
+    out = sym.LinearRegressionOutput(x, sym.Variable("label"))
+    ex = out.simple_bind(x=(4, 2), label=(4, 2), grad_req="write")
+    xv = np.random.randn(4, 2).astype(np.float32)
+    lv = np.random.randn(4, 2).astype(np.float32)
+    ex.forward(is_train=True, x=xv, label=lv)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), xv - lv, rtol=1e-5)
+
+
+def test_json_roundtrip():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=7, name="fc1")
+    act = sym.Activation(fc1, act_type="tanh")
+    js = act.tojson()
+    act2 = sym.load_json(js)
+    assert act2.list_arguments() == act.list_arguments()
+    a1, o1, _ = act.infer_shape(data=(3, 4))
+    a2, o2, _ = act2.infer_shape(data=(3, 4))
+    assert o1 == o2 and a1 == a2
+    # numeric parity
+    ex1 = act.simple_bind(data=(3, 4))
+    ex2 = act2.simple_bind(data=(3, 4))
+    w = np.random.randn(7, 4).astype(np.float32)
+    x = np.random.randn(3, 4).astype(np.float32)
+    for ex in (ex1, ex2):
+        ex.arg_dict["fc1_weight"][:] = w
+        ex.forward(data=x)
+    np.testing.assert_allclose(ex1.outputs[0].asnumpy(),
+                               ex2.outputs[0].asnumpy(), rtol=1e-6)
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = sym.relu(a, name="r")
+    c = sym.tanh(a, name="t")
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(args={"a": np.array([[-1.0, 2.0]], np.float32)}, grad_req="null")
+    o = ex.forward()
+    np.testing.assert_allclose(o[0].asnumpy(), [[0.0, 2.0]])
+    np.testing.assert_allclose(o[1].asnumpy(), np.tanh([[-1.0, 2.0]]), rtol=1e-6)
+    internals = b.get_internals()
+    assert "a" in internals.list_outputs()[0]
+
+
+def test_grad_req_add_and_null():
+    x = sym.Variable("x")
+    y = sym.sum(x * x)
+    ex = y.bind(args={"x": np.array([1.0, 2.0], np.float32)},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [4.0, 8.0])
+
+
+def test_slice_and_concat():
+    a = sym.Variable("a")
+    parts = sym.SliceChannel(a, num_outputs=2, axis=1)
+    back = sym.Concat(parts[0], parts[1], dim=1)
+    ex = back.bind(args={"a": np.arange(8, dtype=np.float32).reshape(2, 4)},
+                   grad_req="null")
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               np.arange(8, dtype=np.float32).reshape(2, 4))
+
+
+def test_dropout_train_vs_eval():
+    x = sym.Variable("x")
+    d = sym.Dropout(x, p=0.5)
+    ex = d.bind(args={"x": np.ones((100, 100), np.float32)}, grad_req="null")
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_eval, np.ones((100, 100)))
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    assert (out_train == 0).mean() > 0.3
+
+
+def test_backward_respects_train_mode_switch():
+    # regression: backward jit must be keyed by is_train, not frozen
+    x = sym.Variable("x")
+    d = sym.sum(sym.Dropout(x, p=0.5))
+    ex = d.bind(args={"x": np.ones((64, 64), np.float32)}, grad_req="write")
+    ex.forward(is_train=False)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 1.0)  # no mask
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["x"].asnumpy()
+    assert (g == 0).mean() > 0.3  # dropout mask applied in train backward
+
+
+def test_infer_type():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    args, outs, _ = fc.infer_type(data="float32")
+    d = dict(zip(fc.list_arguments(), args))
+    assert d["fc_weight"] == np.dtype(np.float32)
+    assert outs == [np.dtype(np.float32)]
+
+
+def test_load_json_no_name_collision():
+    # regression: auto-name counter must advance past loaded node names
+    a = sym.Variable("a")
+    f1 = sym.FullyConnected(a, num_hidden=3)  # auto-named fullyconnected{N}
+    loaded = sym.load_json(f1.tojson())
+    f2 = sym.FullyConnected(loaded, num_hidden=2)
+    args = f2.list_arguments()
+    assert len(args) == len(set(args)), args
