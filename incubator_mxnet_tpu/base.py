@@ -81,7 +81,10 @@ class NameManager:
         return cls._tls.nm
 
 
-_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+# Acronym-aware: "LSTMCell" -> "lstm_cell", "Conv2D" -> "conv2d",
+# "HybridSequential" -> "hybrid_sequential" (split at lower→upper and
+# acronym→word boundaries only; digits don't split).
+_SNAKE_RE = re.compile(r"(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
 
 
 def camel_to_snake(name: str) -> str:

@@ -1,8 +1,8 @@
 """gluon: the imperative/hybrid high-level API (parity: python/mxnet/gluon)."""
-from . import loss, nn
+from . import data, loss, nn, rnn
 from .block import Block, HybridBlock
 from .parameter import Constant, Parameter, ParameterDict
 from .trainer import Trainer
 
 __all__ = ["Block", "HybridBlock", "Parameter", "ParameterDict", "Constant",
-           "Trainer", "nn", "loss"]
+           "Trainer", "nn", "loss", "rnn", "data"]

@@ -140,14 +140,18 @@ class RecordFileDataset(Dataset):
                 pos = f.tell()
 
     def _handle(self):
-        # One handle per (dataset, pid): reopen after fork so DataLoader
-        # workers don't share a seek position (reference IndexedRecordIO
-        # keeps a persistent handle the same way).
+        # One handle per (pid, thread): DataLoader workers are threads, so a
+        # shared handle would race on seek+read; forked processes must also
+        # not inherit a shared seek position.
         import os
-        if getattr(self, "_fh_pid", None) != os.getpid():
-            self._fh = open(self._filename, "rb")
+        import threading
+        local = getattr(self, "_fh_local", None)
+        if local is None or getattr(self, "_fh_pid", None) != os.getpid():
+            local = self._fh_local = threading.local()
             self._fh_pid = os.getpid()
-        return self._fh
+        if not hasattr(local, "fh"):
+            local.fh = open(self._filename, "rb")
+        return local.fh
 
     def __getitem__(self, idx):
         import struct

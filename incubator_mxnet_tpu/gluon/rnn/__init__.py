@@ -1,0 +1,488 @@
+"""gluon.rnn (parity: python/mxnet/gluon/rnn/{rnn_cell,rnn_layer}.py).
+
+Two surfaces, same math:
+- Cells (RNNCell/LSTMCell/GRUCell + wrappers): explicit per-step API;
+  `unroll` loops in Python eagerly and fuses into one XLA loop under
+  hybridize — flexible, for custom recurrences.
+- Layers (RNN/LSTM/GRU): the fused path. The WHOLE sequence × layers ×
+  directions runs as one recorded op on `lax.scan` (ops/_rnn.py), the
+  TPU-native equivalent of the reference's cuDNN fused RNN kernel.
+
+Parameter naming matches the reference ("l0_i2h_weight", "r0_h2h_bias", ...)
+so checkpoints and tests line up; gate orders match rnn-inl.h.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import autograd
+from ... import ndarray as nd
+from ...ndarray import NDArray, _apply
+from ...ndarray import random as ndrandom
+from ...ops import _rnn
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "RNN", "LSTM", "GRU"]
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+class RecurrentCell(HybridBlock):
+    """Base class (parity: gluon.rnn.RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._init_counter = -1
+
+    def reset(self):
+        self._init_counter = -1
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(func(shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll `length` steps. inputs: NDArray (layout) or list of (N, C).
+        Python loop — under hybridize it traces into one XLA computation."""
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            steps = [inputs.take(i, axis=axis) for i in range(length)]
+        else:
+            steps = list(inputs)
+            assert len(steps) == length
+        batch = steps[0].shape[0]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        outputs = []
+        all_states = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outputs.append(out)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            # hold final state at each sequence's true end
+            vl = valid_length if isinstance(valid_length, NDArray) \
+                else nd.array(valid_length)
+            picked = []
+            for k in range(len(states)):
+                stk = nd.stack(*[s[k] for s in all_states], axis=0)  # (T,N,H)
+                picked.append(_apply(
+                    lambda s, v: jnp.take_along_axis(
+                        s, (v.astype(jnp.int32) - 1).clip(0)[None, :, None],
+                        axis=0)[0],
+                    [stk, vl], name="select_last_state"))
+            states = picked
+            mask = _apply(lambda v: (jnp.arange(length)[:, None]
+                                     < v[None, :]).astype(jnp.float32),
+                          [vl], name="len_mask")
+            outputs = [o * mask[t].reshape((-1,) + (1,) * (o.ndim - 1))
+                       for t, o in enumerate(outputs)]
+        if merge_outputs is False:
+            return outputs, states
+        merged = nd.stack(*outputs, axis=axis)
+        return merged, states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._gates = gates
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(gates * hidden_size, input_size),
+            init=i2h_weight_initializer)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(gates * hidden_size, hidden_size),
+            init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(gates * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(gates * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._gates * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _step(self, mode, x, states):
+        raws = [x] + list(states)
+        n_states = len(states)
+
+        def f(xr, *rest):
+            sts = rest[:n_states]
+            wi, wh, bi, bh = rest[n_states:]
+            out, new = _rnn.rnn_cell_step(mode, xr, sts, wi, wh, bi, bh)
+            return (out,) + tuple(new)
+
+        outs = _apply(f, raws + [self.i2h_weight.data(), self.h2h_weight.data(),
+                                 self.i2h_bias.data(), self.h2h_bias.data()],
+                      n_out=1 + n_states, name=mode + "_cell")
+        return outs[0], list(outs[1:])
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+
+    def forward(self, inputs, states):
+        return self._step(self._mode, inputs, states)
+
+
+class LSTMCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        return self._step("lstm", inputs, states)
+
+
+class GRUCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def forward(self, inputs, states):
+        return self._step("gru", inputs, states)
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells; state list is the concatenation of children's states."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def __len__(self):
+        return len(self._children)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, func, **kwargs))
+        return states
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, sts = cell(inputs, states[p:p + n])
+            next_states.extend(sts)
+            p += n
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self.rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import ops
+        return ops.Dropout(inputs, self.rate), states
+
+
+class ZoneoutCell(RecurrentCell):
+    """Zoneout (parity: gluon.rnn.ZoneoutCell): randomly hold previous
+    states instead of updating — the RNN analogue of dropout."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self._prev_output = None
+        return self.base_cell.begin_state(batch_size, func, **kwargs)
+
+    def forward(self, inputs, states):
+        out, new_states = self.base_cell(inputs, states)
+        if not autograd.is_training():
+            return out, new_states
+
+        def zone(new, old, rate):
+            if rate == 0.0:
+                return new
+            key = ndrandom._key()
+            return _apply(
+                lambda n_, o_: jnp.where(jax.random.bernoulli(key, rate, n_.shape),
+                                         o_, n_),
+                [new, old], name="zoneout")
+
+        prev_out = self._prev_output
+        if prev_out is None:
+            prev_out = nd.zeros(out.shape)
+        out_z = zone(out, prev_out, self.zoneout_outputs)
+        states_z = [zone(n, o, self.zoneout_states)
+                    for n, o in zip(new_states, states)]
+        self._prev_output = out
+        return out_z, states_z
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, *args, **kwargs):
+        return self.base_cell.begin_state(*args, **kwargs)
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+def _seq_reverse_steps(steps, valid_length):
+    """Reverse a list of (N, C) steps within each sample's valid prefix,
+    keeping padding steps in place (SequenceReverse semantics)."""
+    vl = valid_length if isinstance(valid_length, NDArray) \
+        else nd.array(valid_length)
+    T = len(steps)
+    stk = nd.stack(*steps, axis=0)  # (T, N, C)
+    rev = _apply(
+        lambda s, v: jnp.take_along_axis(
+            s,
+            jnp.where(jnp.arange(T)[:, None] < v.astype(jnp.int32)[None, :],
+                      v.astype(jnp.int32)[None, :] - 1
+                      - jnp.arange(T)[:, None],
+                      jnp.arange(T)[:, None])[:, :, None],
+            axis=0),
+        [stk, vl], name="sequence_reverse")
+    return [rev.take(t, axis=0) for t in range(T)]
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size) +
+                self.r_cell.state_info(batch_size))
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return (self.l_cell.begin_state(batch_size, func, **kwargs) +
+                self.r_cell.begin_state(batch_size, func, **kwargs))
+
+    def forward(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell must be used via unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        if isinstance(inputs, NDArray):
+            steps = [inputs.take(i, axis=axis) for i in range(length)]
+        else:
+            steps = list(inputs)
+        batch = steps[0].shape[0]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_states, r_states = states[:nl], states[nl:]
+        l_out, l_states = self.l_cell.unroll(
+            length, steps, l_states, layout="NTC" if axis else "TNC",
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            rev_steps = list(reversed(steps))
+        else:
+            # SequenceReverse semantics (reference src/operator/
+            # sequence_reverse.cc): reverse each sample WITHIN its valid
+            # prefix, leaving padding positions in place, so the reverse
+            # cell consumes real data first.
+            rev_steps = _seq_reverse_steps(steps, valid_length)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev_steps, r_states,
+            layout="NTC" if axis else "TNC", merge_outputs=False,
+            valid_length=valid_length)
+        if valid_length is None:
+            r_out = list(reversed(r_out))
+        else:
+            r_out = _seq_reverse_steps(r_out, valid_length)
+        outs = [nd.concat(lo, ro, dim=-1) for lo, ro in zip(l_out, r_out)]
+        states = l_states + r_states
+        if merge_outputs is False:
+            return outs, states
+        return nd.stack(*outs, axis=axis), states
+
+
+# ---------------------------------------------------------------------------
+# fused layers
+# ---------------------------------------------------------------------------
+
+class _RNNLayer(HybridBlock):
+    """Fused multi-layer (bi)RNN on lax.scan (the cuDNN-RNN replacement)."""
+
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        gates = _rnn.GATES[mode]
+        self._gates = gates
+        ni = input_size
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                pre = f"{'r' if d else 'l'}{layer}_"
+                setattr(self, f"_{pre}i2h_weight", self.params.get(
+                    pre + "i2h_weight", shape=(gates * hidden_size, ni),
+                    init=i2h_weight_initializer))
+                setattr(self, f"_{pre}h2h_weight", self.params.get(
+                    pre + "h2h_weight", shape=(gates * hidden_size, hidden_size),
+                    init=h2h_weight_initializer))
+                setattr(self, f"_{pre}i2h_bias", self.params.get(
+                    pre + "i2h_bias", shape=(gates * hidden_size,),
+                    init=i2h_bias_initializer))
+                setattr(self, f"_{pre}h2h_bias", self.params.get(
+                    pre + "h2h_bias", shape=(gates * hidden_size,),
+                    init=h2h_bias_initializer))
+            ni = hidden_size * self._dir
+
+    def _layer_param(self, layer, d, name):
+        return getattr(self, f"_{'r' if d else 'l'}{layer}_{name}")
+
+    def infer_shape(self, x, *args, **kwargs):
+        in_size = x.shape[-1]
+        ni = in_size
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                self._layer_param(layer, d, "i2h_weight").shape = \
+                    (self._gates * self._hidden_size, ni)
+            ni = self._hidden_size * self._dir
+
+    def state_info(self, batch_size=0):
+        n = self._num_layers * self._dir
+        shapes = [{"shape": (n, batch_size, self._hidden_size),
+                   "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            shapes.append({"shape": (n, batch_size, self._hidden_size),
+                           "__layout__": "LNC"})
+        return shapes
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or nd.zeros
+        return [func(info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def forward(self, inputs, states=None, sequence_length=None):
+        ntc = self._layout == "NTC"
+        return_states = states is not None
+        if states is None:
+            batch = inputs.shape[0] if ntc else inputs.shape[1]
+            states = self.begin_state(batch)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        states = list(states)
+        n_states = len(states)
+        params = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                params.extend([
+                    self._layer_param(layer, d, "i2h_weight").data(),
+                    self._layer_param(layer, d, "h2h_weight").data(),
+                    self._layer_param(layer, d, "i2h_bias").data(),
+                    self._layer_param(layer, d, "h2h_bias").data()])
+        mode = self._mode
+        bidir = self._dir == 2
+        dropout = self._dropout
+        training = autograd.is_training()
+        key = ndrandom._key() if (dropout > 0.0 and training) else None
+        has_vl = sequence_length is not None
+        extra = [sequence_length] if has_vl else []
+
+        def f(x_raw, *rest):
+            sts = rest[:n_states]
+            vl = rest[n_states] if has_vl else None
+            praws = rest[n_states + (1 if has_vl else 0):]
+            lp = [tuple(praws[i:i + 4]) for i in range(0, len(praws), 4)]
+            x_tnc = jnp.transpose(x_raw, (1, 0, 2)) if ntc else x_raw
+            out, new_states = _rnn.rnn_forward(
+                x_tnc, list(sts), lp, mode, bidirectional=bidir,
+                dropout=dropout, dropout_key=key, training=training,
+                valid_len=vl)
+            if ntc:
+                out = jnp.transpose(out, (1, 0, 2))
+            return (out,) + tuple(new_states)
+
+        outs = _apply(f, [inputs] + states + extra + params,
+                      n_out=1 + n_states, name=mode)
+        out, new_states = outs[0], list(outs[1:])
+        return (out, new_states) if return_states else out
+
+    def __call__(self, inputs, states=None, **kwargs):
+        return super().__call__(inputs, states, **kwargs) if states is not None \
+            else super().__call__(inputs, **kwargs)
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

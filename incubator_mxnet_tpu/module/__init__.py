@@ -207,6 +207,10 @@ class Module(BaseModule):
         initializer = initializer or init_mod.Uniform(0.01)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
+            if arg_params and name not in arg_params and not allow_missing:
+                raise ValueError(
+                    f"parameter {name!r} missing from arg_params; pass "
+                    f"allow_missing=True to re-initialize missing params")
             if arg_params and name in arg_params:
                 src = arg_params[name]
                 arr._data = jnp.asarray(

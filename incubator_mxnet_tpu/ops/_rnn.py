@@ -1,0 +1,108 @@
+"""Recurrent kernels on lax.scan (parity: src/operator/rnn.cc, the fused
+RNN op cuDNN path).
+
+Design: the whole sequence × all layers runs inside ONE traced computation —
+`lax.scan` over time per (layer, direction) — so XLA compiles a single fused
+loop whose body is two MXU matmuls + elementwise gates. This replaces the
+reference's cuDNN RNN kernels; there is no per-timestep Python dispatch.
+
+Gate orders match the reference (rnn-inl.h):
+  LSTM: i, f, g, o        GRU: r, z, n (reset, update, newmem)
+Weights per (layer, direction): i2h_w (G*H, I), h2h_w (G*H, H),
+i2h_b (G*H,), h2h_b (G*H,) — exactly the reference's parameter packing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_cell_step(mode, x, states, wi, wh, bi, bh):
+    """One timestep. states: tuple of arrays (N, H). Returns (out, states)."""
+    if mode in ("rnn_relu", "rnn_tanh"):
+        (h,) = states
+        pre = x @ wi.T + bi + h @ wh.T + bh
+        h2 = jax.nn.relu(pre) if mode == "rnn_relu" else jnp.tanh(pre)
+        return h2, (h2,)
+    if mode == "lstm":
+        h, c = states
+        pre = x @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(pre, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, (h2, c2)
+    if mode == "gru":
+        (h,) = states
+        xi = x @ wi.T + bi
+        hh = h @ wh.T + bh
+        xr, xz, xn = jnp.split(xi, 3, axis=-1)
+        hr, hz, hn = jnp.split(hh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h2 = (1 - z) * n + z * h
+        return h2, (h2,)
+    raise ValueError(f"unknown rnn mode {mode}")
+
+
+def _scan_direction(mode, x_tnc, h0, wi, wh, bi, bh, reverse=False,
+                    valid_len=None):
+    """Scan one direction over time. x_tnc (T, N, I); h0 tuple of (N, H).
+    valid_len (N,) masks steps t >= valid_len: state holds, output zeroed."""
+    T = x_tnc.shape[0]
+
+    def step(carry, inp):
+        states = carry
+        x_t, t = inp
+        out, new_states = rnn_cell_step(mode, x_t, states, wi, wh, bi, bh)
+        if valid_len is not None:
+            # ts is scanned WITH x, so t is the true time index in both
+            # directions (reverse=True consumes pairs back-to-front).
+            keep = (t < valid_len)[:, None]
+            new_states = tuple(jnp.where(keep, ns, s)
+                               for ns, s in zip(new_states, states))
+            out = jnp.where(keep, out, jnp.zeros_like(out))
+        return new_states, out
+
+    ts = jnp.arange(T)
+    final, outs = lax.scan(step, h0, (x_tnc, ts), reverse=reverse)
+    return outs, final
+
+
+def rnn_forward(x, states, layer_params, mode, bidirectional=False,
+                dropout=0.0, dropout_key=None, training=False,
+                valid_len=None):
+    """Fused multi-layer (bi)RNN (parity: the RNN op's cuDNN fused path).
+
+    x: (T, N, I). states: list of (L*D, N, H) arrays — [h] or [h, c].
+    layer_params: list over L*D of (wi, wh, bi, bh); layout [l0_fwd, l0_bwd,
+    l1_fwd, ...] like the reference. Returns (out (T, N, H*D), new_states).
+    """
+    D = 2 if bidirectional else 1
+    L = len(layer_params) // D
+    n_state = len(states)
+    new_states = [[] for _ in range(n_state)]
+    h = x
+    for layer in range(L):
+        outs_dir = []
+        for d in range(D):
+            idx = layer * D + d
+            wi, wh, bi, bh = layer_params[idx]
+            h0 = tuple(s[idx] for s in states)
+            outs, final = _scan_direction(mode, h, h0, wi, wh, bi, bh,
+                                          reverse=(d == 1),
+                                          valid_len=valid_len)
+            outs_dir.append(outs)
+            for k in range(n_state):
+                new_states[k].append(final[k])
+        h = outs_dir[0] if D == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if dropout > 0.0 and training and layer < L - 1 and dropout_key is not None:
+            dropout_key, sub = jax.random.split(dropout_key)
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(sub, keep, h.shape)
+            h = jnp.where(mask, h / keep, jnp.zeros_like(h))
+    out_states = [jnp.stack(s, axis=0) for s in new_states]
+    return h, out_states

@@ -1,0 +1,266 @@
+"""gluon.rnn tests (mirrors reference tests/python/unittest/test_gluon_rnn.py).
+Numeric references: torch-cpu LSTM/GRU/RNN (same gate equations; gate-order
+permuted where the conventions differ)."""
+import numpy as np
+import pytest
+import torch
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon import rnn
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+def test_rnn_cell_shapes():
+    cell = rnn.RNNCell(16)
+    cell.initialize()
+    x = nd.random.uniform(shape=(4, 8))
+    out, states = cell(x, cell.begin_state(4))
+    assert out.shape == (4, 16)
+    assert states[0].shape == (4, 16)
+
+
+def test_lstm_cell_vs_torch():
+    H, I, N = 8, 5, 3
+    cell = rnn.LSTMCell(H)
+    cell.initialize()
+    x = nd.random.uniform(shape=(N, I), low=-1, high=1)
+    h0 = nd.random.uniform(shape=(N, H), low=-1, high=1)
+    c0 = nd.random.uniform(shape=(N, H), low=-1, high=1)
+    out, (h1, c1) = cell(x, [h0, c0])
+
+    tc = torch.nn.LSTMCell(I, H)
+    # our gate order (reference rnn-inl.h): i, f, g, o == torch's i, f, g, o
+    with torch.no_grad():
+        tc.weight_ih.copy_(torch.tensor(_np(cell.i2h_weight.data())))
+        tc.weight_hh.copy_(torch.tensor(_np(cell.h2h_weight.data())))
+        tc.bias_ih.copy_(torch.tensor(_np(cell.i2h_bias.data())))
+        tc.bias_hh.copy_(torch.tensor(_np(cell.h2h_bias.data())))
+        th, tcell = tc(torch.tensor(_np(x)),
+                       (torch.tensor(_np(h0)), torch.tensor(_np(c0))))
+    np.testing.assert_allclose(_np(h1), th.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(c1), tcell.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_gru_cell_vs_torch():
+    H, I, N = 6, 4, 2
+    cell = rnn.GRUCell(H)
+    cell.initialize()
+    x = nd.random.uniform(shape=(N, I), low=-1, high=1)
+    h0 = nd.random.uniform(shape=(N, H), low=-1, high=1)
+    out, (h1,) = cell(x, [h0])
+
+    tc = torch.nn.GRUCell(I, H)
+    with torch.no_grad():
+        tc.weight_ih.copy_(torch.tensor(_np(cell.i2h_weight.data())))
+        tc.weight_hh.copy_(torch.tensor(_np(cell.h2h_weight.data())))
+        tc.bias_ih.copy_(torch.tensor(_np(cell.i2h_bias.data())))
+        tc.bias_hh.copy_(torch.tensor(_np(cell.h2h_bias.data())))
+        th = tc(torch.tensor(_np(x)), torch.tensor(_np(h0)))
+    np.testing.assert_allclose(_np(h1), th.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_cell_unroll_matches_layer():
+    T, N, I, H = 5, 3, 4, 6
+    cell = rnn.LSTMCell(H)
+    cell.initialize()
+    layer = rnn.LSTM(H, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(N, T, I), low=-1, high=1)
+    out_cell, _ = cell.unroll(T, x, layout="NTC")   # triggers deferred init
+    layer(x[:, :1])                                 # ditto for the layer
+    for name in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        cp = getattr(cell, name).data()
+        layer.collect_params()[layer.prefix + "l0_" + name].set_data(cp)
+    out_cell, _ = cell.unroll(T, x, layout="NTC")
+    out_layer = layer(x)
+    np.testing.assert_allclose(_np(out_cell), _np(out_layer),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_and_residual_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.GRUCell(8))
+    stack.add(rnn.ResidualCell(rnn.GRUCell(8)))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 8))
+    out, states = stack(x, stack.begin_state(2))
+    assert out.shape == (2, 8)
+    assert len(states) == 2
+
+
+# ---------------------------------------------------------------------------
+# fused layers vs torch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+@pytest.mark.parametrize("num_layers", [1, 2])
+def test_lstm_layer_vs_torch(num_layers, bidirectional):
+    T, N, I, H = 7, 4, 5, 6
+    layer = rnn.LSTM(H, num_layers=num_layers, layout="TNC",
+                     bidirectional=bidirectional)
+    layer.initialize()
+    x = nd.random.uniform(shape=(T, N, I), low=-1, high=1)
+    out = layer(x)
+
+    t_l = torch.nn.LSTM(I, H, num_layers=num_layers,
+                        bidirectional=bidirectional)
+    D = 2 if bidirectional else 1
+    with torch.no_grad():
+        for layer_i in range(num_layers):
+            for d in range(D):
+                pre = f"{'r' if d else 'l'}{layer_i}_"
+                sfx = "_reverse" if d else ""
+                getattr(t_l, f"weight_ih_l{layer_i}{sfx}").copy_(torch.tensor(
+                    _np(layer.collect_params()[layer.prefix + pre + "i2h_weight"].data())))
+                getattr(t_l, f"weight_hh_l{layer_i}{sfx}").copy_(torch.tensor(
+                    _np(layer.collect_params()[layer.prefix + pre + "h2h_weight"].data())))
+                getattr(t_l, f"bias_ih_l{layer_i}{sfx}").copy_(torch.tensor(
+                    _np(layer.collect_params()[layer.prefix + pre + "i2h_bias"].data())))
+                getattr(t_l, f"bias_hh_l{layer_i}{sfx}").copy_(torch.tensor(
+                    _np(layer.collect_params()[layer.prefix + pre + "h2h_bias"].data())))
+        t_out, _ = t_l(torch.tensor(_np(x)))
+    np.testing.assert_allclose(_np(out), t_out.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_layer_vs_torch():
+    T, N, I, H = 6, 3, 4, 5
+    layer = rnn.GRU(H, layout="TNC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(T, N, I), low=-1, high=1)
+    out = layer(x)
+    t_l = torch.nn.GRU(I, H)
+    with torch.no_grad():
+        t_l.weight_ih_l0.copy_(torch.tensor(
+            _np(layer.collect_params()[layer.prefix + "l0_i2h_weight"].data())))
+        t_l.weight_hh_l0.copy_(torch.tensor(
+            _np(layer.collect_params()[layer.prefix + "l0_h2h_weight"].data())))
+        t_l.bias_ih_l0.copy_(torch.tensor(
+            _np(layer.collect_params()[layer.prefix + "l0_i2h_bias"].data())))
+        t_l.bias_hh_l0.copy_(torch.tensor(
+            _np(layer.collect_params()[layer.prefix + "l0_h2h_bias"].data())))
+        t_out, _ = t_l(torch.tensor(_np(x)))
+    np.testing.assert_allclose(_np(out), t_out.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_layer_states_roundtrip():
+    layer = rnn.LSTM(8, num_layers=2, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 5, 4))
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (3, 5, 8)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+    assert not np.allclose(_np(new_states[0]), 0)
+
+
+def test_layer_ntc_tnc_parity():
+    layer1 = rnn.GRU(6, layout="TNC")
+    layer1.initialize()
+    x = nd.random.uniform(shape=(4, 2, 3))  # T, N, C
+    out1 = layer1(x)
+    layer2 = rnn.GRU(6, layout="NTC", prefix=layer1.prefix,
+                     params=layer1.collect_params())
+    out2 = layer2(x.transpose((1, 0, 2)))
+    np.testing.assert_allclose(_np(out1), _np(out2.transpose((1, 0, 2))),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_variable_length_masking():
+    layer = rnn.LSTM(4, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 6, 3))
+    vl = nd.array(np.array([6, 3], np.float32))
+    out = layer(x, sequence_length=vl)
+    o = _np(out)
+    assert np.allclose(o[1, 3:], 0)      # masked past valid length
+    assert not np.allclose(o[1, :3], 0)
+
+
+def test_rnn_backward_flows():
+    layer = rnn.GRU(8, num_layers=2, layout="NTC")
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 5, 4))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = layer.collect_params()[layer.prefix + "l0_i2h_weight"].grad()
+    assert g is not None and float(nd.abs(g).sum()) > 0
+
+
+def test_rnn_hybridize_parity():
+    net = gluon.nn.HybridSequential()
+    net.add(rnn.LSTM(8, layout="NTC"))
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 5, 4))
+    eager = net(x)
+    net.hybridize()
+    jitted = net(x)
+    np.testing.assert_allclose(_np(eager), _np(jitted), rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_cell():
+    l = rnn.LSTMCell(5)
+    r = rnn.LSTMCell(5)
+    bi = rnn.BidirectionalCell(l, r)
+    bi.initialize()
+    x = nd.random.uniform(shape=(3, 4, 2))  # N, T, C
+    out, states = bi.unroll(4, x, layout="NTC")
+    assert out.shape == (3, 4, 10)
+    assert len(states) == 4
+
+
+def test_zoneout_dropout_cells():
+    base = rnn.GRUCell(6)
+    z = rnn.ZoneoutCell(base, zoneout_outputs=0.5, zoneout_states=0.5)
+    z.initialize()
+    x = nd.random.uniform(shape=(4, 3))
+    s = z.begin_state(4)
+    out_eval, _ = z(x, s)   # no autograd → eval passthrough
+    base_out, _ = base(x, s)
+    np.testing.assert_allclose(_np(out_eval), _np(base_out), rtol=1e-6)
+    d = rnn.DropoutCell(0.3)
+    out, states = d(x, [])
+    assert out.shape == x.shape and states == []
+
+
+def test_bidirectional_layer_valid_length():
+    # regression: reverse-direction mask must use true time index
+    layer = rnn.LSTM(4, layout="NTC", bidirectional=True)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 6, 3), low=-1, high=1)
+    vl = nd.array(np.array([6, 3], np.float32))
+    out = layer(x, sequence_length=vl)
+    o = _np(out)
+    assert np.allclose(o[1, 3:], 0), "padding must be zeroed"
+    assert not np.allclose(o[1, :3], 0), "valid steps must be processed"
+    # sample-1 valid prefix must equal running the same params on the
+    # truncated sequence alone
+    out_short = layer(x[1:2, :3], sequence_length=nd.array(np.array([3.0])))
+    np.testing.assert_allclose(o[1, :3], _np(out_short)[0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_bidirectional_cell_valid_length():
+    l, r = rnn.LSTMCell(4), rnn.LSTMCell(4)
+    bi = rnn.BidirectionalCell(l, r)
+    bi.initialize()
+    x = nd.random.uniform(shape=(2, 6, 3), low=-1, high=1)
+    vl = nd.array(np.array([6, 3], np.float32))
+    out, states = bi.unroll(6, x, layout="NTC", valid_length=vl)
+    o = _np(out)
+    assert np.allclose(o[1, 3:], 0)
+    out_short, _ = bi.unroll(3, x[1:2, :3], layout="NTC",
+                             valid_length=nd.array(np.array([3.0])))
+    np.testing.assert_allclose(o[1, :3], _np(out_short)[0], rtol=1e-5,
+                               atol=1e-6)
