@@ -35,12 +35,7 @@ __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
 class RecurrentCell(HybridBlock):
     """Base class (parity: gluon.rnn.RecurrentCell)."""
 
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix, params)
-        self._init_counter = -1
-
     def reset(self):
-        self._init_counter = -1
         for child in self._children.values():
             if isinstance(child, RecurrentCell):
                 child.reset()
@@ -52,7 +47,6 @@ class RecurrentCell(HybridBlock):
         func = func or nd.zeros
         states = []
         for info in self.state_info(batch_size):
-            self._init_counter += 1
             shape = info["shape"]
             states.append(func(shape, **kwargs))
         return states
@@ -261,7 +255,7 @@ class ZoneoutCell(RecurrentCell):
         out_z = zone(out, prev_out, self.zoneout_outputs)
         states_z = [zone(n, o, self.zoneout_states)
                     for n, o in zip(new_states, states)]
-        self._prev_output = out
+        self._prev_output = out_z  # held positions chain the emitted value
         return out_z, states_z
 
 

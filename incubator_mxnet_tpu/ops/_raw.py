@@ -228,7 +228,13 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     """LayerNorm (reference src/operator/nn/layer_norm.cc). Stats in f32 for
-    bf16 stability, one fused XLA chain."""
+    bf16 stability, one fused XLA chain. Last-axis case dispatches to the
+    fused pallas kernel on TPU (ops/pallas/layer_norm.py)."""
+    if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+        from . import pallas as _pallas
+        if _pallas.enabled() and (jax.default_backend() != "tpu"
+                                  or x.shape[-1] % 128 == 0):
+            return _pallas.layer_norm(x, gamma, beta, eps)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axis, keepdims=True)
     var = jnp.var(xf, axis=axis, keepdims=True)
@@ -349,10 +355,16 @@ def smooth_l1(x, scalar=1.0):
 # ---------------------------------------------------------------------------
 
 def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
-                        key=None, training=False, scale=None):
+                        key=None, training=False, scale=None, causal=False):
     """Batched MHA on (B, L, D) inputs already projected; splits heads,
     scaled-dot-product, merges heads. Reference: src/operator/contrib/
-    transformer.cc (interleaved_matmul_*)."""
+    transformer.cc (interleaved_matmul_*).
+
+    Fast path: when no custom mask/dropout is active, dispatches to the
+    pallas flash-attention kernel (ops/pallas/) — O(L) memory, scores stay
+    in VMEM."""
+    from . import pallas as _pallas
+
     b, lq, d = q.shape
     lk = k.shape[1]
     hd = d // num_heads
@@ -361,8 +373,20 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
     def split(x, l):
         return x.reshape(b, l, num_heads, hd).transpose(0, 2, 1, 3)
 
+    if (mask is None and not (dropout_rate > 0.0 and training)
+            and _pallas.enabled()):
+        out = _pallas.flash_attention(split(q, lq), split(k, lk), split(v, lk),
+                                      causal=causal, scale=scale)
+        return out.transpose(0, 2, 1, 3).reshape(b, lq, d)
+
     qh, kh, vh = split(q, lq), split(k, lk), split(v, lk)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        if lq > lk:
+            raise ValueError("causal attention with more queries than keys is "
+                             "undefined (use an explicit mask)")
+        tri = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+        mask = tri if mask is None else jnp.logical_and(mask, tri)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
     w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)

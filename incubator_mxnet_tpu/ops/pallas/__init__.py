@@ -1,0 +1,28 @@
+"""Pallas TPU kernels for the hot ops (reference analogue: the hand-written
+CUDA/cuDNN kernels under src/operator/contrib/ and src/operator/nn/).
+
+On TPU these run as real Mosaic kernels; off-TPU they run with
+``interpret=True`` (tests) or are bypassed in favor of the XLA path.
+"""
+from .flash_attention import flash_attention
+from .layer_norm import layer_norm
+
+import os
+
+import jax
+
+__all__ = ["flash_attention", "layer_norm", "enabled"]
+
+
+def enabled() -> bool:
+    """Use pallas kernels for framework ops? On by default on TPU; set
+    MXTPU_FORCE_PALLAS=1 to exercise interpret-mode kernels off-TPU, or
+    MXTPU_NO_PALLAS=1 to force the plain XLA path everywhere."""
+    def _truthy(name):
+        return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
+
+    if _truthy("MXTPU_NO_PALLAS"):
+        return False
+    if _truthy("MXTPU_FORCE_PALLAS"):
+        return True
+    return jax.default_backend() == "tpu"
