@@ -141,7 +141,8 @@ def UpSampling(data, scale=2, sample_type="nearest", layout="NCHW"):
     return _apply(f, [data], name="UpSampling")
 
 
-def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0):
+def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
+                        scale=None, causal=False):
     training = autograd.is_training()
     key = ndrandom._key() if (dropout_rate > 0.0 and training) else None
     inputs = [q, k, v] + ([mask] if mask is not None else [])
@@ -149,7 +150,7 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0):
     def f(qq, kk, vv, *rest):
         m = rest[0] if rest else None
         return _raw.multihead_attention(qq, kk, vv, num_heads, m, dropout_rate,
-                                        key, training)
+                                        key, training, scale, causal)
     return _apply(f, inputs, name="multihead_attention")
 
 

@@ -1,0 +1,124 @@
+"""BERT model tests (mirrors gluonnlp tests/unittest/test_models.py bert
+cases + scripts/bert pretraining smoke)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.bert import (
+    BERTModel, BERTForPretrain, BERTPretrainLoss, get_bert_model)
+
+
+def tiny_bert(**kw):
+    cfg = dict(num_layers=2, units=32, hidden_size=64, num_heads=4,
+               max_length=64, vocab_size=100, dropout=0.0)
+    cfg.update(kw)
+    return BERTModel(**cfg)
+
+
+def test_bert_forward_shapes():
+    mx.random.seed(0)
+    net = tiny_bert()
+    net.initialize()
+    B, L = 2, 16
+    ids = nd.array(np.random.randint(0, 100, (B, L)))
+    tt = nd.array(np.random.randint(0, 2, (B, L)))
+    vl = nd.array(np.array([16, 9]))
+    seq, pooled = net(ids, tt, vl)
+    assert seq.shape == (B, L, 32)
+    assert pooled.shape == (B, 32)
+    assert np.isfinite(seq.asnumpy()).all()
+
+
+def test_bert_valid_length_masks_padding():
+    """Positions past valid_length must not affect earlier outputs."""
+    mx.random.seed(0)
+    net = tiny_bert()
+    net.initialize()
+    B, L, VL = 1, 12, 7
+    ids = np.random.randint(0, 100, (B, L))
+    vl = nd.array(np.array([VL]))
+    seq1, _ = net(nd.array(ids), None, vl)
+    ids2 = ids.copy()
+    ids2[:, VL:] = 55  # change only padded tokens
+    seq2, _ = net(nd.array(ids2), None, vl)
+    np.testing.assert_allclose(seq1.asnumpy()[:, :VL],
+                               seq2.asnumpy()[:, :VL], rtol=2e-5, atol=2e-5)
+
+
+def test_bert_hybridize_parity():
+    mx.random.seed(0)
+    net = tiny_bert()
+    net.initialize()
+    B, L = 2, 8
+    ids = nd.array(np.random.randint(0, 100, (B, L)))
+    seq_e, pooled_e = net(ids)
+    net.hybridize()
+    seq_h, pooled_h = net(ids)
+    np.testing.assert_allclose(seq_e.asnumpy(), seq_h.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pooled_e.asnumpy(), pooled_h.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bert_pretrain_loss_decreases():
+    mx.random.seed(0)
+    np.random.seed(0)
+    bert = tiny_bert()
+    net = BERTForPretrain(bert, vocab_size=100)
+    net.initialize()
+    B, L, M = 4, 16, 3
+    ids = nd.array(np.random.randint(0, 100, (B, L)))
+    tt = nd.array(np.zeros((B, L), dtype=np.int32))
+    vl = nd.array(np.full((B,), L))
+    pos = nd.array(np.random.randint(0, L, (B, M)))
+    mlm_labels = nd.array(np.random.randint(0, 100, (B, M)))
+    nsp_labels = nd.array(np.random.randint(0, 2, (B,)))
+    L_fn = BERTPretrainLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adamw",
+                            {"learning_rate": 1e-3})
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            mlm, nsp = net(ids, tt, vl, pos)
+            loss = L_fn(mlm, nsp, mlm_labels, nsp_labels)
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_bert_mlm_ignores_pad_label():
+    """Loss over labels padded with -1 == loss over only the valid slots."""
+    mx.random.seed(0)
+    bert = tiny_bert()
+    net = BERTForPretrain(bert, vocab_size=100)
+    net.initialize()
+    B, L = 2, 8
+    ids = nd.array(np.random.randint(0, 100, (B, L)))
+    tt = nd.array(np.zeros((B, L), dtype=np.int32))
+    vl = nd.array(np.full((B,), L))
+    nspl = nd.array(np.zeros((B,), dtype=np.int32))
+    L_fn = BERTPretrainLoss()
+    # padded: one valid slot per row + three -1 pads (at the same position 0)
+    pos4 = nd.array(np.zeros((B, 4), dtype=np.int32))
+    mlm4, nsp = net(ids, tt, vl, pos4)
+    labels4 = nd.array(np.array([[5, -1, -1, -1], [7, -1, -1, -1]]))
+    l_padded = float(L_fn(mlm4, nsp, labels4, nspl).asnumpy())
+    # unpadded: only the valid slots
+    pos1 = nd.array(np.zeros((B, 1), dtype=np.int32))
+    mlm1, nsp1 = net(ids, tt, vl, pos1)
+    labels1 = nd.array(np.array([[5], [7]]))
+    l_valid = float(L_fn(mlm1, nsp1, labels1, nspl).asnumpy())
+    assert abs(l_padded - l_valid) < 1e-5
+    # and a padded slot flipped to a valid label MUST change the loss
+    labels4b = nd.array(np.array([[5, 42, -1, -1], [7, -1, -1, -1]]))
+    l_changed = float(L_fn(mlm4, nsp, labels4b, nspl).asnumpy())
+    assert abs(l_changed - l_padded) > 1e-4
+
+
+def test_get_bert_model_configs():
+    net = get_bert_model("bert_12_768_12", vocab_size=50)
+    assert len(net.encoder.cells) == 12
+    net = get_bert_model("bert_24_1024_16", vocab_size=50)
+    assert len(net.encoder.cells) == 24
