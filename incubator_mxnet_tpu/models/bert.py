@@ -17,18 +17,15 @@ TPU-first design decisions:
 """
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
 import numpy as np
 
-from .. import autograd
 from ..ndarray import NDArray, _apply
 from .. import ndarray as nd
 from .. import ops
 from ..gluon import nn
 from ..gluon.block import HybridBlock
-from ..gluon.loss import Loss, SoftmaxCrossEntropyLoss
+from ..gluon.loss import Loss
 
 __all__ = ["BERTModel", "BERTEncoder", "BERTEncoderCell", "PositionwiseFFN",
            "MultiHeadAttentionCell", "BERTForPretrain", "BERTPretrainLoss",
@@ -195,6 +192,10 @@ class BERTForPretrain(HybridBlock):
 
     def __init__(self, bert: BERTModel, vocab_size, prefix=None, params=None):
         super().__init__(prefix, params)
+        if bert.pooler is None:
+            raise ValueError("BERTForPretrain needs a BERTModel built with "
+                             "use_pooler=True (the NSP head reads the pooled "
+                             "[CLS] output)")
         self.bert = bert
         self._vocab_size = vocab_size
         units = bert._units

@@ -15,5 +15,14 @@ all-gather / reduce-scatter) over ICI. Axes convention:
 """
 from .mesh import make_mesh, data_parallel_spec
 from .trainer_step import FusedTrainStep
+from .ring_attention import ring_attention, ring_self_attention
+from .pipeline import pipeline_apply, spmd_pipeline
+from .moe import moe_gate, moe_ffn, MoEFFN
+from .tensor_parallel import (column_parallel, row_parallel,
+                              annotate_bert_tp, annotate_ffn_tp)
 
-__all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep"]
+__all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep",
+           "ring_attention", "ring_self_attention", "pipeline_apply",
+           "spmd_pipeline", "moe_gate", "moe_ffn", "MoEFFN",
+           "column_parallel", "row_parallel", "annotate_bert_tp",
+           "annotate_ffn_tp"]

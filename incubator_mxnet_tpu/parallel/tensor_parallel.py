@@ -1,0 +1,60 @@
+"""Tensor (Megatron-style) parallelism via GSPMD sharding annotations.
+
+The reference has no TP (its model parallelism is ps-lite placement); the
+TPU-native design gets TP "for free" from XLA: annotate each weight's
+PartitionSpec (Parameter._sharding, consumed by FusedTrainStep /
+pjit in_shardings) and GSPMD partitions the GEMMs and inserts the
+all-reduces over the `tp` ICI axis — the f/g collectives of Megatron,
+derived by the compiler instead of hand-written.
+
+Convention for gluon Dense (weight shape = (units, in_units)):
+  column-parallel: split the output dim  -> P(tp, None), bias P(tp)
+  row-parallel:    split the input dim   -> P(None, tp), bias P() (replicated)
+A column->row pair (e.g. ffn_1 -> ffn_2, qkv -> proj) needs exactly one
+all-reduce at the pair's end, which XLA places automatically.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["column_parallel", "row_parallel", "annotate_bert_tp",
+           "annotate_ffn_tp"]
+
+
+def column_parallel(dense, axis: str = "tp"):
+    """Split a gluon Dense over its output (units) dim."""
+    dense.weight._sharding = P(axis, None)
+    if dense.bias is not None:
+        dense.bias._sharding = P(axis)
+    return dense
+
+
+def row_parallel(dense, axis: str = "tp"):
+    """Split a gluon Dense over its input dim; output is partial-summed by an
+    XLA all-reduce."""
+    dense.weight._sharding = P(None, axis)
+    if dense.bias is not None:
+        dense.bias._sharding = P()
+    return dense
+
+
+def annotate_ffn_tp(ffn, axis: str = "tp"):
+    """PositionwiseFFN: ffn_1 column-parallel, ffn_2 row-parallel."""
+    column_parallel(ffn.ffn_1, axis)
+    row_parallel(ffn.ffn_2, axis)
+    return ffn
+
+
+def annotate_bert_tp(bert_model, axis: str = "tp"):
+    """Annotate a models.bert.BERTModel for tensor parallelism.
+
+    Per encoder cell: fused qkv column-parallel (heads split over tp), output
+    proj row-parallel, FFN column->row. Embeddings: vocab dim split (the
+    gather's all-reduce is inserted by XLA). LayerNorms stay replicated.
+    """
+    bert_model.word_embed.weight._sharding = P(axis, None)
+    for cell in bert_model.encoder.cells:
+        column_parallel(cell.attention.qkv, axis)
+        row_parallel(cell.attention.proj, axis)
+        annotate_ffn_tp(cell.ffn, axis)
+    return bert_model

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
@@ -112,6 +113,19 @@ class FusedTrainStep:
 
             def pspec(p):
                 spec = p._sharding if p._sharding is not None else P()
+                # replicate instead of shard when a dim doesn't divide the
+                # mesh axis (e.g. unpadded vocab under tp) — annotation is a
+                # layout hint, never a correctness constraint
+                shape = p.shape
+                for d, ax in enumerate(spec):
+                    if ax is None:
+                        continue
+                    axes = ax if isinstance(ax, tuple) else (ax,)
+                    if any(a not in self.mesh.shape for a in axes):
+                        return NamedSharding(self.mesh, P())
+                    size = int(np.prod([self.mesh.shape[a] for a in axes]))
+                    if d >= len(shape) or shape[d] % size:
+                        return NamedSharding(self.mesh, P())
                 return NamedSharding(self.mesh, spec)
 
             train_sh = [pspec(params[i]) for i in self.train_idx]
