@@ -8,12 +8,16 @@ from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,
                      resnet50_v2, resnet101_v2, resnet152_v2)
 from .bert import (BERTModel, BERTForPretrain, BERTPretrainLoss,
                    get_bert_model, bert_12_768_12, bert_24_1024_16)
+from .ssd import (SSD, SSDLoss, ssd_512_resnet18_v1, ssd_512_resnet50_v1,
+                  ssd_300_resnet18_v1)
 
 _MODELS = {}
 for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
               "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
               "resnet101_v2", "resnet152_v2", "lenet",
-              "bert_12_768_12", "bert_24_1024_16"]:
+              "bert_12_768_12", "bert_24_1024_16",
+              "ssd_512_resnet18_v1", "ssd_512_resnet50_v1",
+              "ssd_300_resnet18_v1"]:
     _MODELS[_name] = globals()[_name]
 
 

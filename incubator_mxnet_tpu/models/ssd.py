@@ -1,0 +1,180 @@
+"""SSD single-shot detector (parity: reference example/ssd — symbol_builder,
+multibox targets, SmoothL1+CE with hard negative mining; gluoncv-style
+model API).
+
+TPU-first shape discipline: the anchor set, target matching, loss masking and
+NMS are all static-shape (ops/box.py), so the entire train step — backbone,
+multi-scale heads, MultiBoxTarget, hard-negative mining, loss — jits into one
+XLA computation. NHWC layout by default (MXU-friendly convs).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.loss import Loss
+from ..ndarray import _apply
+from .. import ndarray as nd
+from .. import ops
+from . import resnet as _resnet
+
+__all__ = ["SSD", "SSDLoss", "ssd_512_resnet18_v1", "ssd_512_resnet50_v1",
+           "ssd_300_resnet18_v1"]
+
+
+class _PredHead(HybridBlock):
+    """3x3 conv predictor; emits (B, HW*K, E) rows from an NHWC/NCHW map."""
+
+    def __init__(self, num_anchors, entries, layout, **kw):
+        super().__init__(**kw)
+        self._entries = entries
+        self._layout = layout
+        self.conv = nn.Conv2D(num_anchors * entries, 3, padding=1,
+                              layout=layout)
+
+    def forward(self, x):
+        y = self.conv(x)
+        if self._layout == "NCHW":
+            y = y.transpose((0, 2, 3, 1))
+        b = y.shape[0]
+        return y.reshape((b, -1, self._entries))
+
+
+class SSD(HybridBlock):
+    """Generic SSD: backbone feature extractor + extra downsampling stages +
+    per-scale class/box heads + MultiBoxPrior anchors.
+
+    forward(x) -> (anchors (1, A, 4), cls_preds (B, A, C+1),
+                   box_preds (B, A*4))
+    """
+
+    def __init__(self, backbone_features, num_classes, sizes, ratios,
+                 extra_channels=(512, 256, 256, 256), layout="NHWC",
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(sizes) == len(ratios)
+        self._num_classes = num_classes
+        self._layout = layout
+        self._sizes = sizes
+        self._ratios = ratios
+        self.features = backbone_features
+        self.extras = nn.HybridSequential()
+        for ch in extra_channels:
+            stage = nn.HybridSequential()
+            stage.add(nn.Conv2D(ch // 2, 1, layout=layout, activation="relu"))
+            stage.add(nn.Conv2D(ch, 3, strides=2, padding=1, layout=layout,
+                                activation="relu"))
+            self.extras.add(stage)
+        n_scales = 1 + len(extra_channels)
+        assert len(sizes) == n_scales, (len(sizes), n_scales)
+        self.cls_heads = nn.HybridSequential()
+        self.box_heads = nn.HybridSequential()
+        for s, r in zip(sizes, ratios):
+            k = len(s) + len(r) - 1
+            self.cls_heads.add(_PredHead(k, num_classes + 1, layout))
+            self.box_heads.add(_PredHead(k, 4, layout))
+
+    def forward(self, x):
+        feats = [self.features(x)]
+        for stage in self.extras:
+            feats.append(stage(feats[-1]))
+        anchors, cls_preds, box_preds = [], [], []
+        for i, f in enumerate(feats):
+            anchors.append(ops.MultiBoxPrior(
+                f, sizes=self._sizes[i], ratios=self._ratios[i],
+                layout=self._layout))
+            cls_preds.append(self.cls_heads[i](f))
+            box_preds.append(self.box_heads[i](f))
+        anchor = nd.concat(*anchors, dim=1)
+        cls_pred = nd.concat(*cls_preds, dim=1)             # (B, A, C+1)
+        box_pred = nd.concat(*box_preds, dim=1)             # (B, A, 4)
+        b = box_pred.shape[0]
+        return anchor, cls_pred, box_pred.reshape((b, -1))
+
+    # -- inference ---------------------------------------------------------
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=400):
+        """(B, A, 6) detections [cls, score, x0, y0, x1, y1]; rows with
+        cls = -1 are suppressed (reference MultiBoxDetection output)."""
+        anchor, cls_pred, box_pred = self(x)
+        cls_prob = nd.softmax(cls_pred, axis=-1).transpose((0, 2, 1))
+        return ops.MultiBoxDetection(cls_prob, box_pred, anchor,
+                                     threshold=threshold,
+                                     nms_threshold=nms_threshold,
+                                     nms_topk=nms_topk)
+
+    def targets(self, anchor, cls_pred, label, negative_mining_ratio=3):
+        """MultiBoxTarget with hard negative mining (cls_pred-aware)."""
+        return ops.MultiBoxTarget(
+            anchor, label, cls_pred.transpose((0, 2, 1)),
+            overlap_threshold=0.5,
+            negative_mining_ratio=negative_mining_ratio,
+            negative_mining_thresh=0.5)
+
+
+class SSDLoss(Loss):
+    """CE over mined anchors (cls_target = -1 ignored) + SmoothL1 on
+    positives, normalized by positive count (reference example/ssd
+    MultiBoxLoss / training/losses)."""
+
+    def __init__(self, lambd=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._lambd = lambd
+
+    def forward(self, cls_pred, box_pred, cls_target, box_target, box_mask):
+        import jax
+        import jax.numpy as jnp
+
+        def f(cp, bp, ct, bt, bm):
+            logp = jax.nn.log_softmax(cp.astype(jnp.float32), axis=-1)
+            ctc = jnp.maximum(ct, 0).astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, ctc[..., None], -1)[..., 0]
+            cls_loss = jnp.where(ct >= 0, nll, 0.0)
+            n_pos = jnp.maximum((ct > 0).sum(), 1).astype(jnp.float32)
+            diff = jnp.abs((bp - bt) * bm)
+            sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+            return (cls_loss.sum() + self._lambd * sl1.sum()) / n_pos
+        return _apply(f, [cls_pred, box_pred, cls_target, box_target,
+                          box_mask], name="ssd_loss")
+
+
+def _resnet_features(num_layers, layout):
+    """Backbone = ResNet stages through conv4 (stride 16), like the
+    reference's resnet50 SSD feature map 1."""
+    net = _resnet.get_resnet(1, num_layers, layout=layout)
+    feats = nn.HybridSequential()
+    # keep conv1..stage3 (drop stage4, pool, flatten, output)
+    for child in list(net.features._children.values())[:-3]:
+        feats.add(child)
+    return feats
+
+
+# Anchor configs follow the reference example/ssd defaults: 300-input uses
+# 5 scales here (backbone + 4 extras), 512-input adds a 6th coarser scale.
+_SSD_300 = dict(
+    sizes=[[0.1, 0.141], [0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+           [0.71, 0.79]],
+    ratios=[[1, 2, 0.5]] * 2 + [[1, 2, 0.5, 3, 1.0 / 3]] * 3,
+    extra_channels=(512, 256, 256, 256))
+_SSD_512 = dict(
+    sizes=[[0.07, 0.1], [0.15, 0.222], [0.3, 0.367], [0.45, 0.519],
+           [0.6, 0.67], [0.75, 0.82]],
+    ratios=[[1, 2, 0.5]] * 2 + [[1, 2, 0.5, 3, 1.0 / 3]] * 4,
+    extra_channels=(512, 256, 256, 256, 256))
+
+
+def _make_ssd(num_layers, classes, layout, cfg, **kwargs):
+    return SSD(_resnet_features(num_layers, layout), classes,
+               layout=layout, **cfg, **kwargs)
+
+
+def ssd_512_resnet18_v1(classes=20, layout="NHWC", **kwargs):
+    return _make_ssd(18, classes, layout, _SSD_512, **kwargs)
+
+
+def ssd_512_resnet50_v1(classes=20, layout="NHWC", **kwargs):
+    return _make_ssd(50, classes, layout, _SSD_512, **kwargs)
+
+
+def ssd_300_resnet18_v1(classes=20, layout="NHWC", **kwargs):
+    return _make_ssd(18, classes, layout, _SSD_300, **kwargs)

@@ -12,10 +12,14 @@ from ..ndarray import NDArray, _apply, _as_nd
 from ..ndarray import random as ndrandom
 from . import _raw
 
+from .box import (box_iou, box_nms, MultiBoxPrior, MultiBoxTarget,
+                  MultiBoxDetection)
+
 __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Activation",
            "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
-           "UpSampling", "multihead_attention"]
+           "UpSampling", "multihead_attention", "box_iou", "box_nms",
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection"]
 
 
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
@@ -154,12 +158,20 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
     return _apply(f, inputs, name="multihead_attention")
 
 
-# Mirror the op namespace onto mx.nd for reference-style calls.
+# Mirror the op namespace onto mx.nd for reference-style calls, and expose
+# the box/SSD family under mx.nd.contrib.* like the reference.
 def _mirror_into_nd():
     import sys
+    import types
     nd_mod = sys.modules["incubator_mxnet_tpu.ndarray"]
     for name in __all__:
         setattr(nd_mod, name, globals()[name])
+    contrib = types.ModuleType("incubator_mxnet_tpu.ndarray.contrib")
+    for name in ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
+                 "MultiBoxDetection", "multihead_attention"]:
+        setattr(contrib, name, globals()[name])
+    nd_mod.contrib = contrib
+    sys.modules["incubator_mxnet_tpu.ndarray.contrib"] = contrib
 
 
 _mirror_into_nd()

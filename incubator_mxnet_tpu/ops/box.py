@@ -1,0 +1,292 @@
+"""Bounding-box ops (parity: reference src/operator/contrib/
+{bounding_box,multibox_prior,multibox_target,multibox_detection}.cc —
+the SSD op family, mx.nd.contrib.*).
+
+TPU-first rebuild: every op is static-shape and vectorized (one-hot matmuls,
+pairwise-IoU matrices, lax.scan for the sequential NMS dependency) — no
+dynamic box counts, so everything jits and batches. Coordinates are corner
+format (xmin, ymin, xmax, ymax), normalized, matching the reference default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray import _apply
+
+__all__ = ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
+           "MultiBoxDetection"]
+
+_VAR = (0.1, 0.1, 0.2, 0.2)  # reference multibox center/size variances
+
+
+# ---------------------------------------------------------------------------
+# raw (jnp-level) kernels
+# ---------------------------------------------------------------------------
+
+def _iou_corner(a, b):
+    """Pairwise IoU. a: (..., M, 4), b: (..., N, 4) -> (..., M, N)."""
+    ax0, ay0, ax1, ay1 = jnp.split(a, 4, axis=-1)          # (..., M, 1)
+    bx0, by0, bx1, by1 = (t[..., None, :, 0] for t in jnp.split(b, 4, axis=-1))
+    ix0 = jnp.maximum(ax0, bx0)
+    iy0 = jnp.maximum(ay0, by0)
+    ix1 = jnp.minimum(ax1, bx1)
+    iy1 = jnp.minimum(ay1, by1)
+    inter = jnp.clip(ix1 - ix0, 0) * jnp.clip(iy1 - iy0, 0)
+    area_a = jnp.clip(ax1 - ax0, 0) * jnp.clip(ay1 - ay0, 0)
+    area_b = jnp.clip(bx1 - bx0, 0) * jnp.clip(by1 - by0, 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _center_to_corner(x):
+    cx, cy, w, h = jnp.split(x, 4, axis=-1)
+    return jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _corner_to_center(x):
+    x0, y0, x1, y1 = jnp.split(x, 4, axis=-1)
+    return jnp.concatenate([(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0], -1)
+
+
+def _multibox_prior(h, w, sizes, ratios, steps, offsets, dtype=jnp.float32):
+    """Anchors for one (H, W) feature map -> (H*W*(S+R-1), 4) corner coords.
+
+    Per pixel: [s1,r1], [s2,r1], ..., [sn,r1], [s1,r2], ..., [s1,rm]
+    (reference layout: all sizes with first ratio, then first size with the
+    remaining ratios)."""
+    sizes = jnp.asarray(sizes, dtype)
+    ratios = jnp.asarray(ratios, dtype)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=dtype) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=dtype) + offsets[1]) * step_x
+    # anchor shapes
+    r0 = jnp.sqrt(ratios[0])
+    ws = jnp.concatenate([sizes * r0, sizes[0] * jnp.sqrt(ratios[1:])])
+    hs = jnp.concatenate([sizes / r0, sizes[0] / jnp.sqrt(ratios[1:])])
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")               # (H, W)
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    out = jnp.stack([cxg - ws / 2, cyg - hs / 2, cxg + ws / 2, cyg + hs / 2],
+                    axis=-1)                                      # (H, W, K, 4)
+    return out.reshape(-1, 4)
+
+
+def _encode_boxes(gt_corner, anchors_corner, variances=_VAR):
+    """Corner GT + corner anchors -> variance-scaled center offsets."""
+    g = _corner_to_center(gt_corner)
+    a = _corner_to_center(anchors_corner)
+    tx = (g[..., 0] - a[..., 0]) / jnp.maximum(a[..., 2], 1e-12) / variances[0]
+    ty = (g[..., 1] - a[..., 1]) / jnp.maximum(a[..., 3], 1e-12) / variances[1]
+    tw = jnp.log(jnp.maximum(g[..., 2] / jnp.maximum(a[..., 2], 1e-12),
+                             1e-12)) / variances[2]
+    th = jnp.log(jnp.maximum(g[..., 3] / jnp.maximum(a[..., 3], 1e-12),
+                             1e-12)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def _decode_boxes(pred, anchors_corner, clip=True, variances=_VAR):
+    """Variance-scaled offsets -> corner boxes."""
+    a = _corner_to_center(anchors_corner)
+    cx = pred[..., 0] * variances[0] * a[..., 2] + a[..., 0]
+    cy = pred[..., 1] * variances[1] * a[..., 3] + a[..., 1]
+    w = jnp.exp(jnp.clip(pred[..., 2] * variances[2], -10, 10)) * a[..., 2]
+    h = jnp.exp(jnp.clip(pred[..., 3] * variances[3], -10, 10)) * a[..., 3]
+    out = _center_to_corner(jnp.stack([cx, cy, w, h], axis=-1))
+    return jnp.clip(out, 0.0, 1.0) if clip else out
+
+
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold,
+                     negative_mining_ratio, negative_mining_thresh,
+                     ignore_label=-1, minimum_negative_samples=0,
+                     variances=_VAR):
+    """Single image. anchors (A,4); labels (M,5) [cls x0 y0 x1 y1], cls=-1
+    pad; cls_preds (C+1, A). Returns (box_target (A,4), box_mask (A,4),
+    cls_target (A,) int32 [0=background, c+1=class c])."""
+    A = anchors.shape[0]
+    valid = labels[:, 0] >= 0                                   # (M,)
+    iou = _iou_corner(anchors, labels[:, 1:5])                  # (A, M)
+    iou = jnp.where(valid[None, :], iou, -1.0)
+    # per-anchor best gt
+    best_gt = jnp.argmax(iou, axis=1)                           # (A,)
+    best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
+    matched = best_iou >= overlap_threshold
+    # bipartite: each VALID gt claims its best anchor (overrides threshold);
+    # padded gts scatter to index A and are dropped
+    gt_best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), A)  # (M,)
+    forced = jnp.zeros((A,), bool)
+    forced = forced.at[gt_best_anchor].set(True, mode="drop")
+    gt_of_forced = jnp.zeros((A,), jnp.int32)
+    gt_of_forced = gt_of_forced.at[gt_best_anchor].set(
+        jnp.arange(labels.shape[0], dtype=jnp.int32), mode="drop")
+    assign_gt = jnp.where(forced, gt_of_forced, best_gt)
+    positive = jnp.logical_or(matched & (best_iou > 0), forced)
+    gt_boxes = labels[assign_gt, 1:5]                           # (A, 4)
+    gt_cls = labels[assign_gt, 0].astype(jnp.int32)
+    box_target = jnp.where(positive[:, None],
+                           _encode_boxes(gt_boxes, anchors, variances), 0.0)
+    box_mask = jnp.broadcast_to(positive[:, None], (A, 4)).astype(jnp.float32)
+    cls_target = jnp.where(positive, gt_cls + 1, 0)
+    if negative_mining_ratio > 0 and cls_preds is not None:
+        # hard negatives: largest background score gap first
+        probs = jax.nn.softmax(cls_preds, axis=0)               # (C+1, A)
+        neg_score = 1.0 - probs[0]                              # bg error
+        neg_score = jnp.where(positive, -1.0, neg_score)
+        neg_score = jnp.where(neg_score > negative_mining_thresh,
+                              neg_score, -1.0)
+        n_pos = positive.sum()
+        n_neg = jnp.clip((n_pos * negative_mining_ratio).astype(jnp.int32),
+                         minimum_negative_samples, A)
+        order = jnp.argsort(-neg_score)                          # desc
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(
+            jnp.arange(A, dtype=jnp.int32))
+        keep_neg = (rank < n_neg) & (neg_score > -1.0)
+        # ignore_label marks anchors excluded from the cls loss
+        cls_target = jnp.where(positive, cls_target,
+                               jnp.where(keep_neg, 0, ignore_label))
+    return box_target, box_mask, cls_target
+
+
+def _nms_mask(boxes, scores, ids, iou_threshold, valid, force_suppress):
+    """Greedy NMS keep-mask over score-sorted boxes via lax.scan.
+    boxes (K,4), scores (K,), ids (K,) — already sorted desc by score."""
+    K = boxes.shape[0]
+    iou = _iou_corner(boxes, boxes)                             # (K, K)
+    same_cls = (ids[:, None] == ids[None, :]) | force_suppress
+    suppress_pair = (iou > iou_threshold) & same_cls            # i suppresses j
+
+    def step(alive, i):
+        keep_i = alive[i] & valid[i]
+        alive = alive & ~(keep_i & suppress_pair[i] &
+                          (jnp.arange(K) > i))
+        return alive, keep_i
+
+    alive0 = jnp.ones((K,), bool)
+    _, keep = lax.scan(step, alive0, jnp.arange(K))
+    return keep & valid
+
+
+def _box_nms(data, overlap_thresh, valid_thresh, topk, coord_start,
+             score_index, id_index, force_suppress, background_id,
+             in_format="corner"):
+    """data (B, K, E) rows [.. id? score coords ..] -> same shape, suppressed
+    rows set to -1, kept rows score-sorted first (reference box_nms
+    semantics). Only the top-`topk` candidates enter the O(T^2) suppression
+    matrix — the rest are below them in score and returned as -1."""
+    scores = data[..., score_index]
+    ids = (data[..., id_index].astype(jnp.int32) if id_index >= 0
+           else jnp.zeros(scores.shape, jnp.int32))
+    boxes = lax.dynamic_slice_in_dim(data, coord_start, 4, axis=-1)
+    if in_format == "center":
+        boxes = _center_to_corner(boxes)
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid &= ids != background_id
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+    K = data.shape[1]
+    T = min(topk, K) if topk > 0 else K
+
+    def per_image(d, b, s, i, v, o):
+        ot = o[:T]
+        db, bb, sb, ib, vb = d[ot], b[ot], s[ot], i[ot], v[ot]
+        keep = _nms_mask(bb, sb, ib, overlap_thresh, vb, force_suppress)
+        out_top = jnp.where(keep[:, None], db, -jnp.ones_like(db))
+        if T == K:
+            return out_top
+        pad = -jnp.ones((K - T, d.shape[-1]), d.dtype)
+        return jnp.concatenate([out_top, pad], axis=0)
+
+    return jax.vmap(per_image)(data, boxes, scores, ids, valid, order)
+
+
+# ---------------------------------------------------------------------------
+# recordable NDArray-level ops
+# ---------------------------------------------------------------------------
+
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: mx.nd.contrib.box_iou)."""
+    def f(a, b):
+        if format == "center":
+            a, b = _center_to_corner(a), _center_to_corner(b)
+        return _iou_corner(a, b)
+    return _apply(f, [lhs, rhs], name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference: mx.nd.contrib.box_nms).
+    Suppressed/invalid rows become all -1; rows are returned score-sorted."""
+    if out_format != in_format:
+        raise NotImplementedError("box_nms: out_format conversion not "
+                                  "supported; rows keep their input format")
+
+    def f(d):
+        one = d.ndim == 2
+        db = d[None] if one else d
+        out = _box_nms(db, overlap_thresh, valid_thresh, topk, coord_start,
+                       score_index, id_index, force_suppress, background_id,
+                       in_format)
+        return out[0] if one else out
+    return _apply(f, [data], name="box_nms")
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                  offsets=(0.5, 0.5), layout="NCHW"):
+    """Anchor generation (reference: mx.nd.contrib.MultiBoxPrior).
+    data: feature map; returns (1, H*W*K, 4) corner anchors."""
+    def f(x):
+        h, w = (x.shape[2], x.shape[3]) if layout == "NCHW" else \
+               (x.shape[1], x.shape[2])
+        return _multibox_prior(h, w, sizes, ratios, steps, offsets,
+                               x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                               else jnp.float32)[None]
+    return _apply(f, [data], name="MultiBoxPrior")
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1, negative_mining_ratio=-1,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=_VAR):
+    """Anchor-GT matching + target encoding (reference:
+    mx.nd.contrib.MultiBoxTarget). anchor (1,A,4); label (B,M,5);
+    cls_pred (B,C+1,A). Returns (box_target (B,A*4), box_mask (B,A*4),
+    cls_target (B,A))."""
+    def f(anc, lab, cp):
+        def one(lab_i, cp_i):
+            bt, bm, ct = _multibox_target(anc[0], lab_i, cp_i,
+                                          overlap_threshold,
+                                          negative_mining_ratio,
+                                          negative_mining_thresh,
+                                          ignore_label,
+                                          minimum_negative_samples,
+                                          variances)
+            return bt.reshape(-1), bm.reshape(-1), ct
+        return jax.vmap(one)(lab, cp)
+    return _apply(f, [anchor, label, cls_pred], n_out=3, name="MultiBoxTarget")
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
+                      clip=True, nms_threshold=0.5, force_suppress=False,
+                      variances=_VAR, nms_topk=400):
+    """Decode + per-class NMS (reference: mx.nd.contrib.MultiBoxDetection).
+    cls_prob (B,C+1,A); loc_pred (B,A*4); anchor (1,A,4).
+    Returns (B, A, 6) rows [class_id, score, x0, y0, x1, y1]; suppressed
+    rows have class_id = -1."""
+    def f(cp, lp, anc):
+        b = cp.shape[0]
+        a = anc.shape[1]
+        boxes = _decode_boxes(lp.reshape(b, a, 4), anc, clip,
+                              variances)                         # (B,A,4)
+        # best non-background class per anchor
+        cls_id = jnp.argmax(cp[:, 1:, :], axis=1)                # (B,A)
+        score = jnp.max(cp[:, 1:, :], axis=1)
+        keep = score > threshold
+        rows = jnp.concatenate([
+            jnp.where(keep, cls_id, -1).astype(boxes.dtype)[..., None],
+            jnp.where(keep, score, -1.0)[..., None], boxes], axis=-1)
+        return _box_nms(rows, nms_threshold, threshold, nms_topk,
+                        coord_start=2, score_index=1, id_index=0,
+                        force_suppress=force_suppress, background_id=-1)
+    return _apply(f, [cls_prob, loc_pred, anchor], name="MultiBoxDetection")

@@ -1,0 +1,202 @@
+"""Box ops + SSD tests (mirrors reference tests/python/unittest/
+test_contrib_operator.py multibox cases + example/ssd smoke)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd, ops
+from incubator_mxnet_tpu.models.ssd import SSD, SSDLoss, ssd_300_resnet18_v1
+
+
+# ---------------------------------------------------------------------------
+# box_iou / box_nms
+# ---------------------------------------------------------------------------
+
+def test_box_iou_known_values():
+    a = nd.array([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.5, 0.5]])
+    b = nd.array([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.0, 1.0]])
+    iou = ops.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 0.25, atol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 0.25, atol=1e-6)
+    np.testing.assert_allclose(iou[1, 1], 0.0, atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [id, score, x0, y0, x1, y1]
+    data = nd.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.01, 0.01, 1.0, 1.0],   # heavy overlap with row 0 -> out
+        [0, 0.7, 0.5, 0.5, 0.9, 0.9],     # small overlap -> kept
+        [1, 0.6, 0.02, 0.0, 1.0, 1.0],    # other class -> kept
+    ])
+    out = ops.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                      score_index=1, id_index=0).asnumpy()
+    scores = out[:, 1]
+    assert (scores > 0).sum() == 3
+    assert 0.8 not in scores[scores > 0]
+
+
+def test_box_nms_force_suppress_ignores_class():
+    data = nd.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [1, 0.6, 0.02, 0.0, 1.0, 1.0],
+    ])
+    out = ops.box_nms(data, overlap_thresh=0.5, coord_start=2, score_index=1,
+                      id_index=0, force_suppress=True).asnumpy()
+    assert (out[:, 1] > 0).sum() == 1
+
+
+def test_nd_contrib_namespace():
+    assert nd.contrib.box_nms is ops.box_nms
+    assert nd.contrib.MultiBoxPrior is ops.MultiBoxPrior
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior / Target / Detection
+# ---------------------------------------------------------------------------
+
+def test_multibox_prior_count_and_centers():
+    x = nd.zeros((1, 4, 4, 8))  # NHWC
+    anchors = ops.MultiBoxPrior(x, sizes=[0.5, 0.25], ratios=[1, 2],
+                                layout="NHWC")
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4 * 4 * 3, 4)
+    # first pixel center = (0.5/4, 0.5/4); first anchor size 0.5 ratio 1
+    np.testing.assert_allclose(a[0, 0], [0.125 - 0.25, 0.125 - 0.25,
+                                         0.125 + 0.25, 0.125 + 0.25],
+                               atol=1e-6)
+
+
+def test_multibox_target_matches_gt():
+    # one anchor dead-on a GT box, one far away
+    anchor = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                 [0.6, 0.6, 0.9, 0.9]]]))
+    label = nd.array(np.array([[[1.0, 0.1, 0.1, 0.4, 0.4]]]))  # class 1
+    cls_pred = nd.zeros((1, 3, 2))
+    bt, bm, ct = ops.MultiBoxTarget(anchor, label, cls_pred,
+                                    negative_mining_ratio=-1)
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 2          # class 1 -> target 2 (0 is background)
+    assert ct[0, 1] == 0          # unmatched -> background
+    bm = bm.asnumpy().reshape(1, 2, 4)
+    assert bm[0, 0].sum() == 4 and bm[0, 1].sum() == 0
+    bt = bt.asnumpy().reshape(1, 2, 4)
+    np.testing.assert_allclose(bt[0, 0], 0.0, atol=1e-5)  # perfect match
+
+
+def test_multibox_target_hard_negative_mining():
+    rng = np.random.RandomState(0)
+    anchor = nd.array(rng.uniform(0, 0.4, (1, 20, 2)).repeat(2, axis=-1)
+                      + np.array([0, 0, 0.3, 0.3]))
+    label = nd.array(np.array([[[0.0, 0.05, 0.05, 0.35, 0.35]]]))
+    cls_pred = nd.array(rng.randn(1, 4, 20))
+    bt, bm, ct = ops.MultiBoxTarget(anchor, label, cls_pred,
+                                    negative_mining_ratio=2,
+                                    negative_mining_thresh=0.0)
+    ct = ct.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ign = (ct == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= 2 * n_pos     # mining ratio respected
+    assert n_ign > 0              # some anchors ignored
+
+
+def test_multibox_target_padded_labels_keep_bipartite_match():
+    """Padding rows (cls=-1) must not steal the forced bipartite match at
+    anchor 0 (regression: padded gts all argmax to anchor 0)."""
+    # gt's best anchor IS anchor 0 but with IoU below threshold
+    anchor = nd.array(np.array([[[0.0, 0.0, 0.3, 0.3],
+                                 [0.7, 0.7, 1.0, 1.0]]]))
+    label = nd.array(np.array([[[2.0, 0.2, 0.2, 0.6, 0.6],
+                                [-1.0, 0.0, 0.0, 0.0, 0.0],
+                                [-1.0, 0.0, 0.0, 0.0, 0.0]]]))
+    cls_pred = nd.zeros((1, 4, 2))
+    bt, bm, ct = ops.MultiBoxTarget(anchor, label, cls_pred,
+                                    overlap_threshold=0.5,
+                                    negative_mining_ratio=-1)
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 3          # class 2 -> target 3, forced bipartite
+    assert bm.asnumpy().reshape(1, 2, 4)[0, 0].sum() == 4
+
+
+def test_box_nms_center_format():
+    # centered boxes: both rows are the same box in center format
+    data = nd.array([[0, 0.9, 0.5, 0.5, 1.0, 1.0],
+                     [0, 0.8, 0.5, 0.5, 1.0, 1.0]])
+    out = ops.box_nms(data, overlap_thresh=0.5, coord_start=2, score_index=1,
+                      id_index=0, in_format="center",
+                      out_format="center").asnumpy()
+    assert (out[:, 1] > 0).sum() == 1
+
+
+def test_multibox_detection_roundtrip():
+    # perfect loc_pred (zeros) on an anchor == the anchor itself
+    anchor = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                 [0.6, 0.6, 0.9, 0.9]]]))
+    cls_prob = nd.array(np.array([[[0.1, 0.8],    # background prob
+                                   [0.9, 0.1],    # class 0
+                                   [0.0, 0.1]]]))  # class 1
+    loc_pred = nd.zeros((1, 8))
+    out = ops.MultiBoxDetection(cls_prob, loc_pred, anchor,
+                                threshold=0.2).asnumpy()
+    kept = out[0][out[0, :, 0] >= 0]
+    assert kept.shape[0] == 1
+    assert kept[0, 0] == 0                       # class 0
+    np.testing.assert_allclose(kept[0, 1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# SSD network
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ssd_net():
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = ssd_300_resnet18_v1(classes=4)
+    net.initialize()
+    return net
+
+
+def test_ssd_forward_shapes(ssd_net):
+    x = nd.ones((2, 128, 128, 3))
+    anchor, cls_pred, box_pred = ssd_net(x)
+    A = anchor.shape[1]
+    assert anchor.shape == (1, A, 4)
+    assert cls_pred.shape == (2, A, 5)
+    assert box_pred.shape == (2, A * 4)
+
+
+def test_ssd_train_step_decreases_loss(ssd_net):
+    net = ssd_net
+    L = SSDLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    x = nd.array(np.random.randn(2, 128, 128, 3).astype(np.float32))
+    label = nd.array(np.array([
+        [[1.0, 0.1, 0.1, 0.45, 0.45]],
+        [[3.0, 0.5, 0.5, 0.95, 0.95]]]))
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            anchor, cls_pred, box_pred = net(x)
+            with autograd.pause():
+                bt, bm, ct = net.targets(anchor, cls_pred, label)
+            loss = L(cls_pred, box_pred, ct, bt, bm)
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ssd_detect(ssd_net):
+    x = nd.ones((1, 128, 128, 3))
+    det = ssd_net.detect(x).asnumpy()
+    assert det.shape[-1] == 6
+    # scores of kept rows are sorted desc
+    kept = det[0][det[0, :, 0] >= 0]
+    if kept.shape[0] > 1:
+        assert (np.diff(kept[:, 1]) <= 1e-6).all()
