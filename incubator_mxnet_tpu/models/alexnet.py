@@ -1,0 +1,39 @@
+"""AlexNet (parity: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(64, 11, strides=4, padding=2,
+                                    activation="relu", layout=layout))
+        self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+        self.features.add(nn.Conv2D(192, 5, padding=2, activation="relu",
+                                    layout=layout))
+        self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+        self.features.add(nn.Conv2D(384, 3, padding=1, activation="relu",
+                                    layout=layout))
+        self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu",
+                                    layout=layout))
+        self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu",
+                                    layout=layout))
+        self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+        self.features.add(nn.Flatten())
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def alexnet(classes=1000, layout="NHWC", **kwargs):
+    return AlexNet(classes=classes, layout=layout, **kwargs)

@@ -1,0 +1,7 @@
+"""Shared model-zoo helpers."""
+
+
+def bn_axis(layout: str) -> int:
+    """Channel axis for normalization layers: -1 for channels-last (NHWC,
+    the TPU-preferred layout), 1 for channels-first (NCHW parity)."""
+    return -1 if layout.endswith("C") else 1

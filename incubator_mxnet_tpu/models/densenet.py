@@ -1,0 +1,95 @@
+"""DenseNet 121/161/169/201 (parity: python/mxnet/gluon/model_zoo/vision/
+densenet.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import ndarray as nd
+from .common import bn_axis as _bn_axis
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+# num_init_features, growth_rate, block_config
+_SPEC = {121: (64, 32, [6, 12, 24, 16]),
+         161: (96, 48, [6, 12, 36, 24]),
+         169: (64, 32, [6, 12, 32, 32]),
+         201: (64, 32, [6, 12, 48, 32])}
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, layout, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = _bn_axis(layout)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(axis=self._axis))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(bn_size * growth_rate, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=self._axis))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(growth_rate, 3, padding=1, use_bias=False,
+                                layout=layout))
+        if dropout:
+            self.body.add(nn.Dropout(dropout))
+
+    def forward(self, x):
+        return nd.concat(x, self.body(x), dim=self._axis)
+
+
+class _Transition(HybridBlock):
+    def __init__(self, channels, layout, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(axis=_bn_axis(layout)))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, 1, use_bias=False, layout=layout))
+        self.body.add(nn.AvgPool2D(2, 2, layout=layout))
+
+    def forward(self, x):
+        return self.body(x)
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        axis = _bn_axis(layout)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(num_init_features, 7, strides=2,
+                                    padding=3, use_bias=False, layout=layout))
+        self.features.add(nn.BatchNorm(axis=axis))
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for _ in range(num_layers):
+                self.features.add(_DenseLayer(growth_rate, bn_size, dropout,
+                                              layout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_Transition(num_features, layout))
+        self.features.add(nn.BatchNorm(axis=axis))
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _make(n):
+    def f(classes=1000, layout="NHWC", **kwargs):
+        ninit, growth, cfg = _SPEC[n]
+        return DenseNet(ninit, growth, cfg, classes=classes, layout=layout,
+                        **kwargs)
+    f.__name__ = f"densenet{n}"
+    return f
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)

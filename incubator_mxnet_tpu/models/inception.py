@@ -1,0 +1,132 @@
+"""Inception-V3 (parity: python/mxnet/gluon/model_zoo/vision/inception.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .common import bn_axis as _bn_axis
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv(channels, kernel, stride=1, pad=0, layout="NHWC"):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, kernel, strides=stride, padding=pad,
+                      use_bias=False, layout=layout))
+    out.add(nn.BatchNorm(axis=_bn_axis(layout), epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _Branches(branches, layout):
+    """Run branches on one input, concat along channels (nn.Concatenate)."""
+    out = nn.Concatenate(axis=_bn_axis(layout))
+    out.add(*branches)
+    return out
+
+
+def _seq(*blocks):
+    s = nn.HybridSequential()
+    for b in blocks:
+        s.add(b)
+    return s
+
+
+def _make_A(pool_features, layout):
+    return _Branches([
+        _conv(64, 1, layout=layout),
+        _seq(_conv(48, 1, layout=layout), _conv(64, 5, pad=2, layout=layout)),
+        _seq(_conv(64, 1, layout=layout), _conv(96, 3, pad=1, layout=layout),
+             _conv(96, 3, pad=1, layout=layout)),
+        _seq(nn.AvgPool2D(3, 1, 1, layout=layout),
+             _conv(pool_features, 1, layout=layout)),
+    ], layout)
+
+
+def _make_B(layout):
+    return _Branches([
+        _conv(384, 3, stride=2, layout=layout),
+        _seq(_conv(64, 1, layout=layout), _conv(96, 3, pad=1, layout=layout),
+             _conv(96, 3, stride=2, layout=layout)),
+        nn.MaxPool2D(3, 2, layout=layout),
+    ], layout)
+
+
+def _make_C(channels_7x7, layout):
+    c = channels_7x7
+    return _Branches([
+        _conv(192, 1, layout=layout),
+        _seq(_conv(c, 1, layout=layout),
+             _conv(c, (1, 7), pad=(0, 3), layout=layout),
+             _conv(192, (7, 1), pad=(3, 0), layout=layout)),
+        _seq(_conv(c, 1, layout=layout),
+             _conv(c, (7, 1), pad=(3, 0), layout=layout),
+             _conv(c, (1, 7), pad=(0, 3), layout=layout),
+             _conv(c, (7, 1), pad=(3, 0), layout=layout),
+             _conv(192, (1, 7), pad=(0, 3), layout=layout)),
+        _seq(nn.AvgPool2D(3, 1, 1, layout=layout),
+             _conv(192, 1, layout=layout)),
+    ], layout)
+
+
+def _make_D(layout):
+    return _Branches([
+        _seq(_conv(192, 1, layout=layout),
+             _conv(320, 3, stride=2, layout=layout)),
+        _seq(_conv(192, 1, layout=layout),
+             _conv(192, (1, 7), pad=(0, 3), layout=layout),
+             _conv(192, (7, 1), pad=(3, 0), layout=layout),
+             _conv(192, 3, stride=2, layout=layout)),
+        nn.MaxPool2D(3, 2, layout=layout),
+    ], layout)
+
+
+def _make_E(layout):
+    return _Branches([
+        _conv(320, 1, layout=layout),
+        _seq(_conv(384, 1, layout=layout),
+             _Branches([_conv(384, (1, 3), pad=(0, 1), layout=layout),
+                        _conv(384, (3, 1), pad=(1, 0), layout=layout)],
+                       layout)),
+        _seq(_conv(448, 1, layout=layout),
+             _conv(384, 3, pad=1, layout=layout),
+             _Branches([_conv(384, (1, 3), pad=(0, 1), layout=layout),
+                        _conv(384, (3, 1), pad=(1, 0), layout=layout)],
+                       layout)),
+        _seq(nn.AvgPool2D(3, 1, 1, layout=layout),
+             _conv(192, 1, layout=layout)),
+    ], layout)
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(_conv(32, 3, stride=2, layout=layout))
+        self.features.add(_conv(32, 3, layout=layout))
+        self.features.add(_conv(64, 3, pad=1, layout=layout))
+        self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+        self.features.add(_conv(80, 1, layout=layout))
+        self.features.add(_conv(192, 3, layout=layout))
+        self.features.add(nn.MaxPool2D(3, 2, layout=layout))
+        self.features.add(_make_A(32, layout))
+        self.features.add(_make_A(64, layout))
+        self.features.add(_make_A(64, layout))
+        self.features.add(_make_B(layout))
+        self.features.add(_make_C(128, layout))
+        self.features.add(_make_C(160, layout))
+        self.features.add(_make_C(160, layout))
+        self.features.add(_make_C(192, layout))
+        self.features.add(_make_D(layout))
+        self.features.add(_make_E(layout))
+        self.features.add(_make_E(layout))
+        self.features.add(nn.AvgPool2D(8, layout=layout))
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(classes=1000, layout="NHWC", **kwargs):
+    return Inception3(classes=classes, layout=layout, **kwargs)

@@ -1,0 +1,105 @@
+"""MobileNet v1/v2 (parity: python/mxnet/gluon/model_zoo/vision/
+mobilenet.py). Depthwise convs = grouped Conv2D with groups=channels; XLA:TPU
+lowers these to efficient channel-tiled convolutions in NHWC."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .common import bn_axis
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
+           "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]
+
+
+def _conv_block(out, channels, kernel, stride, pad, layout, groups=1,
+                active=True, relu6=False):
+    out.add(nn.Conv2D(channels, kernel, strides=stride, padding=pad,
+                      groups=groups, use_bias=False, layout=layout))
+    out.add(nn.BatchNorm(axis=bn_axis(layout)))
+    if active:
+        out.add(nn.Activation("relu6" if relu6 else "relu"))
+
+
+class MobileNet(HybridBlock):
+    """v1: depthwise-separable stacks."""
+
+    def __init__(self, multiplier=1.0, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+        self.features = nn.HybridSequential()
+        _conv_block(self.features, int(32 * multiplier), 3, 2, 1, layout)
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            # depthwise
+            _conv_block(self.features, dwc, 3, s, 1, layout, groups=dwc)
+            # pointwise
+            _conv_block(self.features, c, 1, 1, 0, layout)
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _InvertedResidual(HybridBlock):
+    def __init__(self, in_ch, ch, t, stride, layout, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_ch == ch
+        self.out = nn.HybridSequential()
+        if t != 1:
+            _conv_block(self.out, in_ch * t, 1, 1, 0, layout, relu6=True)
+        _conv_block(self.out, in_ch * t, 3, stride, 1, layout,
+                    groups=in_ch * t, relu6=True)
+        _conv_block(self.out, ch, 1, 1, 0, layout, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        return out + x if self.use_shortcut else out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        m = multiplier
+        self.features = nn.HybridSequential()
+        _conv_block(self.features, int(32 * m), 3, 2, 1, layout, relu6=True)
+        # t, c, n, s (expansion, channels, repeats, first stride)
+        spec = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+                (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_ch = int(32 * m)
+        for t, c, n, s in spec:
+            ch = int(c * m)
+            for i in range(n):
+                self.features.add(_InvertedResidual(
+                    in_ch, ch, t, s if i == 0 else 1, layout))
+                in_ch = ch
+        last = int(1280 * m) if m > 1.0 else 1280
+        _conv_block(self.features, last, 1, 1, 0, layout, relu6=True)
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _make(cls, mult, name):
+    def f(classes=1000, layout="NHWC", **kwargs):
+        return cls(mult, classes=classes, layout=layout, **kwargs)
+    f.__name__ = name
+    return f
+
+
+mobilenet1_0 = _make(MobileNet, 1.0, "mobilenet1_0")
+mobilenet0_75 = _make(MobileNet, 0.75, "mobilenet0_75")
+mobilenet0_5 = _make(MobileNet, 0.5, "mobilenet0_5")
+mobilenet0_25 = _make(MobileNet, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _make(MobileNetV2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _make(MobileNetV2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _make(MobileNetV2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _make(MobileNetV2, 0.25, "mobilenet_v2_0_25")

@@ -1,0 +1,74 @@
+"""SqueezeNet 1.0/1.1 (parity: python/mxnet/gluon/model_zoo/vision/
+squeezenet.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import ndarray as nd
+from .common import bn_axis
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze, expand1x1, expand3x3, layout, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = bn_axis(layout)
+        self.squeeze = nn.Conv2D(squeeze, 1, activation="relu", layout=layout)
+        self.expand1x1 = nn.Conv2D(expand1x1, 1, activation="relu",
+                                   layout=layout)
+        self.expand3x3 = nn.Conv2D(expand3x3, 3, padding=1, activation="relu",
+                                   layout=layout)
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return nd.concat(self.expand1x1(x), self.expand3x3(x), dim=self._axis)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version="1.0", classes=1000, layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        assert version in ("1.0", "1.1")
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, 7, strides=2, activation="relu",
+                                        layout=layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            for sq, e1, e3 in [(16, 64, 64), (16, 64, 64), (32, 128, 128)]:
+                self.features.add(_Fire(sq, e1, e3, layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            for sq, e1, e3 in [(32, 128, 128), (48, 192, 192), (48, 192, 192),
+                               (64, 256, 256)]:
+                self.features.add(_Fire(sq, e1, e3, layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            self.features.add(_Fire(64, 256, 256, layout))
+        else:
+            self.features.add(nn.Conv2D(64, 3, strides=2, activation="relu",
+                                        layout=layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            for sq, e1, e3 in [(16, 64, 64), (16, 64, 64)]:
+                self.features.add(_Fire(sq, e1, e3, layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            for sq, e1, e3 in [(32, 128, 128), (32, 128, 128)]:
+                self.features.add(_Fire(sq, e1, e3, layout))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True, layout=layout))
+            for sq, e1, e3 in [(48, 192, 192), (48, 192, 192),
+                               (64, 256, 256), (64, 256, 256)]:
+                self.features.add(_Fire(sq, e1, e3, layout))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, 1, activation="relu",
+                                  layout=layout))
+        self.output.add(nn.GlobalAvgPool2D(layout=layout))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(classes=1000, layout="NHWC", **kwargs):
+    return SqueezeNet("1.0", classes=classes, layout=layout, **kwargs)
+
+
+def squeezenet1_1(classes=1000, layout="NHWC", **kwargs):
+    return SqueezeNet("1.1", classes=classes, layout=layout, **kwargs)

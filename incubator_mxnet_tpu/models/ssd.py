@@ -9,8 +9,6 @@ XLA computation. NHWC layout by default (MXU-friendly convs).
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..gluon.loss import Loss
@@ -114,8 +112,9 @@ class SSD(HybridBlock):
 
 class SSDLoss(Loss):
     """CE over mined anchors (cls_target = -1 ignored) + SmoothL1 on
-    positives, normalized by positive count (reference example/ssd
-    MultiBoxLoss / training/losses)."""
+    positives, each image normalized by its positive count (reference
+    example/ssd MultiBoxLoss). Returns per-sample losses (B,) per the gluon
+    Loss contract; `weight` scales them."""
 
     def __init__(self, lambd=1.0, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
@@ -124,16 +123,18 @@ class SSDLoss(Loss):
     def forward(self, cls_pred, box_pred, cls_target, box_target, box_mask):
         import jax
         import jax.numpy as jnp
+        w = self._weight if self._weight is not None else 1.0
 
         def f(cp, bp, ct, bt, bm):
             logp = jax.nn.log_softmax(cp.astype(jnp.float32), axis=-1)
             ctc = jnp.maximum(ct, 0).astype(jnp.int32)
             nll = -jnp.take_along_axis(logp, ctc[..., None], -1)[..., 0]
-            cls_loss = jnp.where(ct >= 0, nll, 0.0)
-            n_pos = jnp.maximum((ct > 0).sum(), 1).astype(jnp.float32)
+            cls_loss = jnp.where(ct >= 0, nll, 0.0).sum(axis=-1)    # (B,)
+            n_pos = jnp.maximum((ct > 0).sum(axis=-1), 1)           # (B,)
             diff = jnp.abs((bp - bt) * bm)
-            sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
-            return (cls_loss.sum() + self._lambd * sl1.sum()) / n_pos
+            sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff,
+                            diff - 0.5).sum(axis=-1)                # (B,)
+            return w * (cls_loss + self._lambd * sl1) / n_pos
         return _apply(f, [cls_pred, box_pred, cls_target, box_target,
                           box_mask], name="ssd_loss")
 

@@ -1,0 +1,57 @@
+"""VGG 11/13/16/19 (+BN) (parity: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .common import bn_axis
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg"]
+
+_SPEC = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+         13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+         16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+         19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 layout="NHWC", **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        for num, ch in zip(layers, filters):
+            for _ in range(num):
+                self.features.add(nn.Conv2D(ch, 3, padding=1, layout=layout))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm(axis=bn_axis(layout)))
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(2, 2, layout=layout))
+        self.features.add(nn.Flatten())
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers, classes=1000, batch_norm=False, layout="NHWC",
+            **kwargs):
+    layers, filters = _SPEC[num_layers]
+    return VGG(layers, filters, classes=classes, batch_norm=batch_norm,
+               layout=layout, **kwargs)
+
+
+def _make(n, bn):
+    def f(classes=1000, layout="NHWC", **kwargs):
+        return get_vgg(n, classes=classes, batch_norm=bn, layout=layout,
+                       **kwargs)
+    f.__name__ = f"vgg{n}_bn" if bn else f"vgg{n}"
+    return f
+
+
+vgg11, vgg13, vgg16, vgg19 = (_make(n, False) for n in (11, 13, 16, 19))
+vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn = (_make(n, True)
+                                          for n in (11, 13, 16, 19))

@@ -112,14 +112,24 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold,
     best_gt = jnp.argmax(iou, axis=1)                           # (A,)
     best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
     matched = best_iou >= overlap_threshold
-    # bipartite: each VALID gt claims its best anchor (overrides threshold);
-    # padded gts scatter to index A and are dropped
-    gt_best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), A)  # (M,)
-    forced = jnp.zeros((A,), bool)
-    forced = forced.at[gt_best_anchor].set(True, mode="drop")
-    gt_of_forced = jnp.zeros((A,), jnp.int32)
-    gt_of_forced = gt_of_forced.at[gt_best_anchor].set(
-        jnp.arange(labels.shape[0], dtype=jnp.int32), mode="drop")
+    # bipartite: each VALID gt claims its best still-unclaimed anchor, in gt
+    # order (exclusive, like the reference's sequential matcher); zero-IoU
+    # gts claim nothing
+    def claim(carry, m):
+        claimed, forced, gt_of = carry
+        col = jnp.where(claimed, -2.0, iou[:, m])
+        a_best = jnp.argmax(col)
+        ok = valid[m] & (col[a_best] > 0)
+        claimed = claimed.at[a_best].set(claimed[a_best] | ok)
+        forced = forced.at[a_best].set(forced[a_best] | ok)
+        gt_of = gt_of.at[a_best].set(jnp.where(ok, m, gt_of[a_best]))
+        return (claimed, forced, gt_of), None
+
+    M = labels.shape[0]
+    (_, forced, gt_of_forced), _ = lax.scan(
+        claim, (jnp.zeros((A,), bool), jnp.zeros((A,), bool),
+                jnp.zeros((A,), jnp.int32)),
+        jnp.arange(M, dtype=jnp.int32))
     assign_gt = jnp.where(forced, gt_of_forced, best_gt)
     positive = jnp.logical_or(matched & (best_iou > 0), forced)
     gt_boxes = labels[assign_gt, 1:5]                           # (A, 4)
@@ -129,12 +139,12 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold,
     box_mask = jnp.broadcast_to(positive[:, None], (A, 4)).astype(jnp.float32)
     cls_target = jnp.where(positive, gt_cls + 1, 0)
     if negative_mining_ratio > 0 and cls_preds is not None:
-        # hard negatives: largest background score gap first
+        # hard negatives: among anchors with max-IoU < negative_mining_thresh
+        # (reference semantics), rank by background-error score
         probs = jax.nn.softmax(cls_preds, axis=0)               # (C+1, A)
         neg_score = 1.0 - probs[0]                              # bg error
-        neg_score = jnp.where(positive, -1.0, neg_score)
-        neg_score = jnp.where(neg_score > negative_mining_thresh,
-                              neg_score, -1.0)
+        eligible = (~positive) & (best_iou < negative_mining_thresh)
+        neg_score = jnp.where(eligible, neg_score, -1.0)
         n_pos = positive.sum()
         n_neg = jnp.clip((n_pos * negative_mining_ratio).astype(jnp.int32),
                          minimum_negative_samples, A)
