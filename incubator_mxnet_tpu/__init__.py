@@ -29,6 +29,12 @@ from . import module
 from . import module as mod
 from . import metric
 from . import io
+from . import amp
+from . import runtime
+from . import test_utils
+from . import utils
+from .utils import profiler
+from . import module as model  # mx.model.save_checkpoint/load_checkpoint
 
 from .ndarray import NDArray
 from .ndarray import random as _ndrandom

@@ -1,0 +1,143 @@
+"""AMP — automatic mixed precision (parity: python/mxnet/contrib/amp/amp.py).
+
+TPU-native stance: bf16 is the native MXU dtype and has fp32's exponent
+range, so the default `target_dtype='bfloat16'` usually needs NO loss
+scaling — `net.cast('bfloat16')` + a multi_precision optimizer is the whole
+recipe, and norm statistics stay f32 inside the norm kernels (ops/_raw.py).
+The fp16-style loss-scaling machinery (static + dynamic with overflow
+backoff — the reference's 'race/fault guard' of mixed precision,
+SURVEY.md §5) is provided for API parity and for fp16 checkpoints.
+
+Usage (reference API):
+    amp.init()                       # set default target dtype
+    net.cast(amp.target_dtype())     # bf16/fp16 params + compute
+    trainer = gluon.Trainer(..., optimizer_params={'multi_precision': True})
+    amp.init_trainer(trainer)        # attach dynamic loss scaler
+    with autograd.record():
+        loss = L(net(x), y)
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+    trainer.step(batch)              # unscales; skips + backs off on overflow
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["init", "target_dtype", "init_trainer", "scale_loss",
+           "LossScaler", "DynamicLossScaler", "unscale"]
+
+_state = {"initialized": False, "target_dtype": "bfloat16"}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP defaults. bfloat16 (TPU-native) or float16."""
+    assert target_dtype in ("bfloat16", "float16")
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+
+
+def target_dtype():
+    return _state["target_dtype"]
+
+
+class LossScaler:
+    """Static loss scale."""
+
+    def __init__(self, init_scale=2.0 ** 10):
+        self.loss_scale = float(init_scale)
+
+    def update(self, overflow: bool):
+        pass
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scaling: halve on overflow (and skip the update), double
+    after `growth_interval` clean steps — the reference's overflow-detection
+    guard."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000):
+        super().__init__(init_scale)
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self._unskipped = 0
+
+    def update(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale * self.backoff_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.growth_interval:
+                self.loss_scale *= self.growth_factor
+                self._unskipped = 0
+
+
+def _grads_finite(params) -> bool:
+    """One fused finiteness check over every gradient (single host fetch)."""
+    total = jnp.float32(0)
+    for p in params:
+        g = p.grad()
+        if g is None:
+            continue
+        total = total + jnp.sum(jnp.abs(g._data).astype(jnp.float32))
+    return bool(np.isfinite(np.asarray(total)))
+
+
+def init_trainer(trainer, scaler: LossScaler | None = None):
+    """Attach a loss scaler and wrap trainer.step with unscale + overflow
+    skip/backoff (the reference patches the trainer the same way)."""
+    scaler = scaler or DynamicLossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_unscaled = False
+
+    def wrap(orig):
+        def amp_call(batch_size, ignore_stale_grad=False):
+            overflow = not _grads_finite(trainer._params)
+            if not overflow:
+                already = trainer._amp_unscaled  # amp.unscale() ran this step
+                trainer._scale = 1.0 if already else 1.0 / scaler.loss_scale
+                try:
+                    orig(batch_size, ignore_stale_grad)
+                finally:
+                    trainer._scale = 1.0
+            trainer._amp_unscaled = False
+            scaler.update(overflow)
+        return amp_call
+
+    trainer.step = wrap(trainer.step)
+    trainer.update = wrap(trainer.update)
+    return trainer
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Yield `loss * scale`; trainer.step (wrapped by init_trainer) divides
+    gradients back by the scale."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise ValueError("call amp.init_trainer(trainer) first")
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Explicitly divide the current grads by the loss scale (for grad
+    clipping between backward and step, reference amp.unscale). The
+    following trainer.step()/update() skips its own unscale; the scaler's
+    scale/state are untouched."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise ValueError("call amp.init_trainer(trainer) first")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p.grad()
+        if g is not None:
+            g._data = (g._data.astype(jnp.float32) * inv).astype(g._data.dtype)
+    trainer._amp_unscaled = True

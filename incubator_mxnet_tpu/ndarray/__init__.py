@@ -32,6 +32,9 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concat", "concatenate", "stack", "split", "dot", "batch_dot",
            "save", "load", "waitall"]
 
+# utils/profiler installs a timing wrapper here while profiling is active
+_op_hook = None
+
 
 def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None,
            fn_fwd=None, fn_vjp=None):
@@ -43,7 +46,10 @@ def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] 
     (primals..., out_cots...) -> input cots (HybridBlock CachedOp path).
     """
     raws = [x._data for x in inputs]
-    outs = (fn_fwd or fn)(*raws)
+    if _op_hook is None:
+        outs = (fn_fwd or fn)(*raws)
+    else:
+        outs = _op_hook(fn_fwd or fn, raws, name)  # profiler timing path
     outs_t = (outs,) if n_out == 1 else tuple(outs)
     results = [NDArray(o) for o in outs_t]
     if autograd.is_recording():

@@ -1,0 +1,59 @@
+"""Test utilities (parity: python/mxnet/test_utils.py — the helpers the
+reference's own test suite is written against)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import context as ctx_mod
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "default_context",
+           "set_default_context", "rand_ndarray", "rand_shape_nd",
+           "default_dtype"]
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b):
+    return np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _to_np(a), _to_np(b)
+    if not np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = np.abs(a_np - b_np)
+        rel = err / (np.abs(b_np) + 1e-12)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): "
+            f"max abs err {err.max():.3e}, max rel err {rel.max():.3e}")
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    ctx_mod._default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None):
+    return array(np.random.uniform(-1.0, 1.0, shape).astype(dtype), ctx=ctx)

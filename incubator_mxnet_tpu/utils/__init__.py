@@ -1,0 +1,6 @@
+"""Aux runtime utilities (SURVEY.md §2.25-26): profiler, checkpoint helpers
+re-exported from module/, misc device info."""
+from . import profiler
+from ..module import save_checkpoint, load_checkpoint
+
+__all__ = ["profiler", "save_checkpoint", "load_checkpoint"]

@@ -1,0 +1,146 @@
+"""AMP tests (mirrors reference tests/python/ amp + multi-precision
+optimizer coverage)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import amp, autograd, gluon, nd
+
+
+def _toy(dtype=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.Dense(1, in_units=4)
+    net.initialize()
+    if dtype:
+        net.cast(dtype)
+    xs = np.random.randn(16, 4).astype(np.float32)
+    ys = xs @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    x, y = nd.array(xs), nd.array(ys)
+    if dtype:
+        x = x.astype(dtype)
+        y = y.astype(dtype)
+    return net, x, y
+
+
+def test_amp_init_sets_dtype():
+    amp.init()
+    assert amp.target_dtype() == "bfloat16"
+    amp.init("float16")
+    assert amp.target_dtype() == "float16"
+    amp.init("bfloat16")
+
+
+def test_scaled_training_matches_unscaled():
+    """Static scale S: scaled loss + unscale-in-step == vanilla training."""
+    L = gluon.loss.L2Loss()
+
+    def run(scaled):
+        net, x, y = _toy()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        if scaled:
+            amp.init_trainer(tr, amp.LossScaler(init_scale=128.0))
+        for _ in range(5):
+            with autograd.record():
+                loss = L(net(x), y)
+                if scaled:
+                    with amp.scale_loss(loss, tr) as sl:
+                        sl.backward()
+                else:
+                    loss.backward()
+            tr.step(16)
+        return net.weight.data().asnumpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_scaler_backoff_and_growth():
+    s = amp.DynamicLossScaler(init_scale=1024.0, growth_interval=3)
+    s.update(overflow=True)
+    assert s.loss_scale == 512.0
+    for _ in range(3):
+        s.update(overflow=False)
+    assert s.loss_scale == 1024.0
+
+
+def test_overflow_skips_update():
+    net, x, y = _toy()
+    L = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    scaler = amp.DynamicLossScaler(init_scale=1024.0)
+    amp.init_trainer(tr, scaler)
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = L(net(x), y)
+        loss.backward()
+    # poison the gradient with inf
+    g = net.weight.grad()
+    g._data = (g._data * np.inf).astype(g._data.dtype)
+    tr.step(16)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+    assert scaler.loss_scale == 512.0
+
+
+def test_bf16_cast_training_converges():
+    """bf16 params + multi_precision master weights still learn."""
+    net, x, y = _toy("bfloat16")
+    L = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "multi_precision": True})
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            loss = L(net(x), y)
+        loss.backward()
+        tr.step(16)
+        losses.append(float(loss.asnumpy().mean()))
+    assert net.weight.data().dtype == "bfloat16"
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_unscale_explicit():
+    net, x, y = _toy()
+    L = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd")
+    amp.init_trainer(tr, amp.LossScaler(init_scale=64.0))
+    with autograd.record():
+        loss = L(net(x), y)
+        with amp.scale_loss(loss, tr) as sl:
+            sl.backward()
+    g_scaled = net.weight.grad().asnumpy().copy()
+    amp.unscale(tr)
+    np.testing.assert_allclose(net.weight.grad().asnumpy(),
+                               g_scaled / 64.0, rtol=1e-6)
+    # scaler state preserved; the following step must not unscale again
+    assert tr._amp_loss_scaler.loss_scale == 64.0
+    w_before = net.weight.data().asnumpy().copy()
+    g_unscaled = net.weight.grad().asnumpy().copy()
+    tr.step(1)
+    expected = w_before - 0.01 * g_unscaled  # sgd default lr, scale 1.0
+    np.testing.assert_allclose(net.weight.data().asnumpy(), expected,
+                               rtol=1e-5, atol=1e-7)
+    assert not tr._amp_unscaled  # flag consumed
+
+
+def test_update_path_also_wrapped():
+    """allreduce_grads() + update() must unscale like step()."""
+    L = gluon.loss.L2Loss()
+
+    def run(use_update):
+        net, x, y = _toy()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        amp.init_trainer(tr, amp.LossScaler(init_scale=256.0))
+        for _ in range(3):
+            with autograd.record():
+                loss = L(net(x), y)
+                with amp.scale_loss(loss, tr) as sl:
+                    sl.backward()
+            if use_update:
+                tr.allreduce_grads()
+                tr.update(16)
+            else:
+                tr.step(16)
+        return net.weight.data().asnumpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)

@@ -1,0 +1,95 @@
+"""profiler / test_utils / runtime Features / model alias tests
+(SURVEY.md §2.25-26, §5)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, profiler, runtime, test_utils
+
+
+def test_profiler_records_ops_and_scopes(tmp_path):
+    profiler.reset()
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    profiler.set_state("run")
+    with profiler.scope("my_region"):
+        a = nd.ones((8, 8))
+        b = (a * 2 + 1).sum()
+        b.wait_to_read()
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "my_region" in table
+    assert "Calls" in table
+    profiler.dump()
+    assert os.path.exists(tmp_path / "trace.json")
+    import json
+    with open(tmp_path / "trace.json") as f:
+        trace = json.load(f)
+    assert len(trace["traceEvents"]) >= 2
+    profiler.reset()
+    assert profiler.dumps().count("\n") == 0  # only header remains
+
+
+def test_profiler_pause_resume():
+    profiler.reset()
+    profiler.set_state("run")
+    profiler.pause()
+    nd.ones((4,)).wait_to_read()
+    n_paused = profiler.dumps().count("\n")
+    profiler.resume()
+    (nd.ones((4,)) + 1).wait_to_read()
+    profiler.set_state("stop")
+    assert profiler.dumps().count("\n") >= n_paused
+    profiler.reset()
+
+
+def test_profiler_off_has_no_hook():
+    from incubator_mxnet_tpu import ndarray as nd_mod
+    profiler.set_state("stop")
+    assert nd_mod._op_hook is None
+
+
+def test_device_memory_stats():
+    stats = profiler.device_memory_stats()
+    assert isinstance(stats, dict)  # may be empty on some backends
+
+
+def test_assert_almost_equal():
+    test_utils.assert_almost_equal(nd.ones((3,)), np.ones(3))
+    with pytest.raises(AssertionError, match="max abs err"):
+        test_utils.assert_almost_equal(nd.ones((3,)), np.zeros(3))
+
+
+def test_test_utils_helpers():
+    assert test_utils.same(nd.zeros((2, 2)), np.zeros((2, 2)))
+    assert test_utils.almost_equal(1.0, 1.0 + 1e-9)
+    x = test_utils.rand_ndarray((3, 4))
+    assert x.shape == (3, 4)
+    shp = test_utils.rand_shape_nd(3, dim=5)
+    assert len(shp) == 3 and all(1 <= d <= 5 for d in shp)
+    assert test_utils.default_context() is not None
+
+
+def test_runtime_features():
+    feats = runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert feats.is_enabled("bf16")           # case-insensitive
+    assert not feats.is_enabled("OPENCV")
+    assert not feats.is_enabled("NONEXISTENT")
+    assert any(f.name == "PALLAS" for f in runtime.feature_list())
+    assert "CPU" in repr(feats)
+
+
+def test_model_alias_checkpoint(tmp_path):
+    assert mx.model.save_checkpoint is mx.module.save_checkpoint
+    import incubator_mxnet_tpu.symbol as sym
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.FullyConnected(x, w, num_hidden=3, no_bias=True)
+    prefix = str(tmp_path / "ckpt")
+    arg = {"w": nd.ones((3, 4))}
+    mx.model.save_checkpoint(prefix, 7, out, arg, {})
+    s2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    np.testing.assert_array_equal(arg2["w"].asnumpy(), arg["w"].asnumpy())
+    assert aux2 == {}
