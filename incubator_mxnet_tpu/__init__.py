@@ -31,6 +31,7 @@ from . import metric
 from . import io
 from . import amp
 from . import runtime
+from . import engine
 from . import test_utils
 from . import utils
 from .utils import profiler

@@ -77,15 +77,30 @@ class DynamicLossScaler(LossScaler):
                 self._unskipped = 0
 
 
+_finite_fns = {}
+
+
 def _grads_finite(params) -> bool:
-    """One fused finiteness check over every gradient (single host fetch)."""
-    total = jnp.float32(0)
+    """One fused finiteness kernel over every gradient, one host fetch —
+    the unavoidable found-inf sync of dynamic loss scaling (stale/missing
+    grads are skipped, matching ignore_stale_grad)."""
+    import jax
+    grads = []
     for p in params:
-        g = p.grad()
-        if g is None:
+        try:
+            g = p.grad()
+        except RuntimeError:        # no gradient this step (stale/unused)
             continue
-        total = total + jnp.sum(jnp.abs(g._data).astype(jnp.float32))
-    return bool(np.isfinite(np.asarray(total)))
+        grads.append(g._data)
+    if not grads:
+        return True
+    key = tuple((g.shape, str(g.dtype)) for g in grads)
+    fn = _finite_fns.get(key)
+    if fn is None:
+        fn = jax.jit(lambda gs: jnp.all(jnp.stack(
+            [jnp.isfinite(jnp.sum(g.astype(jnp.float32))) for g in gs])))
+        _finite_fns[key] = fn
+    return bool(np.asarray(fn(grads)))
 
 
 def init_trainer(trainer, scaler: LossScaler | None = None):
@@ -137,7 +152,9 @@ def unscale(trainer):
         raise ValueError("call amp.init_trainer(trainer) first")
     inv = 1.0 / scaler.loss_scale
     for p in trainer._params:
-        g = p.grad()
-        if g is not None:
-            g._data = (g._data.astype(jnp.float32) * inv).astype(g._data.dtype)
+        try:
+            g = p.grad()
+        except RuntimeError:        # no gradient this step
+            continue
+        g._data = (g._data.astype(jnp.float32) * inv).astype(g._data.dtype)
     trainer._amp_unscaled = True

@@ -1,0 +1,57 @@
+"""Execution-engine surface (parity: reference src/engine/ threaded
+dependency engine; python-side mx.engine hooks).
+
+Device compute is async-scheduled by XLA (its dispatch queue is the
+reference's per-device op queue); this module exposes the NATIVE host-side
+dependency engine (runtime/) with the reference's push/var semantics for
+IO-grade tasks, plus the engine-selection switch:
+
+    MXTPU_ENGINE=native   (default) C++ threaded engine, GIL-free blocking
+    MXTPU_ENGINE=python   pure-Python fallback (the reference's NaiveEngine
+                          analogue for debugging)
+"""
+from __future__ import annotations
+
+import os
+
+from . import runtime as _rt
+from . import ndarray as _nd
+
+__all__ = ["push", "new_var", "wait_for_var", "wait_all", "engine_type",
+           "get_engine"]
+
+
+def engine_type() -> str:
+    forced = os.environ.get("MXTPU_ENGINE", "native")
+    if forced == "python" or not _rt.native_available():
+        return "python"
+    return "native"
+
+
+_engine = None
+
+
+def get_engine() -> _rt.Engine:
+    global _engine
+    if _engine is None:
+        _engine = _rt.Engine(force_python=engine_type() == "python")
+    return _engine
+
+
+def new_var() -> int:
+    return get_engine().new_var()
+
+
+def push(fn, const_vars=(), mutable_vars=()):
+    """Schedule fn once deps resolve: concurrent reads, exclusive writes."""
+    get_engine().push(fn, const_vars, mutable_vars)
+
+
+def wait_for_var(var: int):
+    get_engine().wait_for_var(var)
+
+
+def wait_all():
+    """Barrier on host-engine tasks AND device async work (mx.nd.waitall)."""
+    get_engine().wait_all()
+    _nd.waitall()

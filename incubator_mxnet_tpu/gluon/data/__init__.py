@@ -207,21 +207,64 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
             return
-        # threaded prefetch pipeline (native engine handles scheduling when
-        # built; python threads release the GIL during numpy/jax work)
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(self._num_workers) as pool:
-            batches = list(self._batch_sampler)
-            futures = []
-            it = iter(batches)
-            for _ in range(min(self._prefetch, len(batches))):
-                futures.append(pool.submit(self._load_batch, next(it)))
-            consumed = len(futures)
-            i = 0
-            while i < len(batches):
-                yield futures[i % len(futures)].result()
-                if consumed < len(batches):
-                    futures[i % len(futures)] = pool.submit(
-                        self._load_batch, batches[consumed])
-                    consumed += 1
-                i += 1
+        yield from self._prefetch_iter()
+
+    def _prefetch_iter(self):
+        """Prefetch pipeline on the native runtime (reference: src/io
+        PrefetcherIter): worker threads of the C++ engine load batches; a
+        bounded native TokenQueue provides backpressure (a worker holding a
+        loaded batch blocks GIL-free in C until the consumer catches up)."""
+        import threading
+        from ... import runtime as _rt
+
+        batches = list(self._batch_sampler)
+        if not batches:
+            return
+        eng = _rt.Engine(self._num_workers)
+        q = _rt.TokenQueue(self._prefetch)
+        results = {}
+        lock = threading.Lock()
+
+        def make_task(i, indices):
+            def task():
+                try:
+                    b = self._load_batch(indices)
+                except Exception as e:          # surfaced at consume time
+                    b = e
+                with lock:
+                    results[i] = b
+                q.push(i)
+            return task
+
+        # sliding submission window: at most `prefetch` batches in flight, so
+        # a straggler can't make completed batches pile up unboundedly and an
+        # early break only drains the window, not the epoch
+        submitted = 0
+
+        def submit_next():
+            nonlocal submitted
+            if submitted < len(batches):
+                eng.push(make_task(submitted, batches[submitted]))
+                submitted += 1
+
+        for _ in range(min(self._prefetch, len(batches))):
+            submit_next()
+        try:
+            next_i, ready = 0, set()
+            while next_i < len(batches):
+                while next_i not in ready:
+                    tok = q.pop()
+                    if tok is None:
+                        return
+                    ready.add(tok)
+                ready.discard(next_i)
+                with lock:
+                    b = results.pop(next_i)
+                if isinstance(b, Exception):
+                    raise b
+                submit_next()   # refill before yielding: overlap with consumer
+                yield b
+                next_i += 1
+        finally:
+            q.close()       # unblocks any producer stuck in push
+            eng.wait_all()  # only the in-flight window remains

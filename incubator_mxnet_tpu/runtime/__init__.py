@@ -1,0 +1,360 @@
+"""Native runtime bindings (SURVEY.md §2.27): C++ threaded dependency
+engine, pooled host-storage allocator, bounded prefetch queue — the rebuild
+of the reference's src/engine + src/storage + src/io prefetcher for
+host-side work (device compute is scheduled by XLA's async dispatch).
+
+The .so is built on first import with g++ (no pybind11 — plain C API via
+ctypes). If the toolchain is unavailable everything degrades to functional
+pure-Python equivalents, so the framework never hard-depends on the native
+layer. `native_available()` reports which path is live.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import deque
+
+from .features import Feature, Features, feature_list
+
+__all__ = ["Engine", "StoragePool", "TokenQueue", "native_available",
+           "get_engine", "Feature", "Features", "feature_list"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libmxtpu_runtime.so")
+_lib = None
+
+
+def _build_and_load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        src = os.path.join(_DIR, "src", "runtime.cc")
+        try:
+            subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-pthread",
+                            "-shared", "-o", _SO, src], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.mxtpu_engine_create.restype = ctypes.c_void_p
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
+    lib.mxtpu_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_new_var.restype = ctypes.c_int64
+    lib.mxtpu_engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.mxtpu_engine_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxtpu_engine_wait_all.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pool_create.restype = ctypes.c_void_p
+    lib.mxtpu_pool_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pool_alloc.restype = ctypes.c_void_p
+    lib.mxtpu_pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.mxtpu_pool_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_pool_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_size_t),
+                                     ctypes.POINTER(ctypes.c_size_t)]
+    lib.mxtpu_queue_create.restype = ctypes.c_void_p
+    lib.mxtpu_queue_create.argtypes = [ctypes.c_size_t]
+    lib.mxtpu_queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_queue_push.restype = ctypes.c_int
+    lib.mxtpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.mxtpu_queue_pop.restype = ctypes.c_int
+    lib.mxtpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_queue_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_queue_size.restype = ctypes.c_size_t
+    lib.mxtpu_queue_size.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+_OP_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+# ---------------------------------------------------------------------------
+# dependency engine
+# ---------------------------------------------------------------------------
+
+class Engine:
+    """MXNet-style dependency engine: `push(fn, const_vars, mutable_vars)`
+    runs fn on a worker thread once all its var deps resolve (concurrent
+    reads, exclusive writes, program order per var)."""
+
+    def __init__(self, num_threads=None, force_python=False):
+        num_threads = num_threads or max(2, (os.cpu_count() or 4) // 2)
+        self._lib = None if force_python else _build_and_load()
+        self._callbacks = {}          # keep ctypes thunks alive until run
+        self._cb_lock = threading.Lock()
+        self._cb_id = 0
+        if self._lib is not None:
+            self._h = self._lib.mxtpu_engine_create(num_threads)
+        else:
+            self._py = _PyEngine(num_threads)
+
+    def new_var(self) -> int:
+        if self._lib is not None:
+            return self._lib.mxtpu_engine_new_var(self._h)
+        return self._py.new_var()
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        if self._lib is None:
+            self._py.push(fn, const_vars, mutable_vars)
+            return
+        with self._cb_lock:
+            self._cb_id += 1
+            cid = self._cb_id
+
+        def run(_):
+            try:
+                fn()
+            finally:
+                with self._cb_lock:
+                    self._callbacks.pop(cid, None)
+
+        thunk = _OP_FN(run)
+        with self._cb_lock:
+            self._callbacks[cid] = thunk
+        cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
+        mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
+        self._lib.mxtpu_engine_push(
+            self._h, ctypes.cast(thunk, ctypes.c_void_p), None,
+            cv, len(const_vars), mv, len(mutable_vars))
+
+    def wait_for_var(self, var: int):
+        if self._lib is not None:
+            self._lib.mxtpu_engine_wait_for_var(self._h, var)
+        else:
+            self._py.wait_for_var(var)
+
+    def wait_all(self):
+        if self._lib is not None:
+            self._lib.mxtpu_engine_wait_all(self._h)
+        else:
+            self._py.wait_all()
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and \
+                getattr(self, "_h", None):
+            try:
+                self._lib.mxtpu_engine_destroy(self._h)
+            except Exception:
+                pass
+            self._h = None
+
+
+class _PyEngine:
+    """Pure-Python fallback with the same semantics (GIL-bound)."""
+
+    def __init__(self, num_threads):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(num_threads)
+        self._lock = threading.Lock()
+        self._var_last = {}           # var -> last future touching it
+        self._next = 1
+        self._futures = set()
+
+    def new_var(self):
+        with self._lock:
+            v = self._next
+            self._next += 1
+            return v
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        with self._lock:
+            deps = [self._var_last.get(v) for v in
+                    list(const_vars) + list(mutable_vars)]
+            deps = [d for d in deps if d is not None]
+
+            def run():
+                for d in deps:
+                    d.result()
+                fn()
+
+            fut = self._pool.submit(run)
+            self._futures.add(fut)
+            fut.add_done_callback(lambda f: self._futures.discard(f))
+            for v in mutable_vars:
+                self._var_last[v] = fut
+
+    def wait_for_var(self, var):
+        with self._lock:
+            fut = self._var_last.get(var)
+        if fut is not None:
+            fut.result()
+
+    def wait_all(self):
+        for fut in list(self._futures):
+            fut.result()
+
+
+_global_engine = None
+_global_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    global _global_engine
+    with _global_lock:
+        if _global_engine is None:
+            _global_engine = Engine()
+        return _global_engine
+
+
+# ---------------------------------------------------------------------------
+# pooled storage
+# ---------------------------------------------------------------------------
+
+class StoragePool:
+    """Size-bucketed host buffer pool (reference pooled_storage_manager).
+    alloc() returns a ctypes void_p usable as a staging buffer; free()
+    returns it to the pool rather than the OS."""
+
+    def __init__(self):
+        self._lib = _build_and_load()
+        if self._lib is not None:
+            self._h = self._lib.mxtpu_pool_create()
+        else:
+            self._buckets = {}
+            self._live = {}
+            self._used = 0
+            self._pooled = 0
+            self._plock = threading.Lock()
+
+    @staticmethod
+    def _round(size):
+        b = 256
+        while b < size:
+            b <<= 1
+        return b
+
+    def alloc(self, size):
+        if self._lib is not None:
+            return self._lib.mxtpu_pool_alloc(self._h, size)
+        b = self._round(size)
+        with self._plock:
+            lst = self._buckets.get(b)
+            if lst:
+                buf = lst.pop()
+                self._pooled -= b
+            else:
+                buf = ctypes.create_string_buffer(b)
+            addr = ctypes.addressof(buf)
+            self._live[addr] = (buf, b)
+            self._used += b
+            return addr
+
+    def free(self, ptr):
+        if self._lib is not None:
+            self._lib.mxtpu_pool_free(self._h, ptr)
+            return
+        with self._plock:
+            ent = self._live.pop(ptr, None)
+            if ent is None:
+                return
+            buf, b = ent
+            self._buckets.setdefault(b, []).append(buf)
+            self._used -= b
+            self._pooled += b
+
+    def stats(self):
+        if self._lib is not None:
+            used = ctypes.c_size_t()
+            pooled = ctypes.c_size_t()
+            self._lib.mxtpu_pool_stats(self._h, ctypes.byref(used),
+                                       ctypes.byref(pooled))
+            return {"bytes_in_use": used.value, "bytes_pooled": pooled.value}
+        with self._plock:
+            return {"bytes_in_use": self._used, "bytes_pooled": self._pooled}
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and \
+                getattr(self, "_h", None):
+            try:
+                self._lib.mxtpu_pool_destroy(self._h)
+            except Exception:
+                pass
+            self._h = None
+
+
+# ---------------------------------------------------------------------------
+# bounded token queue (prefetch pipeline backbone)
+# ---------------------------------------------------------------------------
+
+class TokenQueue:
+    """Bounded blocking queue of u64 tokens; C-side blocking releases the
+    GIL, so producer threads in the native engine and the Python consumer
+    overlap. push/pop return False after close()."""
+
+    def __init__(self, capacity):
+        self._lib = _build_and_load()
+        if self._lib is not None:
+            self._h = self._lib.mxtpu_queue_create(capacity)
+        else:
+            self._q = deque()
+            self._cap = max(1, capacity)
+            self._qlock = threading.Lock()
+            self._not_full = threading.Condition(self._qlock)
+            self._not_empty = threading.Condition(self._qlock)
+            self._closed = False
+
+    def push(self, token) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.mxtpu_queue_push(self._h, token))
+        with self._not_full:
+            while not self._closed and len(self._q) >= self._cap:
+                self._not_full.wait()
+            if self._closed:
+                return False
+            self._q.append(token)
+            self._not_empty.notify()
+            return True
+
+    def pop(self):
+        """Returns token or None when closed+drained."""
+        if self._lib is not None:
+            tok = ctypes.c_uint64()
+            ok = self._lib.mxtpu_queue_pop(self._h, ctypes.byref(tok))
+            return tok.value if ok else None
+        with self._not_empty:
+            while not self._closed and not self._q:
+                self._not_empty.wait()
+            if not self._q:
+                return None
+            tok = self._q.popleft()
+            self._not_full.notify()
+            return tok
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.mxtpu_queue_close(self._h)
+            return
+        with self._qlock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+    def __len__(self):
+        if self._lib is not None:
+            return self._lib.mxtpu_queue_size(self._h)
+        with self._qlock:
+            return len(self._q)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and \
+                getattr(self, "_h", None):
+            try:
+                self._lib.mxtpu_queue_destroy(self._h)
+            except Exception:
+                pass
+            self._h = None

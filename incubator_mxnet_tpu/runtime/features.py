@@ -19,7 +19,7 @@ class Feature:
 def _detect():
     backend = jax.default_backend()
     try:
-        from .ops import pallas as _pallas
+        from ..ops import pallas as _pallas
         pallas_ok = _pallas.enabled()
     except Exception:
         pallas_ok = False

@@ -1,0 +1,344 @@
+// Native runtime: threaded dependency engine, pooled storage allocator,
+// bounded token queue. The TPU-native rebuild of the reference's C++ core
+// (src/engine/threaded_engine*.cc, src/storage/pooled_storage_manager,
+// src/io prefetcher) for HOST-side work: device compute is scheduled by
+// XLA's async dispatch; this engine orders and parallelizes the host tasks
+// around it (IO, decode, prefetch, checkpoint writes) with the same
+// var read/write dependency semantics as the reference engine.
+//
+// C API only (consumed via ctypes; no pybind11 in the image).
+//
+// Build: make -C .. (produces libmxtpu_runtime.so next to __init__.py)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*op_fn)(void*);
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// dependency engine
+// ---------------------------------------------------------------------------
+
+struct Op {
+    op_fn fn;
+    void* arg;
+    // (var id, is_write) pairs, deduplicated
+    std::vector<std::pair<int64_t, bool>> vars;
+    size_t grants = 0;   // vars that have admitted this op
+};
+
+struct Var {
+    // pending ops in program order; bool = is_write
+    std::deque<std::pair<Op*, bool>> q;
+    int active_readers = 0;
+    bool active_writer = false;
+};
+
+class Engine {
+  public:
+    explicit Engine(int num_threads) {
+        if (num_threads <= 0) num_threads = 2;
+        for (int i = 0; i < num_threads; ++i)
+            workers_.emplace_back([this] { worker_loop(); });
+    }
+
+    ~Engine() {
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            shutdown_ = true;
+            ready_cv_.notify_all();
+        }
+        for (auto& t : workers_) t.join();
+        for (auto& kv : vars_) delete kv.second;
+    }
+
+    int64_t new_var() {
+        std::unique_lock<std::mutex> lk(mu_);
+        int64_t id = next_var_++;
+        vars_[id] = new Var();
+        return id;
+    }
+
+    void push(op_fn fn, void* arg, const int64_t* const_vars, int n_const,
+              const int64_t* mut_vars, int n_mut) {
+        Op* op = new Op{fn, arg, {}, 0};
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            // dedup: a var both read and written is a write dep
+            for (int i = 0; i < n_mut; ++i) {
+                bool dup = false;
+                for (auto& vb : op->vars)
+                    if (vb.first == mut_vars[i]) { dup = true; break; }
+                if (!dup) add_dep(op, mut_vars[i], true);
+            }
+            for (int i = 0; i < n_const; ++i) {
+                bool dup = false;
+                for (auto& vb : op->vars)
+                    if (vb.first == const_vars[i]) { dup = true; break; }
+                if (!dup) add_dep(op, const_vars[i], false);
+            }
+            ++pending_;
+            if (op->vars.empty()) {
+                ready_.push(op);
+                ready_cv_.notify_one();
+            } else {
+                for (auto& vb : op->vars) {
+                    Var* v = vars_.at(vb.first);
+                    v->q.emplace_back(op, vb.second);
+                }
+                for (auto& vb : op->vars) try_dispatch(vars_.at(vb.first));
+            }
+        }
+    }
+
+    void wait_for_var(int64_t id) {
+        std::unique_lock<std::mutex> lk(mu_);
+        Var* v = vars_.at(id);
+        done_cv_.wait(lk, [&] {
+            return v->q.empty() && !v->active_writer && v->active_readers == 0;
+        });
+    }
+
+    void wait_all() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [&] { return pending_ == 0; });
+    }
+
+  private:
+    void add_dep(Op* op, int64_t id, bool write) {
+        auto it = vars_.find(id);
+        if (it == vars_.end()) vars_[id] = new Var();
+        op->vars.emplace_back(id, write);
+    }
+
+    // admit runnable ops from the front of v's queue (caller holds mu_)
+    void try_dispatch(Var* v) {
+        while (!v->q.empty()) {
+            Op* op = v->q.front().first;
+            bool write = v->q.front().second;
+            if (write) {
+                if (v->active_writer || v->active_readers > 0) break;
+                v->active_writer = true;
+            } else {
+                if (v->active_writer) break;
+                ++v->active_readers;
+            }
+            v->q.pop_front();
+            if (++op->grants == op->vars.size()) {
+                ready_.push(op);
+                ready_cv_.notify_one();
+            }
+            if (write) break;  // writer is exclusive; stop admitting
+        }
+    }
+
+    void worker_loop() {
+        for (;;) {
+            Op* op;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                ready_cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+                if (shutdown_ && ready_.empty()) return;
+                op = ready_.front();
+                ready_.pop();
+            }
+            op->fn(op->arg);
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                for (auto& vb : op->vars) {
+                    Var* v = vars_.at(vb.first);
+                    if (vb.second) v->active_writer = false;
+                    else --v->active_readers;
+                }
+                for (auto& vb : op->vars) try_dispatch(vars_.at(vb.first));
+                --pending_;
+                done_cv_.notify_all();
+            }
+            delete op;
+        }
+    }
+
+    std::mutex mu_;
+    std::condition_variable ready_cv_, done_cv_;
+    std::unordered_map<int64_t, Var*> vars_;
+    std::queue<Op*> ready_;
+    std::vector<std::thread> workers_;
+    int64_t next_var_ = 1;
+    size_t pending_ = 0;
+    bool shutdown_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// pooled storage allocator (host staging buffers)
+// ---------------------------------------------------------------------------
+
+class Pool {
+  public:
+    ~Pool() {
+        for (auto& kv : free_) for (void* p : kv.second) std::free(p);
+    }
+
+    void* alloc(size_t size) {
+        size_t bucket = round_up(size);
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            auto it = free_.find(bucket);
+            if (it != free_.end() && !it->second.empty()) {
+                void* p = it->second.back();
+                it->second.pop_back();
+                pooled_bytes_ -= bucket;
+                live_[p] = bucket;
+                used_bytes_ += bucket;
+                return p;
+            }
+        }
+        void* p = std::malloc(bucket);
+        if (!p) return nullptr;
+        std::unique_lock<std::mutex> lk(mu_);
+        live_[p] = bucket;
+        used_bytes_ += bucket;
+        return p;
+    }
+
+    void release(void* p) {
+        std::unique_lock<std::mutex> lk(mu_);
+        auto it = live_.find(p);
+        if (it == live_.end()) return;  // not ours; ignore
+        size_t bucket = it->second;
+        live_.erase(it);
+        used_bytes_ -= bucket;
+        free_[bucket].push_back(p);
+        pooled_bytes_ += bucket;
+    }
+
+    void stats(size_t* used, size_t* pooled) {
+        std::unique_lock<std::mutex> lk(mu_);
+        *used = used_bytes_;
+        *pooled = pooled_bytes_;
+    }
+
+  private:
+    static size_t round_up(size_t s) {
+        size_t b = 256;
+        while (b < s) b <<= 1;
+        return b;
+    }
+
+    std::mutex mu_;
+    std::unordered_map<size_t, std::vector<void*>> free_;
+    std::unordered_map<void*, size_t> live_;
+    size_t used_bytes_ = 0, pooled_bytes_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// bounded blocking token queue (prefetch pipeline backbone)
+// ---------------------------------------------------------------------------
+
+class TokenQueue {
+  public:
+    explicit TokenQueue(size_t cap) : cap_(cap ? cap : 1) {}
+
+    // blocks while full; returns false if closed
+    bool push(uint64_t tok) {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_push_.wait(lk, [&] { return closed_ || q_.size() < cap_; });
+        if (closed_) return false;
+        q_.push_back(tok);
+        cv_pop_.notify_one();
+        return true;
+    }
+
+    // blocks while empty; returns false if closed and drained
+    bool pop(uint64_t* tok) {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_pop_.wait(lk, [&] { return closed_ || !q_.empty(); });
+        if (q_.empty()) return false;
+        *tok = q_.front();
+        q_.pop_front();
+        cv_push_.notify_one();
+        return true;
+    }
+
+    void close() {
+        std::unique_lock<std::mutex> lk(mu_);
+        closed_ = true;
+        cv_push_.notify_all();
+        cv_pop_.notify_all();
+    }
+
+    size_t size() {
+        std::unique_lock<std::mutex> lk(mu_);
+        return q_.size();
+    }
+
+  private:
+    std::mutex mu_;
+    std::condition_variable cv_push_, cv_pop_;
+    std::deque<uint64_t> q_;
+    size_t cap_;
+    bool closed_ = false;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* mxtpu_engine_create(int num_threads) { return new Engine(num_threads); }
+void mxtpu_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+int64_t mxtpu_engine_new_var(void* e) {
+    return static_cast<Engine*>(e)->new_var();
+}
+void mxtpu_engine_push(void* e, op_fn fn, void* arg,
+                       const int64_t* const_vars, int n_const,
+                       const int64_t* mut_vars, int n_mut) {
+    static_cast<Engine*>(e)->push(fn, arg, const_vars, n_const, mut_vars,
+                                  n_mut);
+}
+void mxtpu_engine_wait_for_var(void* e, int64_t v) {
+    static_cast<Engine*>(e)->wait_for_var(v);
+}
+void mxtpu_engine_wait_all(void* e) { static_cast<Engine*>(e)->wait_all(); }
+
+void* mxtpu_pool_create() { return new Pool(); }
+void mxtpu_pool_destroy(void* p) { delete static_cast<Pool*>(p); }
+void* mxtpu_pool_alloc(void* p, size_t size) {
+    return static_cast<Pool*>(p)->alloc(size);
+}
+void mxtpu_pool_free(void* p, void* ptr) {
+    static_cast<Pool*>(p)->release(ptr);
+}
+void mxtpu_pool_stats(void* p, size_t* used, size_t* pooled) {
+    static_cast<Pool*>(p)->stats(used, pooled);
+}
+
+void* mxtpu_queue_create(size_t cap) { return new TokenQueue(cap); }
+void mxtpu_queue_destroy(void* q) { delete static_cast<TokenQueue*>(q); }
+int mxtpu_queue_push(void* q, uint64_t tok) {
+    return static_cast<TokenQueue*>(q)->push(tok) ? 1 : 0;
+}
+int mxtpu_queue_pop(void* q, uint64_t* tok) {
+    return static_cast<TokenQueue*>(q)->pop(tok) ? 1 : 0;
+}
+void mxtpu_queue_close(void* q) { static_cast<TokenQueue*>(q)->close(); }
+size_t mxtpu_queue_size(void* q) {
+    return static_cast<TokenQueue*>(q)->size();
+}
+
+}  // extern "C"
