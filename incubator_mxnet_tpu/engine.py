@@ -12,30 +12,12 @@ IO-grade tasks, plus the engine-selection switch:
 """
 from __future__ import annotations
 
-import os
-
 from . import runtime as _rt
 from . import ndarray as _nd
+from .runtime import engine_type, get_engine
 
 __all__ = ["push", "new_var", "wait_for_var", "wait_all", "engine_type",
            "get_engine"]
-
-
-def engine_type() -> str:
-    forced = os.environ.get("MXTPU_ENGINE", "native")
-    if forced == "python" or not _rt.native_available():
-        return "python"
-    return "native"
-
-
-_engine = None
-
-
-def get_engine() -> _rt.Engine:
-    global _engine
-    if _engine is None:
-        _engine = _rt.Engine(force_python=engine_type() == "python")
-    return _engine
 
 
 def new_var() -> int:

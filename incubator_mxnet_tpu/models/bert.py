@@ -41,12 +41,20 @@ class MultiHeadAttentionCell(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 weight_initializer=None, prefix=None, params=None):
+                 weight_initializer=None, ring=None, prefix=None,
+                 params=None):
         super().__init__(prefix, params)
         assert units % num_heads == 0
         self._units = units
         self._num_heads = num_heads
         self._dropout = dropout
+        self._ring = ring    # (mesh, axis): sequence-parallel attention core
+        if ring is not None and dropout > 0.0:
+            import warnings
+            warnings.warn(
+                "ring attention applies no attention-weight dropout (flash-"
+                "style kernels keep weights in registers); residual/FFN "
+                "dropout still applies", stacklevel=3)
         self.qkv = nn.Dense(3 * units, flatten=False, in_units=units,
                             use_bias=use_bias,
                             weight_initializer=weight_initializer)
@@ -56,9 +64,34 @@ class MultiHeadAttentionCell(HybridBlock):
 
     def forward(self, x, mask=None):
         q, k, v = nd.split(self.qkv(x), 3, axis=-1)
-        out = ops.multihead_attention(q, k, v, self._num_heads, mask,
-                                      self._dropout)
+        if self._ring is not None:
+            if mask is not None:
+                raise ValueError("ring attention path needs full sequences "
+                                 "(valid_length mask unsupported); pad to "
+                                 "max_length instead")
+            out = self._ring_core(q, k, v)
+        else:
+            out = ops.multihead_attention(q, k, v, self._num_heads, mask,
+                                          self._dropout)
         return self.proj(out)
+
+    def _ring_core(self, q, k, v):
+        """Long-context core: sequence dim sharded over the mesh 'sp' axis,
+        KV blocks rotate over ICI (parallel/ring_attention.py)."""
+        from ..parallel import ring_attention
+        mesh, axis = self._ring
+        heads = self._num_heads
+
+        def f(qr, kr, vr):
+            b, L, d = qr.shape
+            hd = d // heads
+
+            def split(t):
+                return t.reshape(b, L, heads, hd).transpose(0, 2, 1, 3)
+
+            o = ring_attention(split(qr), split(kr), split(vr), mesh, axis)
+            return o.transpose(0, 2, 1, 3).reshape(b, L, d)
+        return _apply(f, [q, k, v], name="ring_self_attention")
 
 
 class PositionwiseFFN(HybridBlock):
@@ -88,11 +121,13 @@ class BERTEncoderCell(HybridBlock):
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  pre_norm=False, layer_norm_eps=1e-12,
-                 weight_initializer=None, prefix=None, params=None):
+                 weight_initializer=None, ring=None, prefix=None,
+                 params=None):
         super().__init__(prefix, params)
         self._pre_norm = pre_norm
         self.attention = MultiHeadAttentionCell(
-            units, num_heads, dropout, weight_initializer=weight_initializer)
+            units, num_heads, dropout, weight_initializer=weight_initializer,
+            ring=ring)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout,
                                    weight_initializer=weight_initializer)
         self.dropout = nn.Dropout(dropout)
@@ -112,7 +147,7 @@ class BERTEncoder(HybridBlock):
 
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  max_length=512, dropout=0.0, pre_norm=False,
-                 layer_norm_eps=1e-12, weight_initializer=None,
+                 layer_norm_eps=1e-12, weight_initializer=None, ring=None,
                  prefix=None, params=None):
         super().__init__(prefix, params)
         self._units = units
@@ -125,7 +160,7 @@ class BERTEncoder(HybridBlock):
         for _ in range(num_layers):
             self.cells.add(BERTEncoderCell(
                 units, hidden_size, num_heads, dropout, pre_norm,
-                layer_norm_eps, weight_initializer))
+                layer_norm_eps, weight_initializer, ring=ring))
 
     def forward(self, x, mask=None):
         seq_len = x.shape[1]
@@ -156,15 +191,18 @@ class BERTModel(HybridBlock):
     def __init__(self, num_layers=12, units=768, hidden_size=3072,
                  num_heads=12, max_length=512, vocab_size=30522,
                  token_type_vocab_size=2, dropout=0.1, pre_norm=False,
-                 use_pooler=True, layer_norm_eps=1e-12, prefix=None,
-                 params=None):
+                 use_pooler=True, layer_norm_eps=1e-12, ring=None,
+                 prefix=None, params=None):
+        """ring=(mesh, 'sp') switches every attention core to sequence-
+        parallel ring attention for long-context training: activations stay
+        sharded (B, L/sp, D) per device, only KV blocks move over ICI."""
         super().__init__(prefix, params)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
         self.token_type_embed = nn.Embedding(token_type_vocab_size, units)
         self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
                                    max_length, dropout, pre_norm,
-                                   layer_norm_eps)
+                                   layer_norm_eps, ring=ring)
         self.pooler = (nn.Dense(units, flatten=False, in_units=units,
                                 activation="tanh") if use_pooler else None)
 

@@ -19,7 +19,8 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Activation",
            "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
            "UpSampling", "multihead_attention", "box_iou", "box_nms",
-           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection"]
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+           "ROIPooling", "im2col", "SliceChannel"]
 
 
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
@@ -143,6 +144,26 @@ def UpSampling(data, scale=2, sample_type="nearest", layout="NCHW"):
         raise NotImplementedError("bilinear UpSampling: use Deconvolution with "
                                   "Bilinear init (parity with reference usage)")
     return _apply(f, [data], name="UpSampling")
+
+
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """ROI max pooling (reference: mx.nd.ROIPooling). data NCHW; rois (R,5)
+    rows [batch_idx, x0, y0, x1, y1] image coords."""
+    return _apply(lambda x, r: _raw.roi_pooling(x, r, pooled_size,
+                                                spatial_scale),
+                  [data, _as_nd(rois)], name="ROIPooling")
+
+
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """Patch unfolding (reference: mx.nd.im2col)."""
+    return _apply(lambda x: _raw.im2col(x, kernel, stride, dilate, pad),
+                  [data], name="im2col")
+
+
+def SliceChannel(data, num_outputs, axis=1, squeeze_axis=False):
+    """Parity alias: mx.nd.SliceChannel == split."""
+    from .. import ndarray as nd
+    return nd.split(data, num_outputs, axis=axis, squeeze_axis=squeeze_axis)
 
 
 def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,

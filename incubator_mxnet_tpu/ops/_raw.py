@@ -394,3 +394,70 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
         w = dropout(w, key, dropout_rate, training)
     out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
     return out.transpose(0, 2, 1, 3).reshape(b, lq, d)
+
+
+# ---------------------------------------------------------------------------
+# vision extras (reference: src/operator/roi_pooling.cc, im2col.h)
+# ---------------------------------------------------------------------------
+
+def roi_pooling(x, rois, pooled_size, spatial_scale):
+    """ROI max pooling, NCHW. x: (N,C,H,W); rois: (R,5) [batch_idx, x0, y0,
+    x1, y1] in image coords. Static-shape TPU formulation: one mask-matmul
+    per pooled cell over the full H,W grid is replaced by a gather-free
+    max over a masked grid — vectorized over rois via vmap."""
+    n, c, h, w = x.shape
+    ph, pw = pooled_size
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0, y0, x1, y1 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        x0, y0 = jnp.round(x0), jnp.round(y0)
+        x1, y1 = jnp.round(x1), jnp.round(y1)
+        rw = jnp.maximum(x1 - x0 + 1.0, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1.0, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        img = x[b]                                          # (C,H,W)
+
+        def cell(i, j):
+            hs = jnp.floor(y0 + i * bin_h)
+            he = jnp.ceil(y0 + (i + 1) * bin_h)
+            ws_ = jnp.floor(x0 + j * bin_w)
+            we = jnp.ceil(x0 + (j + 1) * bin_w)
+            mask = ((ys >= hs) & (ys < he))[:, None] & \
+                   ((xs >= ws_) & (xs < we))[None, :]
+            empty = ~mask.any()
+            val = jnp.max(jnp.where(mask[None], img, -jnp.inf), axis=(1, 2))
+            return jnp.where(empty, 0.0, val)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(ii, jj)            # (ph,pw,C)
+        return cells.transpose(2, 0, 1)                     # (C,ph,pw)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))      # (R,C,ph,pw)
+
+
+def im2col(x, kernel, stride=None, dilate=None, pad=None):
+    """Unfold NCHW patches to columns (reference im2col.h):
+    (N, C, H, W) -> (N, C*kh*kw, L) with L = out_h*out_w."""
+    kh, kw = kernel
+    stride = stride or (1, 1)
+    dilate = dilate or (1, 1)
+    pad = pad or (0, 0)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    out_h = (h + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    out_w = (w + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    # extract_patches via gather of strided indices (static shapes)
+    i0 = jnp.arange(out_h) * stride[0]
+    j0 = jnp.arange(out_w) * stride[1]
+    ki = jnp.arange(kh) * dilate[0]
+    kj = jnp.arange(kw) * dilate[1]
+    rows = i0[:, None] + ki[None, :]                         # (out_h, kh)
+    cols = j0[:, None] + kj[None, :]                         # (out_w, kw)
+    # (N, C, out_h, kh, W') -> (N, C, out_h, kh, out_w, kw)
+    patches = xp[:, :, rows][:, :, :, :, cols]
+    patches = patches.transpose(0, 1, 3, 5, 2, 4)            # N,C,kh,kw,oh,ow
+    return patches.reshape(n, c * kh * kw, out_h * out_w)

@@ -19,7 +19,8 @@ from collections import deque
 from .features import Feature, Features, feature_list
 
 __all__ = ["Engine", "StoragePool", "TokenQueue", "native_available",
-           "get_engine", "Feature", "Features", "feature_list"]
+           "get_engine", "engine_type", "Feature", "Features",
+           "feature_list"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmxtpu_runtime.so")
@@ -77,6 +78,7 @@ def _build_and_load():
 
 
 _OP_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_ENGINE_ENV = "MXTPU_ENGINE"
 
 
 def native_available() -> bool:
@@ -95,13 +97,24 @@ class Engine:
     def __init__(self, num_threads=None, force_python=False):
         num_threads = num_threads or max(2, (os.cpu_count() or 4) // 2)
         self._lib = None if force_python else _build_and_load()
-        self._callbacks = {}          # keep ctypes thunks alive until run
+        self._callbacks = {}          # op id -> python fn (until it runs)
         self._cb_lock = threading.Lock()
         self._cb_id = 0
         if self._lib is not None:
+            # ONE persistent trampoline for all ops: the C side passes the
+            # op id as arg, so no per-op CFUNCTYPE object ever gets freed
+            # while a worker thread is inside it
+            self._dispatch = _OP_FN(self._run_cb)
             self._h = self._lib.mxtpu_engine_create(num_threads)
         else:
             self._py = _PyEngine(num_threads)
+
+    def _run_cb(self, arg):
+        cid = int(arg) if arg is not None else 0
+        with self._cb_lock:
+            fn = self._callbacks.pop(cid, None)
+        if fn is not None:
+            fn()
 
     def new_var(self) -> int:
         if self._lib is not None:
@@ -115,21 +128,12 @@ class Engine:
         with self._cb_lock:
             self._cb_id += 1
             cid = self._cb_id
-
-        def run(_):
-            try:
-                fn()
-            finally:
-                with self._cb_lock:
-                    self._callbacks.pop(cid, None)
-
-        thunk = _OP_FN(run)
-        with self._cb_lock:
-            self._callbacks[cid] = thunk
+            self._callbacks[cid] = fn
         cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
         mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
         self._lib.mxtpu_engine_push(
-            self._h, ctypes.cast(thunk, ctypes.c_void_p), None,
+            self._h, ctypes.cast(self._dispatch, ctypes.c_void_p),
+            ctypes.c_void_p(cid),
             cv, len(const_vars), mv, len(mutable_vars))
 
     def wait_for_var(self, var: int):
@@ -155,13 +159,16 @@ class Engine:
 
 
 class _PyEngine:
-    """Pure-Python fallback with the same semantics (GIL-bound)."""
+    """Pure-Python fallback with the same semantics (GIL-bound):
+    reads of a var run concurrently after the last write; a write waits for
+    the last write AND all reads issued since it."""
 
     def __init__(self, num_threads):
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(num_threads)
         self._lock = threading.Lock()
-        self._var_last = {}           # var -> last future touching it
+        self._last_write = {}         # var -> future of last write
+        self._readers = {}            # var -> futures reading since last write
         self._next = 1
         self._futures = set()
 
@@ -173,9 +180,16 @@ class _PyEngine:
 
     def push(self, fn, const_vars=(), mutable_vars=()):
         with self._lock:
-            deps = [self._var_last.get(v) for v in
-                    list(const_vars) + list(mutable_vars)]
-            deps = [d for d in deps if d is not None]
+            deps = []
+            for v in const_vars:
+                d = self._last_write.get(v)
+                if d is not None:
+                    deps.append(d)
+            for v in mutable_vars:
+                d = self._last_write.get(v)
+                if d is not None:
+                    deps.append(d)
+                deps.extend(self._readers.get(v, ()))
 
             def run():
                 for d in deps:
@@ -185,14 +199,19 @@ class _PyEngine:
             fut = self._pool.submit(run)
             self._futures.add(fut)
             fut.add_done_callback(lambda f: self._futures.discard(f))
+            for v in const_vars:
+                self._readers.setdefault(v, []).append(fut)
             for v in mutable_vars:
-                self._var_last[v] = fut
+                self._last_write[v] = fut
+                self._readers[v] = []
 
     def wait_for_var(self, var):
         with self._lock:
-            fut = self._var_last.get(var)
-        if fut is not None:
-            fut.result()
+            futs = [self._last_write.get(var)] + \
+                list(self._readers.get(var, ()))
+        for fut in futs:
+            if fut is not None:
+                fut.result()
 
     def wait_all(self):
         for fut in list(self._futures):
@@ -203,11 +222,21 @@ _global_engine = None
 _global_lock = threading.Lock()
 
 
+def engine_type() -> str:
+    """'native' (C++ threaded engine) unless MXTPU_ENGINE=python or the
+    toolchain is unavailable."""
+    if os.environ.get(_ENGINE_ENV, "native") == "python" or \
+            not native_available():
+        return "python"
+    return "native"
+
+
 def get_engine() -> Engine:
+    """Process-wide engine singleton, honoring MXTPU_ENGINE."""
     global _global_engine
     with _global_lock:
         if _global_engine is None:
-            _global_engine = Engine()
+            _global_engine = Engine(force_python=engine_type() == "python")
         return _global_engine
 
 

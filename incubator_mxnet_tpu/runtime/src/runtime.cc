@@ -106,7 +106,9 @@ class Engine {
 
     void wait_for_var(int64_t id) {
         std::unique_lock<std::mutex> lk(mu_);
-        Var* v = vars_.at(id);
+        auto it = vars_.find(id);
+        if (it == vars_.end()) return;  // unknown var: nothing pending
+        Var* v = it->second;
         done_cv_.wait(lk, [&] {
             return v->q.empty() && !v->active_writer && v->active_readers == 0;
         });

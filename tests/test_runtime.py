@@ -95,6 +95,49 @@ class TestEngine:
         eng.wait_all()
         assert out == list(range(20))
 
+    def test_python_fallback_write_waits_for_reads(self):
+        """Regression: a write pushed after reads must wait for them."""
+        eng = runtime.Engine(4, force_python=True)
+        v = eng.new_var()
+        events = []
+        lock = threading.Lock()
+
+        def slow_read():
+            time.sleep(0.05)
+            with lock:
+                events.append("r")
+
+        def write():
+            with lock:
+                events.append("w")
+
+        eng.push(slow_read, const_vars=[v])
+        eng.push(slow_read, const_vars=[v])
+        eng.push(write, mutable_vars=[v])
+        eng.wait_for_var(v)
+        assert events == ["r", "r", "w"]
+
+    def test_wait_for_unknown_var_returns(self):
+        eng = runtime.Engine(2)
+        eng.wait_for_var(999999)   # must not abort/hang
+
+    def test_many_ops_stress(self):
+        """Thunk lifetime: thousands of callbacks through the persistent
+        dispatcher must not corrupt the process."""
+        eng = runtime.Engine(8)
+        v = [eng.new_var() for _ in range(8)]
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def bump():
+            with lock:
+                counter["n"] += 1
+
+        for i in range(4000):
+            eng.push(bump, mutable_vars=[v[i % 8]])
+        eng.wait_all()
+        assert counter["n"] == 4000
+
 
 class TestStoragePool:
     def test_alloc_free_reuse(self):

@@ -220,3 +220,42 @@ def test_ssd_detect(ssd_net):
     kept = det[0][det[0, :, 0] >= 0]
     if kept.shape[0] > 1:
         assert (np.diff(kept[:, 1]) <= 1e-6).all()
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling / im2col / SliceChannel (SURVEY §2.5 vision extras)
+# ---------------------------------------------------------------------------
+
+def test_roi_pooling_known_values():
+    # 1x1x4x4 image with values 0..15; roi covering the whole image,
+    # pooled 2x2 -> max of each quadrant
+    img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = ops.ROIPooling(img, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_roi_pooling_batch_index_and_scale():
+    imgs = nd.array(np.stack([np.zeros((1, 4, 4), np.float32),
+                              np.full((1, 4, 4), 9.0, np.float32)]))
+    rois = nd.array(np.array([[1, 0, 0, 6, 6]], np.float32))
+    out = ops.ROIPooling(imgs, rois, pooled_size=(1, 1), spatial_scale=0.5)
+    assert float(out.asnumpy()[0, 0, 0, 0]) == 9.0
+
+
+def test_im2col_matches_torch_unfold():
+    import torch
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    got = ops.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1)).asnumpy()
+    ref = torch.nn.functional.unfold(torch.from_numpy(x), (3, 3),
+                                     padding=1, stride=2).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_slice_channel():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(2, 6))
+    parts = ops.SliceChannel(x, 3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_array_equal(parts[0].asnumpy(), [[0, 1], [6, 7]])
