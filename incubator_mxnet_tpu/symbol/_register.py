@@ -593,3 +593,110 @@ _EXPORTS = [n for n in list(globals()) if n[0].isupper() or n in (
 for _n in _EXPORTS:
     if not _n.startswith("_"):
         setattr(_sym_mod, _n, globals()[_n])
+
+
+# ---------------------------------------------------------------------------
+# InstanceNorm / UpSampling / fused RNN (parity: src/operator/instance_norm,
+# nn/upsampling, rnn.cc — mx.sym surface)
+# ---------------------------------------------------------------------------
+
+register_op(
+    "InstanceNorm",
+    lambda rt, a, x, g, b: _raw.instance_norm(x, g, b, a.get("eps", 1e-3)),
+    ("data", "gamma", "beta"), infer_hint=_channel_hint_at(1))
+
+register_op(
+    "UpSampling",
+    lambda rt, a, x: jnp.repeat(jnp.repeat(x, a.get("scale", 2), axis=2),
+                                a.get("scale", 2), axis=3),
+    ("data",))
+
+
+def _unpack_rnn_params(p, mode, num_layers, D, I, H):
+    """Reference flat packing (rnn-inl.h): all i2h/h2h weights in
+    (layer, dir) order, then all biases in the same order."""
+    from ..ops._rnn import GATES
+    G = GATES[mode]
+    shapes = []
+    for layer in range(num_layers):
+        il = I if layer == 0 else D * H
+        for _ in range(D):
+            shapes.append(((G * H, il), (G * H, H)))
+    off = 0
+    weights = []
+    for s1, s2 in shapes:
+        n1 = s1[0] * s1[1]
+        w1 = p[off:off + n1].reshape(s1)
+        off += n1
+        n2 = s2[0] * s2[1]
+        w2 = p[off:off + n2].reshape(s2)
+        off += n2
+        weights.append((w1, w2))
+    biases = []
+    for s1, s2 in shapes:
+        b1 = p[off:off + s1[0]]
+        off += s1[0]
+        b2 = p[off:off + s2[0]]
+        off += s2[0]
+        biases.append((b1, b2))
+    return [(w1, w2, b1, b2)
+            for (w1, w2), (b1, b2) in zip(weights, biases)]
+
+
+def _rnn_fn(rt, a, x, params, *states):
+    from ..ops import _rnn as _rnn_mod
+    mode = a.get("mode", "lstm")
+    H = int(a["state_size"])
+    L = int(a.get("num_layers", 1))
+    bid = bool(a.get("bidirectional", False))
+    D = 2 if bid else 1
+    I = x.shape[-1]
+    layer_params = _unpack_rnn_params(params, mode, L, D, I, H)
+    dropout = float(a.get("p", 0.0))
+    key = rt.next_key() if (dropout > 0.0 and rt.is_train) else None
+    out, new_states = _rnn_mod.rnn_forward(
+        x, list(states), layer_params, mode, bidirectional=bid,
+        dropout=dropout, dropout_key=key, training=rt.is_train)
+    if a.get("state_outputs", False):
+        return (out, *new_states)
+    return out
+
+
+def _rnn_nout(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+register_op("RNN", _rnn_fn, ("data", "parameters", "state", "state_cell"),
+            n_out=_rnn_nout)
+
+
+def InstanceNorm(data=None, gamma=None, beta=None, eps=1e-3, name=None):
+    return _make_op("InstanceNorm", [data, gamma, beta], _attrs(eps=eps),
+                    name)
+
+
+def UpSampling(data=None, scale=2, sample_type="nearest", name=None):
+    if sample_type != "nearest":
+        raise NotImplementedError("bilinear UpSampling: use Deconvolution "
+                                  "with Bilinear init")
+    return _make_op("UpSampling", [data], _attrs(scale=scale), name)
+
+
+def RNN(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, name=None):
+    """Fused multi-layer RNN (parity: mx.sym.RNN / src/operator/rnn.cc).
+    data (T,N,I); parameters flat packed (rnn-inl.h layout); state
+    (L*D,N,H); state_cell for lstm."""
+    inputs = [data, parameters, state]
+    if mode == "lstm":
+        inputs.append(state_cell)
+    return _make_op("RNN", inputs, _attrs(
+        mode=mode, state_size=state_size, num_layers=num_layers,
+        bidirectional=bidirectional, p=p, state_outputs=state_outputs), name)
+
+
+for _n in ["InstanceNorm", "UpSampling", "RNN"]:
+    setattr(_sym_mod, _n, globals()[_n])
