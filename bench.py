@@ -38,11 +38,34 @@ from incubator_mxnet_tpu.parallel import FusedTrainStep  # noqa: E402
 V100_BASELINE_IMG_S = 390.0  # MXNet ResNet-50 fp32, single V100 (published)
 
 
+def acquire_backend(attempts=6, first_delay=3.0):
+    """Backend init through the axon relay is occasionally UNAVAILABLE
+    (transient tunnel/contention); retry with backoff before giving up so
+    one flake doesn't forfeit the round's perf number."""
+    delay = first_delay
+    last = None
+    for i in range(attempts):
+        try:
+            devs = jax.devices()
+            # force a real device computation, not just backend discovery
+            import jax.numpy as jnp
+            jnp.zeros((2, 2)).block_until_ready()
+            return devs
+        except Exception as e:  # noqa: BLE001
+            last = e
+            print(f"bench: backend attempt {i + 1}/{attempts} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+    raise RuntimeError(f"backend unavailable after {attempts} attempts: {last}")
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
+    acquire_backend()
     np.random.seed(0)
     mx.random.seed(0)
 
@@ -91,4 +114,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        traceback.print_exc()
+        # Emit a parseable JSON line even on failure so the driver records
+        # a diagnostic instead of a bare rc=1.
+        print(json.dumps({
+            "metric": "resnet50_imagenet_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }))
+        sys.exit(1)

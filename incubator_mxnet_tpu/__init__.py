@@ -29,6 +29,8 @@ from . import module
 from . import module as mod
 from . import metric
 from . import io
+from . import recordio
+from . import image
 from . import amp
 from . import runtime
 from . import engine

@@ -1,6 +1,6 @@
 """gluon: the imperative/hybrid high-level API (parity: python/mxnet/gluon)."""
 from . import data, loss, nn, rnn
-from .block import Block, HybridBlock
+from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict
 from .trainer import Trainer
 

@@ -23,7 +23,7 @@ from ..ndarray import random as ndrandom
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
                         _ParamTraceScope, _trace)
 
-__all__ = ["Block", "HybridBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
 class _NameScope:
@@ -110,7 +110,7 @@ class Block:
         for p in self.collect_params().values():
             p.cast(dtype)
         for child in self._children.values():
-            pass  # params already covered via collect_params
+            child.cast(dtype)
         self._dtype = dtype
 
     def apply(self, fn):
@@ -331,3 +331,157 @@ class HybridBlock(Block):
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a (bound-able) Symbol graph as a Gluon block (parity:
+    python/mxnet/gluon/block.py SymbolBlock) — the serving/fine-tuning
+    bridge between the Symbol and Gluon APIs: import a saved symbol +
+    checkpoint, then treat it as an ordinary HybridBlock (compose, train,
+    hybridize).
+
+    TPU-native: forward evaluates the graph through the same jnp-level
+    graph runner the Executor compiles, recorded on the autograd tape as
+    one node (`_apply`), so eager backward and the hybridized CachedOp both
+    run the graph as fused XLA computations.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        from .. import symbol as sym_mod
+        from ..symbol import _topo
+        from ..symbol.executor import _graph_runner
+
+        super().__init__(prefix="", params=None)
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        if isinstance(inputs, (str, sym_mod.Symbol)):
+            inputs = [inputs]
+        input_names = [i.name if isinstance(i, sym_mod.Symbol) else str(i)
+                       for i in inputs]
+        self._symbol = outputs
+        self._input_names = input_names
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        missing = [n for n in input_names if n not in arg_names]
+        if missing:
+            raise ValueError(f"inputs {missing} are not arguments of the "
+                             f"symbol (arguments: {arg_names})")
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        param_names = [n for n in arg_names if n not in input_names]
+
+        shared = dict(params.items()) if params is not None else {}
+        self._arg_params_list = []
+        for n in param_names:
+            if n in shared:
+                self._params.update([(n, shared[n])])
+                self._arg_params_list.append(shared[n])
+            else:
+                self._arg_params_list.append(
+                    self._params.get(n, shape=None, allow_deferred_init=True))
+        self._aux_params_list = []
+        for n in aux_names:
+            if n in shared:
+                self._params.update([(n, shared[n])])
+                self._aux_params_list.append(shared[n])
+            else:
+                self._aux_params_list.append(
+                    self._params.get(n, shape=None, grad_req="null",
+                                     init="zeros", allow_deferred_init=True))
+
+        order = _topo(outputs._entries)
+        var_by_name = {n.name: n for n in order if n.is_var}
+        self._runner = _graph_runner(outputs._entries,
+                                     [var_by_name[n] for n in arg_names],
+                                     [var_by_name[n] for n in aux_names])
+        self._n_out = len(outputs._entries)
+        # positions of inputs vs params within the symbol's argument order
+        self._input_pos = [arg_names.index(n) for n in input_names]
+        self._param_pos = [arg_names.index(n) for n in param_names]
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load `prefix-symbol.json` (+ optional `prefix-NNNN.params` in the
+        checkpoint format, `arg:`/`aux:` prefixes) into a SymbolBlock."""
+        from .. import ndarray as nd_mod
+        from .. import symbol as sym_mod
+
+        symbol = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        block = SymbolBlock(symbol, input_names)
+        if param_file is not None:
+            loaded = nd_mod.load(param_file)
+            by_name = {}
+            for k, v in loaded.items():
+                by_name[k.split(":", 1)[1] if ":" in k else k] = v
+            for name, p in block._params.items():
+                if name in by_name:
+                    p.set_data(by_name[name])
+                else:
+                    raise KeyError(f"Parameter {name} missing from "
+                                   f"{param_file}")
+        return block
+
+    def _complete_deferred(self, args):
+        """Finish deferred param init by running symbol shape inference with
+        the observed input shapes."""
+        pending = [p for p in self._arg_params_list + self._aux_params_list
+                   if p._data is None]
+        if not pending:
+            return
+        shapes = {n: tuple(a.shape)
+                  for n, a in zip(self._input_names, args)}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        for pos, p in zip(self._param_pos, self._arg_params_list):
+            if p._data is None and arg_shapes[pos] is not None:
+                p.shape = arg_shapes[pos]
+        for s, p in zip(aux_shapes, self._aux_params_list):
+            if p._data is None and s is not None:
+                p.shape = s
+        for p in pending:
+            if p._deferred is not None:
+                p.finish_deferred_init()
+            if p._data is None:
+                raise DeferredInitializationError(
+                    f"Parameter {p.name}: call initialize() before forward")
+
+    def forward(self, *args):
+        from ..symbol import _Runtime
+
+        if len(args) != len(self._input_names):
+            raise ValueError(f"SymbolBlock expects {len(self._input_names)} "
+                             f"inputs {self._input_names}, got {len(args)}")
+        self._complete_deferred(args)
+        param_nds = [p.data() for p in self._arg_params_list]
+        aux_nds = [p.data() for p in self._aux_params_list]
+        is_train = autograd.is_training()
+        key = ndrandom._key()
+        runner = self._runner
+        n_in, n_p = len(args), len(param_nds)
+        n_out, n_aux = self._n_out, len(aux_nds)
+        n_args_total = len(self._arg_names)
+        input_pos, param_pos = self._input_pos, self._param_pos
+
+        def f(*raws):
+            in_raws = raws[:n_in]
+            p_raws = raws[n_in:n_in + n_p]
+            aux_raws = raws[n_in + n_p:]
+            arg_raws = [None] * n_args_total
+            for pos, r in zip(input_pos, in_raws):
+                arg_raws[pos] = r
+            for pos, r in zip(param_pos, p_raws):
+                arg_raws[pos] = r
+            rt = _Runtime(is_train, key)
+            outs, new_aux = runner(rt, arg_raws, aux_raws)
+            return tuple(outs) + tuple(new_aux)
+
+        res = _apply(f, list(args) + param_nds + aux_nds,
+                     n_out=n_out + n_aux, name="symbolblock")
+        if n_out + n_aux == 1:
+            res = (res,)
+        outs, new_aux = res[:n_out], res[n_out:]
+        if is_train:
+            for p, new in zip(self._aux_params_list, new_aux):
+                p.update_aux(new._data)
+        return outs[0] if n_out == 1 else list(outs)

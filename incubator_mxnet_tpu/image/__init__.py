@@ -1,0 +1,571 @@
+"""Image API (parity: reference python/mxnet/image/image.py + the augmenter
+stack of src/io/image_aug_default.cc): decode, resize, crop, color jitter,
+and composable Augmenters feeding the training input pipeline.
+
+TPU-first design: everything here is the HOST side of the input pipeline —
+decode (PIL's C JPEG codec replacing the reference's OpenCV), numpy
+augmentation, batch assembly — and runs on DataLoader/ImageRecordIter
+worker threads under the native C++ prefetch runtime so the chip never
+waits on input. Per-image work never touches the device; only assembled
+batches are transferred (one host->device copy per batch).
+
+Functions accept and return `NDArray` (HWC, like the reference) but carry a
+numpy fast path internally (`_as_np`) so per-image augmentation costs no
+device round-trips.
+"""
+from __future__ import annotations
+
+import io as _io
+import random as _pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+    "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+    "RandomOrderAug", "CreateAugmenter", "ImageIter",
+]
+
+_GRAY = np.array([0.299, 0.587, 0.114], dtype=np.float32)
+
+
+def _as_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def _wrap(arr, like):
+    return nd.array(arr) if isinstance(like, NDArray) or like is None else arr
+
+
+# ---------------------------------------------------------------------------
+# decode / geometric ops
+# ---------------------------------------------------------------------------
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded (JPEG/PNG/...) buffer to an HWC uint8 NDArray
+    (reference mx.image.imdecode; flag=0 -> grayscale HW1)."""
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("L") if flag == 0 else img.convert(
+        "RGB" if to_rgb else "RGB")
+    arr = np.asarray(img, dtype=np.uint8)
+    if not to_rgb and flag != 0:
+        arr = arr[..., ::-1]  # reference BGR default when to_rgb=False
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return nd.array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file -> HWC uint8 NDArray (reference mx.image.imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def _pil_resize(arr, w, h, interp):
+    from PIL import Image
+
+    resamples = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                 3: Image.LANCZOS, 4: Image.LANCZOS}
+    squeeze = arr.shape[-1] == 1
+    pil = Image.fromarray(arr[..., 0] if squeeze else arr)
+    out = np.asarray(pil.resize((int(w), int(h)),
+                                resamples.get(interp, Image.BILINEAR)))
+    if squeeze:
+        out = out[:, :, None]
+    return out
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to exactly (w, h) (reference mx.image.imresize)."""
+    arr = _as_np(src)
+    return _wrap(_pil_resize(arr, w, h, interp), src)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the SHORT side equals `size`, preserving aspect
+    (reference mx.image.resize_short)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return _wrap(_pil_resize(arr, new_w, new_h, interp), src)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop the (x0, y0, w, h) window, optionally resize to `size` (w, h)."""
+    arr = _as_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        arr = _pil_resize(arr, size[0], size[1], interp)
+    return _wrap(arr, src)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of exactly `size`=(w, h) (pre-resized up if smaller);
+    returns (cropped, (x0, y0, w, h)) like the reference."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(_wrap(arr, src), x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop to `size`=(w, h); returns (cropped, window)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(_wrap(arr, src), x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, max_attempts=10):
+    """RandomResizedCrop: crop a random area/aspect window, resize to `size`
+    (reference mx.image.random_size_crop; the Inception-style augmenter)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(max_attempts):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(_wrap(arr, src), x0, y0, new_w, new_h, size,
+                             interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std, float32 (reference mx.image.color_normalize)."""
+    arr = _as_np(src).astype(np.float32)
+    mean_arr = _as_np(mean).astype(np.float32) if mean is not None else None
+    if mean_arr is not None:
+        arr = arr - mean_arr
+    if std is not None:
+        arr = arr / _as_np(std).astype(np.float32)
+    return _wrap(arr, src)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference Augmenter class hierarchy)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    """Composable image augmenter; __call__(img HWC NDArray) -> NDArray."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(range(len(self.ts)))
+        _pyrandom.shuffle(order)
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """resize_short to `size`."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to exactly (w, h) ignoring aspect."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, \
+            interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return _wrap(_as_np(src)[:, ::-1], src)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _wrap(_as_np(src).astype(self.typ), src)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return _wrap(_as_np(src).astype(np.float32) * alpha, src)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray_mean = (arr * _GRAY).sum() / (arr.shape[0] * arr.shape[1])
+        return _wrap(arr * alpha + gray_mean * (1.0 - alpha), src)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (arr * _GRAY).sum(axis=2, keepdims=True)
+        return _wrap(arr * alpha + gray * (1.0 - alpha), src)
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference HueJitterAug's Gray-world
+    approximation with the tyiq/ityiq matrices)."""
+
+    _TYIQ = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ITYIQ = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, w_ = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w_],
+                       [0.0, w_, u]], np.float32)
+        t = self._ITYIQ @ bt @ self._TYIQ
+        return _wrap(arr @ t.T, src)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(
+            np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return _wrap(_as_np(src).astype(np.float32) + rgb, src)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _as_np(src).astype(np.float32)
+            gray = (arr * _GRAY).sum(axis=2, keepdims=True)
+            return _wrap(np.broadcast_to(gray, arr.shape).copy(), src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard training augmenter stack (reference mx.image.CreateAugmenter).
+    data_shape is CHW like the reference."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(
+            pca_noise,
+            [55.46, 4.794, 1.148],
+            [[-0.5675, 0.7192, 0.4009],
+             [-0.5808, -0.0045, -0.8140],
+             [-0.5836, -0.6948, 0.4203]]))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference mx.image.ImageIter: .rec or .lst/raw-file driven)
+# ---------------------------------------------------------------------------
+
+class ImageIter:
+    """Image iterator over a record file (path_imgrec) or an index list
+    (imglist) of raw image files, with augmentation. DataIter protocol:
+    next() -> DataBatch of CHW float32 data + label.
+
+    The hot path (decode + augment, numpy) runs on the caller thread here;
+    `io.ImageRecordIter` wraps this dataset shape with the native prefetch
+    pipeline for throughput (reference iter_image_recordio_2.cc).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **aug_kwargs):
+        from ..io import DataDesc
+        if len(data_shape) != 3:
+            raise ValueError("data_shape must be CHW")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self._rec = None
+        self._samples = None
+        if path_imgrec is not None:
+            from ..recordio import MXIndexedRecordIO
+            idx_path = path_imgrec[:-4] + ".idx" \
+                if path_imgrec.endswith(".rec") else path_imgrec + ".idx"
+            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._order = list(self._rec.keys) if self._rec.keys else None
+            if self._order is None:
+                raise ValueError(f"no index found for {path_imgrec}")
+        elif imglist is not None or path_imglist is not None:
+            import os
+            if imglist is None:
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        imglist.append([float(x) for x in parts[1:-1]]
+                                       + [parts[-1]])
+            self._samples = [(np.asarray(entry[:-1], np.float32),
+                              os.path.join(path_root, entry[-1]))
+                             for entry in imglist]
+            self._order = list(range(len(self._samples)))
+        else:
+            raise ValueError("need path_imgrec, path_imglist or imglist")
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **aug_kwargs)
+        self.auglist = aug_list
+        self.data_name, self.label_name = data_name, label_name
+        self._desc = DataDesc
+        self.reset()
+
+    def __len__(self):
+        return len(self._order)
+
+    @property
+    def provide_data(self):
+        return [self._desc(self.data_name,
+                           (self.batch_size,) + self.data_shape, np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [self._desc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self._shuffle:
+            _pyrandom.shuffle(self._order)
+        self._cursor = 0
+
+    def read_sample(self, i):
+        """(label float32 array, HWC uint8 image) for sample key/index i."""
+        from ..recordio import unpack
+        if self._rec is not None:
+            header, img_bytes = unpack(self._rec.read_idx(i))
+            label = np.atleast_1d(np.asarray(header.label, np.float32))
+            img = imdecode(img_bytes).asnumpy()
+        else:
+            label, path = self._samples[i]
+            img = imread(path).asnumpy()
+        return label, img
+
+    def _augment(self, img):
+        out = img
+        for aug in self.auglist:
+            out = aug(out)
+        return _as_np(out)
+
+    def next(self):
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        from ..io import DataBatch
+        c, h, w = self.data_shape
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = 0
+        if len(idx) < self.batch_size:
+            if self._last == "discard":
+                self._cursor = len(self._order)
+                raise StopIteration
+            pad = self.batch_size - len(idx)
+            idx = list(idx) + self._order[:pad]
+        self._cursor += self.batch_size
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        label = np.empty((self.batch_size, self.label_width), np.float32)
+        for n, i in enumerate(idx):
+            lab, img = self.read_sample(i)
+            img = self._augment(img)
+            if img.shape[:2] != (h, w):
+                img = _pil_resize(img.astype(np.uint8), w, h, 2)
+            data[n] = np.transpose(img, (2, 0, 1)).astype(np.float32)
+            label[n] = lab[:self.label_width]
+        lab_out = label[:, 0] if self.label_width == 1 else label
+        return DataBatch([nd.array(data)], [nd.array(lab_out)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()

@@ -61,9 +61,12 @@ def _multibox_prior(h, w, sizes, ratios, steps, offsets, dtype=jnp.float32):
     step_x = steps[1] if steps[1] > 0 else 1.0 / w
     cy = (jnp.arange(h, dtype=dtype) + offsets[0]) * step_y
     cx = (jnp.arange(w, dtype=dtype) + offsets[1]) * step_x
-    # anchor shapes
+    # anchor shapes; widths carry the feature-map aspect (h/w) so anchors
+    # stay square in pixel space on non-square maps (reference kernel
+    # multiplies width by in_h/in_w)
     r0 = jnp.sqrt(ratios[0])
-    ws = jnp.concatenate([sizes * r0, sizes[0] * jnp.sqrt(ratios[1:])])
+    ws = jnp.concatenate([sizes * r0, sizes[0] * jnp.sqrt(ratios[1:])]) * (
+        jnp.asarray(h, dtype) / jnp.asarray(w, dtype))
     hs = jnp.concatenate([sizes / r0, sizes[0] / jnp.sqrt(ratios[1:])])
     cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")               # (H, W)
     cxg = cxg[..., None]
@@ -279,7 +282,7 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
 
 def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
                       clip=True, nms_threshold=0.5, force_suppress=False,
-                      variances=_VAR, nms_topk=400):
+                      variances=_VAR, nms_topk=-1):
     """Decode + per-class NMS (reference: mx.nd.contrib.MultiBoxDetection).
     cls_prob (B,C+1,A); loc_pred (B,A*4); anchor (1,A,4).
     Returns (B, A, 6) rows [class_id, score, x0, y0, x1, y1]; suppressed

@@ -1,0 +1,199 @@
+"""RecordIO: sequential + indexed record files and the image-record header
+(parity: reference python/mxnet/recordio.py + src/io/ recordio readers;
+ImageRecordIter's on-disk format).
+
+Format per record: [magic u32 | lrecord u32 | payload | pad-to-4].
+magic = 0xced7230a; lrecord = (cflag << 29) | length (cflag unused here —
+records are written unsplit). Image records prepend an IRHeader to the
+payload: (flag u32, label f32, id u64, id2 u64) packed little-endian; when
+flag > 0 the scalar label is followed by `flag` float32 labels.
+
+TPU-first note: this is pure host-side IO — the decode/augment path feeds
+numpy batches to the chip; nothing here traces into XLA.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LMASK = (1 << 29) - 1
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer (reference MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        if flag not in ("r", "w"):
+            raise ValueError(f"invalid flag {flag!r}: expected 'r' or 'w'")
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        self.record = open(self.uri, "rb" if self.flag == "r" else "wb")
+
+    def close(self):
+        if self.record is not None and not self.record.closed:
+            self.record.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def tell(self):
+        return self.record.tell()
+
+    def write(self, buf):
+        assert self.flag == "w", "not opened for writing"
+        if not isinstance(buf, (bytes, bytearray, memoryview)):
+            raise TypeError("write() expects bytes")
+        self.record.write(struct.pack("<II", _MAGIC, len(buf)))
+        self.record.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        """Next record payload, or None at EOF."""
+        assert self.flag == "r", "not opened for reading"
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError(f"invalid record magic {magic:#x} at "
+                          f"{self.record.tell() - 8}")
+        length = lrec & _LMASK
+        buf = self.record.read(length)
+        if len(buf) < length:
+            raise IOError("truncated record")
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Record file with a .idx sidecar mapping integer keys to byte offsets
+    (reference MXIndexedRecordIO): random access via read_idx/write_idx."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.split("\t")
+                    if len(parts) >= 2:
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.flag == "w" and self.idx:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert self.flag == "r"
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# ---------------------------------------------------------------------------
+# image record packing (IRHeader + encoded image payload)
+# ---------------------------------------------------------------------------
+
+def pack(header, s):
+    """IRHeader + image bytes -> record payload (reference recordio.pack)."""
+    header = IRHeader(*header)
+    label = header.label
+    if isinstance(label, (np.ndarray, list, tuple)):
+        label = np.asarray(label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, float(label), header.id,
+                       header.id2) + s
+
+
+def unpack(s):
+    """Record payload -> (IRHeader, image bytes). Multi-label records return
+    the label vector as header.label (float32 ndarray)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """IRHeader + HWC uint8 array -> record payload with an encoded image
+    (reference pack_img; PIL replaces the reference's cv2 encoder)."""
+    import io as _io
+
+    from PIL import Image
+
+    img = np.asarray(img, dtype=np.uint8)
+    if img.ndim == 2:
+        pil = Image.fromarray(img, mode="L")
+    else:
+        pil = Image.fromarray(img)
+    buf = _io.BytesIO()
+    fmt = img_fmt.lower().lstrip(".")
+    if fmt in ("jpg", "jpeg"):
+        pil.save(buf, format="JPEG", quality=quality)
+    elif fmt == "png":
+        pil.save(buf, format="PNG")
+    else:
+        raise ValueError(f"unsupported image format {img_fmt!r}")
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Record payload -> (IRHeader, decoded HWC uint8 array)."""
+    from . import image as _image
+
+    header, img_bytes = unpack(s)
+    return header, _image.imdecode(img_bytes, to_rgb=True,
+                                   flag=iscolor).asnumpy().astype(np.uint8)

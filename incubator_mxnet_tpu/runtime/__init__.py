@@ -25,24 +25,51 @@ __all__ = ["Engine", "StoragePool", "TokenQueue", "native_available",
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmxtpu_runtime.so")
 _lib = None
+_build_failed = False
+_build_lock = threading.Lock()
 
 
 def _build_and_load():
-    global _lib
+    """First-use g++ build of the native runtime. Thread/process safe:
+    compiles to a pid-unique temp file and os.replace()s it into place
+    (atomic on POSIX), guarded by a double-checked lock, so concurrent
+    importers (pytest-xdist, DataLoader workers) never observe a partially
+    written .so."""
+    global _lib, _build_failed
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO):
-        src = os.path.join(_DIR, "src", "runtime.cc")
-        try:
-            subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-pthread",
-                            "-shared", "-o", _SO, src], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            return None
-    try:
-        lib = ctypes.CDLL(_SO)
-    except OSError:
+    if _build_failed:
         return None
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO):
+            src = os.path.join(_DIR, "src", "runtime.cc")
+            tmp = f"{_SO}.tmp.{os.getpid()}"
+            try:
+                subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC",
+                                "-pthread", "-shared", "-o", tmp, src],
+                               check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        return _register_and_set(lib)
+
+
+def _register_and_set(lib):
+    global _lib
     lib.mxtpu_engine_create.restype = ctypes.c_void_p
     lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
     lib.mxtpu_engine_destroy.argtypes = [ctypes.c_void_p]
@@ -97,9 +124,10 @@ class Engine:
     def __init__(self, num_threads=None, force_python=False):
         num_threads = num_threads or max(2, (os.cpu_count() or 4) // 2)
         self._lib = None if force_python else _build_and_load()
-        self._callbacks = {}          # op id -> python fn (until it runs)
+        self._callbacks = {}          # op id -> (fn, vars) until it runs
         self._cb_lock = threading.Lock()
         self._cb_id = 0
+        self._errors = []             # [(exc, frozenset(vars))] until raised
         if self._lib is not None:
             # ONE persistent trampoline for all ops: the C side passes the
             # op id as arg, so no per-op CFUNCTYPE object ever gets freed
@@ -112,9 +140,32 @@ class Engine:
     def _run_cb(self, arg):
         cid = int(arg) if arg is not None else 0
         with self._cb_lock:
-            fn = self._callbacks.pop(cid, None)
-        if fn is not None:
+            ent = self._callbacks.pop(cid, None)
+        if ent is None:
+            return
+        fn, op_vars = ent
+        try:
             fn()
+        except BaseException as e:  # noqa: BLE001
+            # an exception must not escape into the ctypes trampoline (it
+            # would be printed and dropped); stash it and re-raise at the
+            # next wait_for_var/wait_all — reference engine error semantics
+            with self._cb_lock:
+                self._errors.append((e, op_vars))
+
+    def _raise_pending(self, var=None):
+        with self._cb_lock:
+            if not self._errors:
+                return
+            if var is None:
+                exc, _ = self._errors.pop(0)
+            else:
+                hit = next((i for i, (_, vs) in enumerate(self._errors)
+                            if var in vs), None)
+                if hit is None:
+                    return
+                exc, _ = self._errors.pop(hit)
+        raise exc
 
     def new_var(self) -> int:
         if self._lib is not None:
@@ -128,7 +179,8 @@ class Engine:
         with self._cb_lock:
             self._cb_id += 1
             cid = self._cb_id
-            self._callbacks[cid] = fn
+            self._callbacks[cid] = (
+                fn, frozenset(const_vars) | frozenset(mutable_vars))
         cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
         mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
         self._lib.mxtpu_engine_push(
@@ -139,12 +191,14 @@ class Engine:
     def wait_for_var(self, var: int):
         if self._lib is not None:
             self._lib.mxtpu_engine_wait_for_var(self._h, var)
+            self._raise_pending(var)
         else:
             self._py.wait_for_var(var)
 
     def wait_all(self):
         if self._lib is not None:
             self._lib.mxtpu_engine_wait_all(self._h)
+            self._raise_pending()
         else:
             self._py.wait_all()
 

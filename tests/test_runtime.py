@@ -245,6 +245,34 @@ class TestDataLoaderPrefetch:
             list(dl)
 
 
+def test_engine_push_error_propagates_to_wait():
+    """An exception inside a pushed op must not vanish in the callback
+    trampoline: it re-raises from wait_for_var(var) and wait_all()."""
+    eng = runtime.Engine(num_threads=2)
+    v = eng.new_var()
+
+    def bad():
+        raise ValueError("engine-op-boom")
+
+    eng.push(bad, mutable_vars=[v])
+    with pytest.raises(ValueError, match="engine-op-boom"):
+        eng.wait_for_var(v)
+
+    eng.push(bad, mutable_vars=[v])
+    with pytest.raises(ValueError, match="engine-op-boom"):
+        eng.wait_all()
+    # errors are consumed once raised; subsequent waits are clean
+    eng.wait_all()
+
+    # unrelated vars don't see the error
+    eng.push(bad, mutable_vars=[v])
+    other = eng.new_var()
+    eng.push(lambda: None, mutable_vars=[other])
+    eng.wait_for_var(other)
+    with pytest.raises(ValueError):
+        eng.wait_all()
+
+
 def test_features_pallas_flag_reflects_ops():
     from incubator_mxnet_tpu.ops import pallas
     feats = runtime.Features()

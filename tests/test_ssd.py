@@ -68,6 +68,28 @@ def test_multibox_prior_count_and_centers():
                                atol=1e-6)
 
 
+def test_multibox_prior_nonsquare_map_pixel_square():
+    """Reference kernel scales anchor width by in_h/in_w: on a non-square
+    feature map, a ratio-1 anchor must stay square in PIXEL space."""
+    h, w = 2, 4
+    x = nd.zeros((1, 8, h, w))  # NCHW
+    a = ops.MultiBoxPrior(x, sizes=[0.5], ratios=[1.0]).asnumpy()[0]
+    # first pixel center = (0.5/w, 0.5/h); w_norm = 0.5*h/w, h_norm = 0.5
+    np.testing.assert_allclose(a[0], [0.125 - 0.125, 0.25 - 0.25,
+                                      0.125 + 0.125, 0.25 + 0.25], atol=1e-6)
+    w_norm = a[:, 2] - a[:, 0]
+    h_norm = a[:, 3] - a[:, 1]
+    np.testing.assert_allclose(w_norm * w, h_norm * h, atol=1e-6)
+
+
+def test_multibox_detection_default_topk_all():
+    """Op-level default nms_topk=-1 considers every candidate (reference
+    default); anchors beyond any fixed top-k still come through."""
+    import inspect
+    assert inspect.signature(ops.MultiBoxDetection).parameters[
+        "nms_topk"].default == -1
+
+
 def test_multibox_target_matches_gt():
     # one anchor dead-on a GT box, one far away
     anchor = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
