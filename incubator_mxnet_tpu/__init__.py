@@ -22,6 +22,7 @@ from . import initializer
 from . import initializer as init
 from . import optimizer
 from . import kvstore
+from . import kvstore as kv
 from . import gluon
 from . import symbol
 from . import symbol as sym
@@ -29,6 +30,7 @@ from . import module
 from . import module as mod
 from . import metric
 from . import io
+from . import operator
 from . import recordio
 from . import image
 from . import amp

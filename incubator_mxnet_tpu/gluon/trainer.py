@@ -67,10 +67,10 @@ class Trainer:
         """Aggregate gradients across devices/workers. Single-chip: no-op.
         The mesh path does this inside the compiled step via psum."""
         if self._kvstore is not None and self._kvstore.num_workers > 1:
-            for i, p in enumerate(self._params):
-                g = p.grad()
-                key = f"grad{i}"
-                self._kvstore.pushpull(key, g, out=g)
+            grads = [p.grad() for p in self._params]
+            keys = [f"grad{i}" for i in range(len(grads))]
+            # one batched call → one compiled bucketed collective
+            self._kvstore.pushpull(keys, grads, out=grads)
 
     def step(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -82,11 +82,13 @@ class Trainer:
         self._update()
 
     def _update(self):
+        skip = getattr(self, "_amp_skip", None)  # on-device found-inf bool
         for i, p in enumerate(self._params):
             self._init_state(i, p)
             w = p.data()
             g = p.grad()
-            self._states[i] = self._optimizer.update(i, w, g, self._states[i])
+            self._states[i] = self._optimizer.update(i, w, g, self._states[i],
+                                                     skip=skip)
 
     # -- persistence ------------------------------------------------------
     def save_states(self, fname):

@@ -1,25 +1,168 @@
 """KVStore (parity: python/mxnet/kvstore.py + src/kvstore/).
 
 The reference aggregates gradients through ps-lite servers or NCCL
-(`dist_sync_device`). TPU-native: aggregation IS an XLA collective over the
-device mesh. Two surfaces:
+(`dist_sync_device`, `src/kvstore/kvstore_dist.h`). TPU-native: aggregation
+IS an XLA collective over the device mesh. Two surfaces:
 
 * object API here (init/push/pull/pushpull, server-side optimizer) — keeps
-  Trainer/Module code shape-compatible with the reference; `local`/`device`
-  run single-chip, `dist_*` aggregate across `jax.devices()` eagerly;
+  Trainer/Module code shape-compatible with the reference. Multi-device
+  values aggregate through ONE jitted bucketed computation: per-device
+  shards are flattened into a single fusion buffer per device (the
+  reference's kvstore big-array batching), assembled into a global array
+  sharded over a Mesh, and summed with replicated output sharding — XLA
+  lowers that to an all-reduce that rides ICI on real hardware;
 * the fused path (parallel/trainer_step) inlines a `psum` over the 'dp' mesh
-  axis inside the compiled train step — that is the NCCL-allreduce
-  replacement that rides ICI and is what bench/dryrun use.
+  axis inside the compiled train step — the highest-performance route that
+  bench/dryrun use.
+
+`dist_async` semantics: the reference's async mode lets each worker push
+updates without a global barrier (`src/kvstore/kvstore_dist_server.h`,
+updates applied in arrival order, no staleness bound). Single-process JAX
+has one update stream, so arrival order IS program order and `dist_async`
+is exactly equivalent to `dist_sync`; the flag is preserved so multi-host
+deployments can relax the cross-process allgather into per-process updates.
+
+Gradient compression (parity: src/kvstore/gradient_compression.cc): `2bit`
+quantizes each pushed value to {-threshold, 0, +threshold} with
+error-feedback residuals kept per (key, device-slot); `fp16` casts to
+half precision for the wire. Unsupported types raise (no silent no-ops).
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
 from .. import optimizer as _opt
 
 __all__ = ["KVStore", "create"]
+
+
+# --------------------------------------------------------------------------
+# Bucketed compiled aggregation
+# --------------------------------------------------------------------------
+
+@jax.jit
+def _tree_sum(values_per_key):
+    """Sum each key's list of same-device arrays in one compiled call.
+    jit caches per pytree-structure/shape signature automatically."""
+    out = []
+    for vals in values_per_key:
+        total = vals[0]
+        for v in vals[1:]:
+            total = total + v
+        out.append(total)
+    return out
+
+
+class _BucketedAllReduce:
+    """Aggregates many (key -> per-device shards) in one compiled XLA call.
+
+    Strategy (mirrors the reference kvstore's fusion-buffer batching, but
+    as a compiled collective instead of server RPCs):
+      1. ravel each key's shard and concatenate per device slot into one
+         flat fusion buffer (one cached-jit dispatch per device);
+      2. assemble the n_dev buffers into a global (n_dev, total) array
+         sharded over a 1-axis Mesh of those devices;
+      3. jitted sum over the sharded axis with replicated out_shardings —
+         XLA inserts the all-reduce — and split/reshape back per key,
+         all inside the same compiled computation.
+
+    Compiled callables are cached per (devices, dtype, shapes) signature.
+    """
+
+    def __init__(self):
+        self._reduce_cache = {}
+        self._flatten_cache = {}
+        self._lock = threading.Lock()
+
+    def _flatten_fn(self, shapes, dtype):
+        key = (shapes, dtype)
+        fn = self._flatten_cache.get(key)
+        if fn is None:
+            def flatten(vals):
+                return jnp.concatenate([v.ravel().astype(dtype) for v in vals])
+            fn = jax.jit(flatten)
+            with self._lock:
+                self._flatten_cache[key] = fn
+        return fn
+
+    def _reduce_fn(self, devs, shapes, dtype):
+        key = (devs, shapes, dtype)
+        hit = self._reduce_cache.get(key)
+        if hit is None:
+            mesh = Mesh(np.array(devs), ("kv",))
+            sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+            offs = np.cumsum([0] + sizes)
+
+            def reduce_split(stacked):
+                flat = stacked.sum(axis=0)
+                return tuple(
+                    flat[offs[i]:offs[i + 1]].reshape(shapes[i])
+                    for i in range(len(shapes)))
+
+            fn = jax.jit(
+                reduce_split,
+                out_shardings=tuple(NamedSharding(mesh, P())
+                                    for _ in shapes))
+            with self._lock:
+                self._reduce_cache[key] = (fn, mesh)
+            return fn, mesh
+        return hit
+
+    def __call__(self, values_per_key):
+        """values_per_key: list over keys of lists of jax.Array shards
+        (equal length n_dev, consistent device order). Returns list of
+        aggregated jax.Array, one per key."""
+        n_dev = len(values_per_key[0])
+        if n_dev == 1:
+            return [v[0] for v in values_per_key]
+        dev_slots = [tuple(sorted(v.devices(), key=lambda d: d.id))[0]
+                     for v in values_per_key[0]]
+        distinct = len(set(dev_slots)) == n_dev
+        if not distinct:
+            # shared-device shards (e.g. emulated workers on one chip): one
+            # fused compiled tree-sum. Coalesce stragglers onto slot 0's
+            # device first — jit refuses mixed committed devices.
+            if len(set(dev_slots)) > 1:
+                dev0 = dev_slots[0]
+                values_per_key = [
+                    [v if dev0 in v.devices() else jax.device_put(v, dev0)
+                     for v in vals]
+                    for vals in values_per_key]
+            return _tree_sum(values_per_key)
+
+        shapes = tuple(tuple(v[0].shape) for v in values_per_key)
+        dtype = jnp.result_type(*[v[0].dtype for v in values_per_key])
+        flatten = self._flatten_fn(shapes, dtype)
+        bufs = []
+        for slot in range(n_dev):
+            bufs.append(flatten([v[slot] for v in values_per_key]))
+        total = bufs[0].shape[0]
+        devs = tuple(dev_slots)
+        fn, mesh = self._reduce_fn(devs, shapes, dtype)
+        sharding = NamedSharding(mesh, P("kv"))
+        stacked = jax.make_array_from_single_device_arrays(
+            (n_dev, total), sharding,
+            [jax.device_put(b, d)[None] for b, d in zip(bufs, devs)])
+        return list(fn(stacked))
+
+
+# --------------------------------------------------------------------------
+# Gradient compression (parity: src/kvstore/gradient_compression.cc)
+# --------------------------------------------------------------------------
+
+@jax.jit
+def _compress_2bit(grad, residual, threshold):
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0)
+                  ).astype(grad.dtype)
+    return q, acc - q
 
 
 class KVStore:
@@ -29,6 +172,10 @@ class KVStore:
         self._optimizer = None
         self._states = {}
         self._is_dist = kv_type.startswith("dist")
+        self._is_async = kv_type == "dist_async"
+        self._compression = None
+        self._residuals = {}
+        self._allreduce = _BucketedAllReduce()
 
     # -- topology ---------------------------------------------------------
     @property
@@ -47,31 +194,68 @@ class KVStore:
             return
         self._store[key] = value.copy() if isinstance(value, NDArray) else NDArray(value)
 
-    def _aggregate(self, values):
-        """Sum per-device NDArrays; in dist_* mode additionally allreduce
-        across processes (the reference's ps-lite/NCCL leg — here an XLA
-        collective over hosts)."""
-        if isinstance(values, NDArray):
-            total = values._data
-        elif len(values) == 1:
-            total = values[0]._data
-        else:
-            dev0 = next(iter(values[0]._data.devices()))
-            total = values[0]._data
-            for v in values[1:]:
-                total = total + jax.device_put(v._data, dev0)
+    def _compress(self, values):
+        """Apply gradient compression per device slot with error-feedback
+        residuals, before aggregation (the 'wire' stage of the reference)."""
+        if self._compression is None:
+            return values
+        ctype = self._compression["type"]
+        if ctype == "fp16":
+            return [[v.astype(jnp.float16).astype(v.dtype) for v in vals]
+                    for key_i, vals in values]
+        threshold = float(self._compression.get("threshold", 0.5))
+        out = []
+        for key_i, vals in values:
+            cvals = []
+            for slot, v in enumerate(vals):
+                rkey = (key_i, slot)
+                r = self._residuals.get(rkey)
+                if r is None or r.shape != v.shape:
+                    r = jnp.zeros_like(v)
+                q, r = _compress_2bit(v, r, jnp.asarray(threshold, v.dtype))
+                self._residuals[rkey] = r
+                cvals.append(q)
+            out.append(cvals)
+        return out
+
+    def _batch_aggregate(self, keys, values):
+        """Aggregate a batch of keys' multi-device values in one compiled
+        bucketed collective. values: list (per key) of NDArray or list of
+        NDArray. Returns list of aggregated NDArray."""
+        norm = []
+        for v in values:
+            if isinstance(v, NDArray):
+                norm.append([v._data])
+            elif len(v) == 0:
+                raise ValueError("empty value list in kvstore aggregation")
+            else:
+                norm.append([x._data for x in v])
+        n_dev = len(norm[0])
+        if any(len(v) != n_dev for v in norm):
+            # ragged: aggregate each key independently
+            return [self._batch_aggregate([k], [v])[0]
+                    for k, v in zip(keys, values)]
+        if self._compression is not None and n_dev > 1:
+            norm = self._compress(list(zip(keys, norm)))
+        aggs = self._allreduce(norm)
         if self._is_dist and jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(total)
-            total = jnp.sum(gathered, axis=0)
-        return NDArray(total)
+            aggs = [jnp.sum(multihost_utils.process_allgather(a), axis=0)
+                    for a in aggs]
+        return [NDArray(a) for a in aggs]
+
+    def _aggregate(self, values, key=None):
+        return self._batch_aggregate([key], [values])[0]
 
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
-            for k, v in zip(key, value):
-                self.push(k, v, priority)
+            aggs = self._batch_aggregate(key, value)
+            for k, a in zip(key, aggs):
+                self._apply_push(k, a)
             return
-        agg = self._aggregate(value)
+        self._apply_push(key, self._aggregate(value, key))
+
+    def _apply_push(self, key, agg):
         if self._optimizer is not None:
             weight = self._store[key]
             if key not in self._states:
@@ -96,12 +280,19 @@ class KVStore:
             src.copyto(o)
 
     def pushpull(self, key, value, out=None, priority=0):
-        """Fused allreduce (parity: kv.pushpull in dist_sync_device)."""
+        """Fused allreduce (parity: kv.pushpull in dist_sync_device).
+        List-form calls aggregate ALL keys in one compiled bucketed
+        collective — the efficient path Trainer uses."""
         if isinstance(key, (list, tuple)):
-            for i, k in enumerate(key):
-                self.pushpull(k, value[i], None if out is None else out[i], priority)
+            aggs = self._batch_aggregate(key, value)
+            if out is None:
+                return aggs
+            for a, o in zip(aggs, out):
+                outs = o if isinstance(o, (list, tuple)) else [o]
+                for oo in outs:
+                    a.copyto(oo)
             return
-        agg = self._aggregate(value)
+        agg = self._aggregate(value, key)
         if out is None:
             return agg
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -109,7 +300,29 @@ class KVStore:
             agg.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        self.pull(key, out, priority)
+        """Pull only the requested rows (parity: reference row_sparse_pull,
+        python/mxnet/kvstore.py). `row_ids` selects rows of the stored
+        value; result rows appear at their row_id positions (other rows
+        zero), matching the reference's RowSparseNDArray densified view."""
+        if row_ids is None:
+            self.pull(key, out, priority)
+            return
+        if isinstance(key, (list, tuple)):
+            rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(key)
+            for k, o, r in zip(key, out, rids):
+                self.row_sparse_pull(k, o, priority, r)
+            return
+        src = self._store[key]
+        ids = row_ids._data if isinstance(row_ids, NDArray) else jnp.asarray(row_ids)
+        ids_np = np.unique(np.asarray(ids).astype(np.int64).ravel())
+        rows = jnp.take(src._data, jnp.asarray(ids_np), axis=0)
+        if out is None:
+            from ..ndarray import sparse as _sparse
+            return _sparse.RowSparseNDArray(rows, ids_np, src.shape)
+        dense = jnp.zeros_like(src._data).at[jnp.asarray(ids_np)].set(rows)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            NDArray(dense).copyto(o)
 
     # -- server-side optimizer --------------------------------------------
     def set_optimizer(self, optimizer):
@@ -120,13 +333,17 @@ class KVStore:
         return capability in ("optimizer",)
 
     def set_gradient_compression(self, compression_params):
-        # XLA collectives over ICI make 2-bit compression unnecessary at the
-        # bandwidths TPU interconnect provides; accepted for API parity.
-        self._compression = compression_params
+        ctype = (compression_params or {}).get("type")
+        if ctype not in ("2bit", "fp16"):
+            raise ValueError(
+                f"unsupported gradient compression type {ctype!r}: "
+                "supported are '2bit' (error-feedback sign quantization, "
+                "parity: src/kvstore/gradient_compression.cc) and 'fp16'")
+        self._compression = dict(compression_params)
+        self._residuals = {}
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         import pickle
-        import numpy as np
         blob = {k: jax.tree_util.tree_map(lambda a: np.asarray(a), v)
                 for k, v in self._states.items()}
         with open(fname, "wb") as f:

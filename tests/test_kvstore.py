@@ -1,0 +1,176 @@
+"""KVStore: bucketed compiled collectives, compression, row_sparse_pull.
+
+Parity model: python/mxnet/kvstore.py + src/kvstore/kvstore_dist.h
+(dist_sync_device semantics on the 8-virtual-device CPU mesh).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+import jax
+import jax.numpy as jnp
+
+
+def _nd(x):
+    return nd.array(np.asarray(x, np.float32))
+
+
+def test_local_pushpull_scalar_key():
+    kv = mx.kv.create("local")
+    kv.init(3, _nd(np.ones((2, 3))))
+    vals = [_nd(np.full((2, 3), i, np.float32)) for i in range(1, 5)]
+    out = _nd(np.zeros((2, 3)))
+    kv.pushpull(3, vals, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 10.0))
+
+
+def test_push_pull_accumulate():
+    kv = mx.kv.create("device")
+    kv.init("w", _nd(np.zeros((4,))))
+    kv.push("w", _nd(np.arange(4)))
+    out = _nd(np.zeros((4,)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.arange(4))
+
+
+def test_batched_pushpull_50_keys_multidevice():
+    """50 params, shards on 8 distinct devices → one bucketed compiled
+    collective; result equals the per-key sum and is replicated."""
+    devs = jax.devices()
+    n_dev = min(8, len(devs))
+    kv = mx.kv.create("dist_sync_device")
+    rng = np.random.RandomState(0)
+    keys = [f"p{i}" for i in range(50)]
+    shapes = [(3, 5), (7,), (2, 2, 2), (11,), (4, 3)] * 10
+    per_key = []
+    expected = []
+    for shp in shapes:
+        shards_np = [rng.randn(*shp).astype(np.float32) for _ in range(n_dev)]
+        expected.append(np.sum(shards_np, axis=0))
+        shards = [nd.NDArray(jax.device_put(jnp.asarray(s), devs[d]))
+                  for d, s in enumerate(shards_np)]
+        per_key.append(shards)
+    outs = [_nd(np.zeros(shp)) for shp in shapes]
+    kv.pushpull(keys, per_key, out=outs)
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(o.asnumpy(), e, rtol=1e-5, atol=1e-5)
+    # the reduce computation was compiled once for the whole batch
+    assert len(kv._allreduce._reduce_cache) == 1
+    # repeat with new values: cache hit, still correct
+    kv.pushpull(keys, per_key, out=outs)
+    assert len(kv._allreduce._reduce_cache) == 1
+
+
+def test_same_device_shards_tree_sum():
+    kv = mx.kv.create("device")
+    vals = [[_nd(np.full((3,), i + j)) for j in range(4)] for i in range(2)]
+    aggs = kv.pushpull(["a", "b"], vals)
+    np.testing.assert_allclose(aggs[0].asnumpy(), np.full((3,), 0 + 1 + 2 + 3))
+    np.testing.assert_allclose(aggs[1].asnumpy(), np.full((3,), 1 + 2 + 3 + 4))
+
+
+def test_server_side_optimizer():
+    kv = mx.kv.create("local")
+    kv.init("w", _nd(np.ones((4,))))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push("w", _nd(np.ones((4,))))
+    out = _nd(np.zeros((4,)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), 0.5))
+
+
+def test_gradient_compression_2bit_error_feedback():
+    kv = mx.kv.create("dist_sync_device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    devs = jax.devices()
+    g0 = np.array([0.3, -0.7, 0.9, 0.0], np.float32)
+    g1 = np.array([0.3, 0.1, -0.2, 0.6], np.float32)
+    shards = [nd.NDArray(jax.device_put(jnp.asarray(g), devs[i]))
+              for i, g in enumerate([g0, g1])]
+    agg = kv.pushpull(["g"], [shards])[0].asnumpy()
+    # each shard quantized to {-.5, 0, .5}: q0=[0,-.5,.5,0], q1=[0,0,0,.5]
+    np.testing.assert_allclose(agg, [0.0, -0.5, 0.5, 0.5])
+    # residuals carry the quantization error for the next round
+    r0 = np.asarray(kv._residuals[("g", 0)])
+    np.testing.assert_allclose(r0, [0.3, -0.2, 0.4, 0.0], atol=1e-6)
+    # second push: residual + grad crosses threshold where it should
+    agg2 = kv.pushpull(["g"], [shards])[0].asnumpy()
+    # shard0 acc = g0 + r0 = [.6, -.9, 1.3, 0] → q=[.5,-.5,.5,0]
+    # shard1 acc = g1 + r1 = [.6, .2, -.4, 1.2] → q=[.5,0,0,.5]
+    np.testing.assert_allclose(agg2, [1.0, -0.5, 0.5, 0.5])
+
+
+def test_gradient_compression_fp16():
+    kv = mx.kv.create("dist_sync_device")
+    kv.set_gradient_compression({"type": "fp16"})
+    devs = jax.devices()
+    g = np.array([1.0001, 2.0], np.float32)
+    shards = [nd.NDArray(jax.device_put(jnp.asarray(g), devs[i]))
+              for i in range(2)]
+    agg = kv.pushpull(["g"], [shards])[0].asnumpy()
+    expected = 2 * g.astype(np.float16).astype(np.float32)
+    np.testing.assert_allclose(agg, expected)
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = mx.kv.create("dist_sync_device")
+    with pytest.raises(ValueError, match="unsupported gradient compression"):
+        kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_row_sparse_pull_selected_rows():
+    kv = mx.kv.create("local")
+    w = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("emb", _nd(w))
+    out = _nd(np.zeros((5, 4)))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(np.array([1, 3])))
+    expected = np.zeros((5, 4), np.float32)
+    expected[[1, 3]] = w[[1, 3]]
+    np.testing.assert_allclose(out.asnumpy(), expected)
+
+
+def test_dist_async_equals_sync_single_host():
+    """Single-process: dist_async update stream is program order, so results
+    are bit-identical to dist_sync (see kvstore module docstring)."""
+    results = {}
+    for mode in ("dist_sync", "dist_async"):
+        kv = mx.kv.create(mode)
+        kv.init("w", _nd(np.ones((3,))))
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+        for step in range(3):
+            kv.push("w", _nd(np.full((3,), step + 1.0)))
+        out = _nd(np.zeros((3,)))
+        kv.pull("w", out=out)
+        results[mode] = out.asnumpy()
+    np.testing.assert_array_equal(results["dist_sync"], results["dist_async"])
+
+
+def test_trainer_batched_allreduce_matches_manual(monkeypatch):
+    """Trainer.allreduce_grads routes ALL params through one list-form
+    pushpull (one bucketed collective)."""
+    from incubator_mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    x = _nd(np.random.RandomState(0).randn(2, 3))
+    with mx.autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.0}, kvstore="dist_sync")
+    calls = []
+    orig = tr._kvstore.pushpull
+
+    def spy(key, value, out=None, priority=0):
+        calls.append(key)
+        return orig(key, value, out=out, priority=priority)
+
+    monkeypatch.setattr(tr._kvstore, "pushpull", spy)
+    monkeypatch.setattr(type(tr._kvstore), "num_workers",
+                        property(lambda self: 2), raising=False)
+    tr.step(2)
+    assert len(calls) == 1 and isinstance(calls[0], list)
