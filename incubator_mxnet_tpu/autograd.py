@@ -317,6 +317,27 @@ def _grad_impl(heads, head_grads, variables, create_graph):
 
 
 def _accumulate_leaf(leaf, g):
+    from .ndarray import sparse as _sparse
+    if isinstance(g, _sparse.RowSparseNDArray):
+        # sparse embedding gradient: 'write' stores the RowSparse object
+        # itself (the whole point — optimizers take the lazy-row path);
+        # 'add' over an existing buffer merges sparsely or densifies.
+        if leaf._grad_req == "add" and leaf._grad is not None:
+            if isinstance(leaf._grad, _sparse.RowSparseNDArray):
+                leaf._grad = leaf._grad + g
+            else:
+                leaf._grad._data = (leaf._grad._data
+                                    + g.todense()._data.astype(leaf._grad._data.dtype))
+        else:
+            leaf._grad = g
+        return
+    if isinstance(leaf._grad, _sparse.RowSparseNDArray):
+        # dense grad arriving over a sparse buffer from a previous step
+        if leaf._grad_req == "add":
+            from . import ndarray as _nd
+            leaf._grad = _nd.NDArray(leaf._grad.todense()._data + g._data)
+            return
+        leaf._grad = None  # fall through to dense write below
     if leaf._grad_req == "add" and leaf._grad is not None:
         leaf._grad._data = (leaf._grad._data + g._data).astype(leaf._grad._data.dtype)
     else:  # 'write'

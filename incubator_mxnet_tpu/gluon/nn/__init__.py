@@ -228,11 +228,13 @@ class Embedding(HybridBlock):
         super().__init__(prefix, params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = self.params.get("weight", shape=(input_dim, output_dim),
                                       dtype=dtype, init=weight_initializer)
 
     def forward(self, x):
-        return nd.embedding(x, self.weight.data())
+        return nd.embedding(x, self.weight.data(),
+                            sparse_grad=self._sparse_grad)
 
 
 # ---------------------------------------------------------------------------

@@ -903,10 +903,40 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
 
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
-    """Parity: nd.Embedding — lookup rows of `weight` by integer `data`."""
+    """Parity: nd.Embedding — lookup rows of `weight` by integer `data`.
+
+    sparse_grad=True makes the weight's gradient a RowSparseNDArray holding
+    only the looked-up rows (parity: Embedding(sparse_grad=True) →
+    RowSparse grad, python/mxnet/ndarray/sparse.py). Eager-mode feature;
+    inside a traced/hybridized graph it falls back to dense (XLA needs
+    static shapes, and the fused step's scatter-add is already optimal)."""
     data = _as_nd(data)
+    if sparse_grad and not isinstance(data._data, jax.core.Tracer):
+        return _sparse_embedding(data, weight)
     return _apply(lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
                   [data, weight], name="embedding")
+
+
+def _sparse_embedding(data, weight):
+    class _SparseEmbedding(autograd.Function):
+        def forward(self, d, w):
+            self.save_for_backward(d)
+            self._wshape = tuple(w.shape)
+            return NDArray(jnp.take(w._data, d._data.astype(jnp.int32), axis=0))
+
+        def backward(self, dy):
+            from . import sparse as _sp
+            (d,) = self._saved
+            ids = np.asarray(d._data).astype(np.int64).ravel()
+            uids, pos = np.unique(ids, return_inverse=True)
+            dim = dy._data.shape[-1]
+            vals = jax.ops.segment_sum(dy._data.reshape(-1, dim),
+                                       jnp.asarray(pos),
+                                       num_segments=len(uids))
+            return (NDArray(jnp.zeros_like(d._data)),
+                    _sp.RowSparseNDArray(vals, uids, self._wshape))
+
+    return _SparseEmbedding()(data, weight)
 
 
 Embedding = embedding
@@ -1068,3 +1098,14 @@ block_grad = stop_gradient
 
 from . import random  # noqa: E402  (registers nd.random namespace)
 from .random import shuffle  # noqa: E402
+from . import sparse  # noqa: E402  (registers nd.sparse namespace)
+
+
+def Custom(*args, op_type=None, **kwargs):
+    """mx.nd.Custom (parity: python/mxnet/operator.py eager path): run a
+    registered CustomOp on concrete arrays; its backward is recorded on
+    the autograd tape."""
+    from .. import operator as _operator
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    return _operator.eager_custom(list(args), dict(kwargs, op_type=op_type))

@@ -1,0 +1,294 @@
+"""Sparse NDArray (parity: python/mxnet/ndarray/sparse.py +
+src/operator/tensor/cast_storage-inl.h).
+
+Two storage types, as in the reference:
+
+* ``RowSparseNDArray`` — (indices, data) where ``indices`` are the ids of
+  the non-zero ROWS (sorted, unique) and ``data`` stacks those rows. The
+  workhorse for sparse embedding gradients and ``kv.row_sparse_pull``.
+* ``CSRNDArray`` — classic (data, indices, indptr) compressed rows, for
+  sparse input features and ``sparse.dot``.
+
+TPU-first design notes: XLA has no dynamic sparse layouts, so every
+*operation* here is a static-shape computation over the materialized
+(nnz,…) buffers — ``take``/``segment_sum`` on the MXU-friendly dense
+carriers, jit-compatible once nnz is fixed. Only *construction* from a
+dense array (``cast_storage``) inspects values on the host: that mirrors
+the reference, where cast_storage is likewise a data-dependent kernel and
+never sits in a jitted hot loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import NDArray, _as_nd
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "cast_storage", "retain", "dot",
+    "zeros", "array",
+]
+
+
+class BaseSparseNDArray:
+    stype = "undefined"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._shape} "
+                f"dtype={self.dtype} nnz={self.nnz}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows `indices` of an abstract dense (N, ...) array, stacked in `data`
+    of shape (nnz_rows, ...). Parity: mx.nd.sparse.RowSparseNDArray."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self._data = jnp.asarray(data)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        if self._data.ndim != len(self._shape):
+            raise ValueError(
+                f"row_sparse data ndim {self._data.ndim} must match "
+                f"shape ndim {len(self._shape)}")
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def nnz(self):
+        return int(self.indices.shape[0])
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        if self.nnz:
+            dense = dense.at[self.indices].set(self._data)
+        return NDArray(dense)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self._data.astype(dtype), self.indices,
+                                self._shape)
+
+    def copy(self):
+        return RowSparseNDArray(self._data, self.indices, self._shape)
+
+    def retain(self, row_ids):
+        """Keep only rows whose id is in `row_ids` (parity:
+        sparse.retain)."""
+        ids = _row_ids_np(row_ids)
+        mine = np.asarray(self.indices)
+        keep = np.isin(mine, ids)
+        sel = np.nonzero(keep)[0]
+        return RowSparseNDArray(jnp.take(self._data, jnp.asarray(sel), axis=0),
+                                mine[sel], self._shape)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            if other._shape != self._shape:
+                raise ValueError("shape mismatch in row_sparse add")
+            ids = np.concatenate([np.asarray(self.indices),
+                                  np.asarray(other.indices)])
+            uids, pos = np.unique(ids, return_inverse=True)
+            vals = jnp.concatenate([self._data, other._data], axis=0)
+            merged = jax.ops.segment_sum(vals, jnp.asarray(pos),
+                                         num_segments=len(uids))
+            return RowSparseNDArray(merged, uids, self._shape)
+        if isinstance(other, NDArray):
+            return self.todense() + other
+        return NotImplemented
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        return RowSparseNDArray(self._data * scalar, self.indices, self._shape)
+
+    __rmul__ = __mul__
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (parity: mx.nd.sparse.CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self._data = jnp.asarray(data)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("CSR requires a 2-D shape")
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def nnz(self):
+        return int(self._data.shape[0])
+
+    def _row_of_nnz(self):
+        counts = np.diff(np.asarray(self.indptr))
+        return jnp.asarray(np.repeat(np.arange(self._shape[0]), counts))
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        if self.nnz:
+            rows = self._row_of_nnz()
+            dense = dense.at[rows, self.indices].set(self._data)
+        return NDArray(dense)
+
+    def astype(self, dtype):
+        return CSRNDArray(self._data.astype(dtype), self.indices,
+                          self.indptr, self._shape)
+
+    def copy(self):
+        return CSRNDArray(self._data, self.indices, self.indptr, self._shape)
+
+
+# ---------------------------------------------------------------------------
+# construction / conversion
+# ---------------------------------------------------------------------------
+
+def _row_ids_np(row_ids):
+    if isinstance(row_ids, NDArray):
+        return np.asarray(row_ids._data).astype(np.int64).ravel()
+    return np.asarray(row_ids).astype(np.int64).ravel()
+
+
+def row_sparse_array(arg, shape=None, dtype=None) -> RowSparseNDArray:
+    """row_sparse_array((data, indices), shape) or from a dense source."""
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        indices = _row_ids_np(indices)
+        order = np.argsort(indices)
+        if not np.all(order == np.arange(len(order))):
+            indices = indices[order]
+            data = jnp.take(data, jnp.asarray(order), axis=0)
+        if shape is None:
+            raise ValueError("shape required for (data, indices) form")
+        return RowSparseNDArray(data, indices, shape)
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    dense = arg if isinstance(arg, NDArray) else NDArray(jnp.asarray(arg))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg, shape=None, dtype=None) -> CSRNDArray:
+    """csr_matrix((data, indices, indptr), shape) or from a dense source."""
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        data = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        if shape is None:
+            raise ValueError("shape required for (data, indices, indptr) form")
+        return CSRNDArray(data, indices, indptr, shape)
+    if isinstance(arg, CSRNDArray):
+        return arg
+    dense = arg if isinstance(arg, NDArray) else NDArray(jnp.asarray(arg))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr, stype):
+    """Parity: mx.nd.sparse.cast_storage / src/operator/tensor/cast_storage.
+    Dense→sparse inspects values on the host (data-dependent nnz, like the
+    reference kernel); sparse→dense is a device scatter."""
+    if isinstance(arr, BaseSparseNDArray):
+        if stype == "default":
+            return arr.todense()
+        return arr.tostype(stype)
+    arr = _as_nd(arr)
+    if stype == "default":
+        return arr
+    host = np.asarray(arr._data)
+    if stype == "row_sparse":
+        nz = np.nonzero(host.reshape(host.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(jnp.take(arr._data, jnp.asarray(nz), axis=0),
+                                nz, host.shape)
+    if stype == "csr":
+        if host.ndim != 2:
+            raise ValueError("csr cast requires 2-D input")
+        rows, cols = np.nonzero(host)
+        indptr = np.zeros(host.shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        return CSRNDArray(host[rows, cols], cols, indptr, host.shape)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+def retain(rsp: RowSparseNDArray, row_ids):
+    return rsp.retain(row_ids)
+
+
+def dot(lhs, rhs, transpose_a=False) -> NDArray:
+    """sparse.dot: csr @ dense (and csr.T @ dense), the reference's two
+    supported layouts. Static-nnz segment-sum → jit/MXU friendly."""
+    if not isinstance(lhs, CSRNDArray):
+        raise TypeError("sparse.dot expects a CSRNDArray lhs")
+    rhs = _as_nd(rhs)
+    rows = lhs._row_of_nnz()
+    gathered = jnp.take(rhs._data, lhs.indices, axis=0)  # (nnz, K)
+    contrib = lhs._data[:, None] * gathered
+    if transpose_a:
+        out = jax.ops.segment_sum(contrib, lhs.indices,
+                                  num_segments=lhs._shape[1])
+    else:
+        out = jax.ops.segment_sum(contrib, rows,
+                                  num_segments=lhs._shape[0])
+    return NDArray(out)
+
+
+def zeros(stype, shape, dtype="float32"):
+    if stype == "row_sparse":
+        tail = tuple(shape[1:])
+        return RowSparseNDArray(jnp.zeros((0,) + tail, dtype),
+                                np.zeros((0,), np.int64), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), np.zeros((0,), np.int64),
+                          np.zeros(shape[0] + 1, np.int64), shape)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+def array(source, stype="row_sparse", dtype=None):
+    if stype == "row_sparse":
+        return row_sparse_array(source, dtype=dtype)
+    if stype == "csr":
+        return csr_matrix(source, dtype=dtype)
+    raise ValueError(f"unknown storage type {stype!r}")

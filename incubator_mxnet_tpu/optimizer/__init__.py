@@ -76,10 +76,14 @@ class Optimizer:
     def _update(self, weight, grad, state, lr, wd, t):
         raise NotImplementedError
 
-    def update_step(self, weight, grad, state, lr, wd, t, rescale=None, clip=None):
+    def update_step(self, weight, grad, state, lr, wd, t, rescale=None,
+                    clip=None, skip=None):
         """Pure entry incl. rescale/clip/multi-precision — safe inside jit.
         rescale/clip are runtime args so a jitted wrapper must pass them as
-        tracers (Trainer.step changes rescale_grad with the batch size)."""
+        tracers (Trainer.step changes rescale_grad with the batch size).
+        `skip` is an on-device bool (AMP found-inf): when True the update is
+        a select back to the old weight/state — the step stays one
+        unconditionally-dispatched XLA computation, no host branch."""
         rescale = self.rescale_grad if rescale is None else rescale
         grad = grad.astype(jnp.float32) * rescale
         clip = self.clip_gradient if clip is None else clip
@@ -88,46 +92,62 @@ class Optimizer:
         if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
             master, inner = state[0], state[1:]
             new_master, new_inner = self._update(master, grad, inner, lr, wd, t)
-            return new_master.astype(weight.dtype), (new_master,) + tuple(new_inner)
-        new_w, new_state = self._update(weight.astype(jnp.float32), grad, state,
-                                        lr, wd, t)
-        return new_w.astype(weight.dtype), new_state
+            new_w, new_state = (new_master.astype(weight.dtype),
+                                (new_master,) + tuple(new_inner))
+        else:
+            new_w, new_state = self._update(weight.astype(jnp.float32), grad,
+                                            state, lr, wd, t)
+            new_w = new_w.astype(weight.dtype)
+        if skip is not None:
+            new_w = jnp.where(skip, weight, new_w)
+            new_state = jax.tree_util.tree_map(
+                lambda ns, os: jnp.where(skip, os, ns), new_state, state)
+        return new_w, new_state
 
     # -- eager path (Trainer / KVStore server-side update) ----------------
-    def update(self, index, weight: NDArray, grad: NDArray, state):
+    def update(self, index, weight: NDArray, grad: NDArray, state, skip=None):
+        from ..ndarray import sparse as _sparse
+        if isinstance(grad, _sparse.RowSparseNDArray):
+            return self._update_sparse(index, weight, grad, state, skip=skip)
         self._update_count(index)
         lr, wd = self._get_lr_wd(index)
         t = self._index_update_count[index]
         has_clip = self.clip_gradient is not None
+        has_skip = skip is not None
         key = (weight.shape, str(weight._data.dtype), bool(self.multi_precision),
-               has_clip)
+               has_clip, has_skip)
         fn = self._jit_cache.get(key)
         if fn is None:
-            if has_clip:
-                fn = jax.jit(lambda w, g, s, lr_, wd_, t_, rs_, cl_:
-                             self.update_step(w, g, s, lr_, wd_, t_, rs_, cl_))
-            else:
-                fn = jax.jit(lambda w, g, s, lr_, wd_, t_, rs_:
-                             self.update_step(w, g, s, lr_, wd_, t_, rs_))
+            # None for cl_/sk_ is pytree-static, so one jitted impl covers
+            # all four arities; the cache key pins the chosen arity.
+            fn = jax.jit(lambda w, g, s, lr_, wd_, t_, rs_, cl_=None, sk_=None:
+                         self.update_step(w, g, s, lr_, wd_, t_, rs_, cl_, sk_))
             self._jit_cache[key] = fn
-        extra = (jnp.float32(self.rescale_grad),)
-        if has_clip:
-            extra += (jnp.float32(self.clip_gradient),)
+        cl = jnp.float32(self.clip_gradient) if has_clip else None
         new_w, new_state = fn(weight._data, grad._data, state,
                               jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
-                              *extra)
+                              jnp.float32(self.rescale_grad), cl, skip)
         weight._data = new_w
         return new_state
 
     def update_multi_precision(self, index, weight, grad, state):
         return self.update(index, weight, grad, state)
 
+    def _update_sparse(self, index, weight, grad, state, skip=None):
+        """RowSparse gradient. Optimizers with no lazy rule densify — the
+        mathematically exact fallback (parity: reference optimizers without
+        a sparse kernel do the same via FallBackStorageType). SGD overrides
+        with the true lazy row update."""
+        return self.update(index, weight, grad.todense(), state, skip=skip)
+
 
 @register("sgd")
 class SGD(Optimizer):
-    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight_raw):
         if self.momentum != 0.0:
@@ -142,10 +162,69 @@ class SGD(Optimizer):
             return w + mom, (mom,)
         return w - lr * g, ()
 
+    def _update_sparse(self, index, weight, grad, state, skip=None):
+        """Lazy row update (parity: sgd_update w/ lazy_update=True,
+        src/operator/optimizer_op.cc): only the rows present in the
+        RowSparse gradient touch weight/momentum — one gather + scatter,
+        jit-cached per (shape, nnz). `skip` (AMP found-inf) selects the old
+        rows back inside the same computation."""
+        if (not self.lazy_update
+                or (self.multi_precision
+                    and weight._data.dtype in (jnp.float16, jnp.bfloat16))):
+            return super()._update_sparse(index, weight, grad, state,
+                                          skip=skip)
+        self._update_count(index)
+        lr, wd = self._get_lr_wd(index)
+        has_mom = self.momentum != 0.0
+        has_clip = self.clip_gradient is not None
+        has_skip = skip is not None
+        key = ("rsp", weight.shape, str(weight._data.dtype), int(grad.nnz),
+               has_mom, has_clip, has_skip)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            momentum = self.momentum
+
+            def sparse_step(w, mom, rows, g, lr_, wd_, rs_, cl_, sk_):
+                g32 = g.astype(jnp.float32) * rs_
+                if cl_ is not None:
+                    g32 = jnp.clip(g32, -cl_, cl_)
+                w_rows = jnp.take(w, rows, axis=0).astype(jnp.float32)
+                g32 = g32 + wd_ * w_rows
+                if mom is not None:
+                    m_rows = jnp.take(mom, rows, axis=0)
+                    new_m_rows = momentum * m_rows - lr_ * g32
+                    new_rows = w_rows + new_m_rows
+                    if sk_ is not None:
+                        new_m_rows = jnp.where(sk_, m_rows, new_m_rows)
+                    mom = mom.at[rows].set(new_m_rows)
+                else:
+                    new_rows = w_rows - lr_ * g32
+                if sk_ is not None:
+                    new_rows = jnp.where(sk_, w_rows, new_rows)
+                w = w.at[rows].set(new_rows.astype(w.dtype))
+                return w, mom
+
+            fn = jax.jit(sparse_step)
+            self._jit_cache[key] = fn
+        mom = state[0] if has_mom else None
+        cl = jnp.float32(self.clip_gradient) if has_clip else None
+        new_w, new_mom = fn(weight._data, mom,
+                            grad.indices.astype(jnp.int32), grad._data,
+                            jnp.float32(lr), jnp.float32(wd),
+                            jnp.float32(self.rescale_grad), cl, skip)
+        weight._data = new_w
+        return (new_mom,) if has_mom else ()
+
 
 @register("nag")
 class NAG(SGD):
     """Nesterov accelerated SGD (parity: mx.optimizer.NAG)."""
+
+    def _update_sparse(self, index, weight, grad, state, skip=None):
+        # SGD's hand-written lazy sparse_step hardcodes plain-momentum
+        # math; NAG must densify through its own _update rule instead
+        return Optimizer._update_sparse(self, index, weight, grad, state,
+                                        skip=skip)
 
     def _update(self, w, g, state, lr, wd, t):
         g = g + wd * w
@@ -160,9 +239,12 @@ class NAG(SGD):
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (parity: mx.optimizer.SGLD)."""
 
-    def update(self, index, weight, grad, state):
+    def update(self, index, weight, grad, state, skip=None):
         # bypass the jit cache: a traced PRNG key would freeze the noise
         from ..ndarray import random as ndrandom
+        from ..ndarray import sparse as _sparse
+        if isinstance(grad, _sparse.RowSparseNDArray):
+            grad = grad.todense()
         self._update_count(index)
         lr, wd = self._get_lr_wd(index)
         g = grad._data.astype(jnp.float32) * self.rescale_grad
@@ -171,7 +253,10 @@ class SGLD(Optimizer):
         g = g + wd * weight._data.astype(jnp.float32)
         noise = jax.random.normal(ndrandom._key(), weight.shape, jnp.float32)
         new_w = weight._data.astype(jnp.float32) - lr / 2 * g + jnp.sqrt(lr) * noise
-        weight._data = new_w.astype(weight._data.dtype)
+        new_w = new_w.astype(weight._data.dtype)
+        if skip is not None:
+            new_w = jnp.where(skip, weight._data, new_w)
+        weight._data = new_w
         return state
 
 
