@@ -1,0 +1,236 @@
+"""Custom operator registration (parity: python/mxnet/operator.py —
+CustomOp / CustomOpProp / mx.operator.register, usable from both
+`mx.nd.Custom` and `mx.sym.Custom`).
+
+Reference semantics: a custom op is arbitrary Python running on the
+engine's CPU worker threads, with explicit `forward`/`backward` writing
+results through `assign` per the `req` mode. TPU-native realisation:
+
+* eager (`nd.Custom`): the user op runs directly on concrete NDArrays and
+  is recorded on the autograd tape as a custom-vjp node (the user's
+  `backward` supplies input cotangents);
+* compiled (`sym.Custom` inside a jitted Executor): the op body becomes a
+  `jax.pure_callback` — XLA calls back onto the host exactly where the
+  reference dispatches to its Python worker — wrapped in `jax.custom_vjp`
+  so the user's `backward` runs (also as a callback) during grad. Shapes
+  and dtypes come from the prop's `infer_shape`/`infer_type`, so the
+  surrounding XLA computation stays statically shaped.
+
+The op body itself is host Python (that is the contract of the reference
+API — use pallas / jax ops for device-speed custom kernels instead); the
+framework guarantees correctness, not MXU throughput, for this surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+
+class CustomOp:
+    """Base class for user ops (parity: mx.operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write `src` into `dst` honoring the req mode."""
+        from .ndarray import NDArray
+        if req in (None, "null"):
+            return
+        src_data = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        if req == "add":
+            dst._data = dst._data + src_data.astype(dst._data.dtype)
+        else:  # 'write' / 'inplace'
+            dst._data = src_data.astype(dst._data.dtype)
+
+
+class CustomOpProp:
+    """Base class for op metadata (parity: mx.operator.CustomOpProp).
+
+    Subclasses override list_arguments/list_outputs/infer_shape/
+    create_operator; kwargs passed to register()'d symbols arrive as
+    strings in __init__, as in the reference."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(reg_name):
+    """@mx.operator.register("my_op") above a CustomOpProp subclass."""
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return wrap
+
+
+def get(reg_name) -> type:
+    if reg_name not in _REGISTRY:
+        raise KeyError(f"no custom op registered as {reg_name!r}; "
+                       f"available: {sorted(_REGISTRY)}")
+    return _REGISTRY[reg_name]
+
+
+# ---------------------------------------------------------------------------
+# shared execution helpers
+# ---------------------------------------------------------------------------
+
+def _make_prop(op_type, attrs):
+    prop_cls = get(op_type)
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    return prop_cls(**kwargs)
+
+
+def _infer(prop, in_shapes, in_dtypes):
+    shp = prop.infer_shape([list(s) for s in in_shapes])
+    in_s, out_s = shp[0], shp[1]
+    aux_s = shp[2] if len(shp) > 2 else []
+    if aux_s:
+        raise NotImplementedError(
+            "custom ops with auxiliary states are not supported yet; "
+            "model aux as explicit inputs")
+    _, out_t, _ = prop.infer_type(list(in_dtypes))
+    return ([tuple(s) for s in out_s], out_t)
+
+
+def _host_forward(prop, attrs, is_train, raw_inputs, out_shapes, out_dtypes):
+    """Run the user's forward on host arrays; returns tuple of np arrays."""
+    from .ndarray import NDArray
+    op = prop.create_operator(None, [a.shape for a in raw_inputs],
+                              [a.dtype for a in raw_inputs])
+    in_data = [NDArray(jnp.asarray(a)) for a in raw_inputs]
+    out_data = [NDArray(jnp.zeros(s, d))
+                for s, d in zip(out_shapes, out_dtypes)]
+    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, [])
+    return tuple(np.asarray(o._data) for o in out_data)
+
+
+def _host_backward(prop, attrs, raw_out_grads, raw_inputs, raw_outputs):
+    from .ndarray import NDArray
+    op = prop.create_operator(None, [a.shape for a in raw_inputs],
+                              [a.dtype for a in raw_inputs])
+    in_data = [NDArray(jnp.asarray(a)) for a in raw_inputs]
+    out_data = [NDArray(jnp.asarray(a)) for a in raw_outputs]
+    out_grad = [NDArray(jnp.asarray(g)) for g in raw_out_grads]
+    in_grad = [NDArray(jnp.zeros(a.shape, a.dtype)) for a in raw_inputs]
+    op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
+                in_grad, [])
+    return tuple(np.asarray(g._data) for g in in_grad)
+
+
+def custom_sym_fn(rt, a, *raws):
+    """The traced (rt, attrs, *raws) op fn for the symbol executor:
+    pure_callback forward + custom_vjp backward."""
+    prop = _make_prop(a["op_type"], a)
+    in_shapes = [r.shape for r in raws]
+    in_dtypes = [r.dtype for r in raws]
+    out_shapes, out_dtypes = _infer(prop, in_shapes, in_dtypes)
+    result_avals = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                         for s, d in zip(out_shapes, out_dtypes))
+    is_train = bool(rt.is_train)
+    n_in = len(raws)
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(
+            lambda *hs: _host_forward(prop, a, is_train, hs,
+                                      out_shapes, out_dtypes),
+            result_avals, *xs)
+
+    def run_fwd(*xs):
+        ys = run(*xs)
+        return ys, (xs, ys)
+
+    def run_bwd(res, gs):
+        xs, ys = res
+        in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+        n_out = len(ys)
+        return jax.pure_callback(
+            lambda *flat: _host_backward(
+                prop, a, flat[:n_out],
+                flat[n_out:n_out + n_in],
+                flat[n_out + n_in:]),
+            in_avals, *gs, *xs, *ys)
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(*raws)
+    return out if len(out) > 1 else out[0]
+
+
+def custom_n_out(attrs):
+    return len(_make_prop(attrs["op_type"], attrs).list_outputs())
+
+
+def eager_custom(inputs, attrs):
+    """nd.Custom: run the user op on concrete arrays, record the user's
+    backward on the autograd tape."""
+    from . import autograd
+    from .ndarray import NDArray
+
+    op_type = attrs["op_type"]
+    prop = _make_prop(op_type, attrs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    in_dtypes = [x._data.dtype for x in inputs]
+    out_shapes, out_dtypes = _infer(prop, in_shapes, in_dtypes)
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+
+    class _Fn(autograd.Function):
+        def forward(self, *ins):
+            self.save_for_backward(*ins)
+            outs = [NDArray(jnp.zeros(s, d))
+                    for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(autograd.is_training(), ["write"] * len(outs),
+                       list(ins), outs, [])
+            self._outs = outs
+            return outs if len(outs) > 1 else outs[0]
+
+        def backward(self, *ogs):
+            ins = list(self._saved)
+            in_grads = [NDArray(jnp.zeros(x.shape, d))
+                        for x, d in zip(ins, in_dtypes)]
+            op.backward(["write"] * len(in_grads), list(ogs), ins,
+                        self._outs, in_grads, [])
+            return in_grads if len(in_grads) > 1 else in_grads[0]
+
+    return _Fn()(*inputs)

@@ -131,19 +131,13 @@ def smooth_l1(data, scalar=1.0):
     return _apply(lambda x: _raw.smooth_l1(x, scalar), [data], name="smooth_l1")
 
 
-def UpSampling(data, scale=2, sample_type="nearest", layout="NCHW"):
-    import jax.numpy as jnp
-
-    def f(x):
-        if layout == "NCHW":
-            r = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
-        else:
-            r = jnp.repeat(jnp.repeat(x, scale, axis=1), scale, axis=2)
-        return r
-    if sample_type != "nearest":
-        raise NotImplementedError("bilinear UpSampling: use Deconvolution with "
-                                  "Bilinear init (parity with reference usage)")
-    return _apply(f, [data], name="UpSampling")
+def UpSampling(data, scale=2, sample_type="nearest", num_filter=None,
+               layout="NCHW"):
+    """Parity: mx.nd.UpSampling (src/operator/nn/upsampling.cc); `bilinear`
+    is the reference's fixed-weight Deconvolution path (num_filter accepted
+    for API parity; channels are inferred)."""
+    return _apply(lambda x: _raw.upsampling(x, scale, sample_type, layout),
+                  [data], name="UpSampling")
 
 
 def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):

@@ -113,6 +113,50 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
     return y
 
 
+def bilinear_kernel_1d(k, dtype=jnp.float32):
+    """The reference's bilinear deconv filter row (same formula as
+    mx.init.Bilinear / src/operator/nn/upsampling-inl.h)."""
+    import math
+    f = math.ceil(k / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    x = jnp.arange(k, dtype=dtype)
+    return 1 - jnp.abs(x / f - c)
+
+
+def upsampling(x, scale=2, sample_type="nearest", layout="NCHW"):
+    """UpSampling (reference src/operator/nn/upsampling.cc). `nearest` is a
+    repeat; `bilinear` is the reference's fixed-weight Deconvolution
+    (kernel 2s-s%2, stride s, pad ceil((s-1)/2)) realised as ONE depthwise
+    lhs-dilated conv — a single XLA conv the TPU tiles onto the MXU, no
+    per-channel loop."""
+    import math
+    s = int(scale)
+    if sample_type == "nearest":
+        if layout == "NCHW":
+            return jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+        return jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2)
+    if sample_type != "bilinear":
+        raise ValueError(f"unknown UpSampling sample_type {sample_type!r}")
+    k = 2 * s - s % 2
+    pad_deconv = int(math.ceil((s - 1) / 2.0))
+    p = k - 1 - pad_deconv  # deconv pad → lhs-dilated conv pad
+    w1 = bilinear_kernel_1d(k, x.dtype)
+    w2 = jnp.outer(w1, w1)
+    if layout == "NCHW":
+        ch = x.shape[1]
+        kernel = jnp.broadcast_to(w2, (ch, 1, k, k))
+        dn = ("NCHW", "OIHW", "NCHW")
+    elif layout == "NHWC":
+        ch = x.shape[3]
+        kernel = jnp.broadcast_to(w2[:, :, None, None], (k, k, 1, ch))
+        dn = ("NHWC", "HWIO", "NHWC")
+    else:
+        raise ValueError(f"unsupported UpSampling layout {layout}")
+    return lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), (1, 1), [(p, p), (p, p)],
+        lhs_dilation=(s, s), feature_group_count=ch, dimension_numbers=dn)
+
+
 # ---------------------------------------------------------------------------
 # pooling
 # ---------------------------------------------------------------------------

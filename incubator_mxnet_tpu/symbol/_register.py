@@ -607,8 +607,8 @@ register_op(
 
 register_op(
     "UpSampling",
-    lambda rt, a, x: jnp.repeat(jnp.repeat(x, a.get("scale", 2), axis=2),
-                                a.get("scale", 2), axis=3),
+    lambda rt, a, x: _raw.upsampling(x, a.get("scale", 2),
+                                     a.get("sample_type", "nearest")),
     ("data",))
 
 
@@ -677,11 +677,10 @@ def InstanceNorm(data=None, gamma=None, beta=None, eps=1e-3, name=None):
                     name)
 
 
-def UpSampling(data=None, scale=2, sample_type="nearest", name=None):
-    if sample_type != "nearest":
-        raise NotImplementedError("bilinear UpSampling: use Deconvolution "
-                                  "with Bilinear init")
-    return _make_op("UpSampling", [data], _attrs(scale=scale), name)
+def UpSampling(data=None, scale=2, sample_type="nearest", num_filter=None,
+               name=None):
+    return _make_op("UpSampling", [data],
+                    _attrs(scale=scale, sample_type=sample_type), name)
 
 
 def RNN(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
@@ -700,3 +699,23 @@ def RNN(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
 
 for _n in ["InstanceNorm", "UpSampling", "RNN"]:
     setattr(_sym_mod, _n, globals()[_n])
+
+
+# ---------------------------------------------------------------------------
+# Custom operator (parity: mx.sym.Custom / python/mxnet/operator.py)
+# ---------------------------------------------------------------------------
+
+from .. import operator as _operator  # noqa: E402
+
+register_op("Custom", _operator.custom_sym_fn, (),
+            n_out=_operator.custom_n_out)
+
+
+def Custom(*args, op_type=None, name=None, **kwargs):
+    """mx.sym.Custom(data, ..., op_type='my_op', **string_kwargs)."""
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    return _make_op("Custom", list(args), dict(kwargs, op_type=op_type), name)
+
+
+setattr(_sym_mod, "Custom", Custom)

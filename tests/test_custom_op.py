@@ -1,0 +1,116 @@
+"""Custom operator registration (parity: python/mxnet/operator.py —
+the classic Sigmoid CustomOp example from the reference docs, run through
+both the eager nd.Custom path and the compiled sym.Custom executor)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = 1.0 / (1.0 + np.exp(-x))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(gy * y * (1 - y)))
+
+
+@mx.operator.register("test_scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    """Two-output op: (x*2, x+1) — exercises multi-output plumbing."""
+
+    def list_outputs(self):
+        return ["doubled", "plus1"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Scale2()
+
+
+class Scale2(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(x * 2))
+        self.assign(out_data[1], req[1], mx.nd.array(x + 1))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g0 = out_grad[0].asnumpy()
+        g1 = out_grad[1].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(g0 * 2 + g1))
+
+
+def test_nd_custom_forward():
+    x = np.array([-1.0, 0.0, 2.0], np.float32)
+    y = mx.nd.Custom(nd.array(x), op_type="test_sigmoid")
+    np.testing.assert_allclose(y.asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-6)
+
+
+def test_nd_custom_backward():
+    x = np.array([[-1.0, 0.5], [2.0, -0.3]], np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(a, op_type="test_sigmoid")
+        loss = (y * nd.array(np.ones_like(x) * 3.0)).sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(a._grad.asnumpy(), 3.0 * s * (1 - s),
+                               rtol=1e-5)
+
+
+def test_sym_custom_executor_forward_backward():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Custom(data, op_type="test_sigmoid", name="sig")
+    x = np.array([[-2.0, 0.0, 1.0]], np.float32)
+    ex = out.bind(args={"data": nd.array(x)},
+                  args_grad={"data": nd.zeros((1, 3))})
+    (y,) = ex.forward()
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(y.asnumpy(), s, rtol=1e-6)
+    ex.backward(nd.array(np.ones_like(x)))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), s * (1 - s),
+                               rtol=1e-5)
+
+
+def test_nd_custom_multi_output():
+    x = np.array([1.0, 2.0], np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        d, p = mx.nd.Custom(a, op_type="test_scale2")
+        loss = d.sum() + (p * p).sum()
+    loss.backward()
+    np.testing.assert_allclose(d.asnumpy(), x * 2)
+    np.testing.assert_allclose(p.asnumpy(), x + 1)
+    # dloss/dx = 2 + 2*(x+1)
+    np.testing.assert_allclose(a._grad.asnumpy(), 2 + 2 * (x + 1), rtol=1e-6)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(KeyError, match="no custom op registered"):
+        mx.nd.Custom(nd.zeros((2,)), op_type="nope_not_here")

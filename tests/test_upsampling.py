@@ -1,0 +1,106 @@
+"""UpSampling: nearest + bilinear (parity: src/operator/nn/upsampling.cc —
+bilinear = fixed-weight Deconvolution with the mx.init.Bilinear kernel,
+kernel 2s-s%2, stride s, pad ceil((s-1)/2))."""
+import math
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _bilinear_kernel(k):
+    f = math.ceil(k / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    w1 = 1 - np.abs(np.arange(k) / f - c)
+    return np.outer(w1, w1).astype(np.float32)
+
+
+def _ref_bilinear_deconv(x, s):
+    """Independent NumPy transposed-conv reference: kernel 2s-s%2,
+    stride s, pad ceil((s-1)/2), per channel."""
+    n, ch, h, w = x.shape
+    k = 2 * s - s % 2
+    p = int(math.ceil((s - 1) / 2.0))
+    ker = _bilinear_kernel(k)
+    full_h = (h - 1) * s + k
+    full_w = (w - 1) * s + k
+    out = np.zeros((n, ch, full_h, full_w), np.float32)
+    for b in range(n):
+        for cch in range(ch):
+            for i in range(h):
+                for j in range(w):
+                    out[b, cch, i * s:i * s + k, j * s:j * s + k] += (
+                        x[b, cch, i, j] * ker)
+    return out[:, :, p:p + h * s, p:p + w * s]
+
+
+def test_nearest_upsampling():
+    x = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+    y = mx.nd.UpSampling(nd.array(x), scale=3).asnumpy()
+    assert y.shape == (1, 2, 6, 6)
+    np.testing.assert_array_equal(y[0, 0, :3, :3], x[0, 0, 0, 0])
+
+
+def test_bilinear_upsampling_matches_reference_deconv():
+    rng = np.random.RandomState(0)
+    for s in (2, 3):
+        x = rng.randn(2, 3, 4, 5).astype(np.float32)
+        y = mx.nd.UpSampling(nd.array(x), scale=s,
+                             sample_type="bilinear").asnumpy()
+        assert y.shape == (2, 3, 4 * s, 5 * s)
+        np.testing.assert_allclose(y, _ref_bilinear_deconv(x, s),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_upsampling_constant_interior():
+    """A constant input stays constant in the interior (kernel partition of
+    unity away from borders)."""
+    x = np.full((1, 1, 6, 6), 5.0, np.float32)
+    y = mx.nd.UpSampling(nd.array(x), scale=2,
+                         sample_type="bilinear").asnumpy()
+    np.testing.assert_allclose(y[0, 0, 2:-2, 2:-2], 5.0, rtol=1e-6)
+
+
+def test_bilinear_upsampling_nhwc():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 4, 2).astype(np.float32)
+    y = mx.nd.UpSampling(nd.array(x), scale=2, sample_type="bilinear",
+                         layout="NHWC").asnumpy()
+    x_nchw = np.transpose(x, (0, 3, 1, 2))
+    expected = _ref_bilinear_deconv(x_nchw, 2)
+    np.testing.assert_allclose(np.transpose(y, (0, 3, 1, 2)), expected,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_kernel_matches_initializer():
+    """ops kernel == mx.init.Bilinear weights (the reference's documented
+    equivalence: UpSampling bilinear ≡ Deconvolution + Bilinear init)."""
+    from incubator_mxnet_tpu.ops import _raw
+    import jax.numpy as jnp
+    init = mx.init.Bilinear()
+    w = np.asarray(init._init(None, (1, 1, 4, 4), jnp.float32))
+    k = np.asarray(jnp.outer(_raw.bilinear_kernel_1d(4),
+                             _raw.bilinear_kernel_1d(4)))
+    np.testing.assert_allclose(w[0, 0], k, rtol=1e-6)
+
+
+def test_symbol_bilinear_upsampling():
+    data = mx.sym.Variable("data")
+    out = mx.sym.UpSampling(data, scale=2, sample_type="bilinear")
+    x = np.random.RandomState(2).randn(1, 2, 3, 3).astype(np.float32)
+    ex = out.bind(args={"data": nd.array(x)})
+    (y,) = ex.forward()
+    np.testing.assert_allclose(y.asnumpy(), _ref_bilinear_deconv(x, 2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_upsampling_grad():
+    x = nd.array(np.random.RandomState(3).randn(1, 2, 3, 3).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.UpSampling(x, scale=2, sample_type="bilinear")
+        loss = (y * y).sum()
+    loss.backward()
+    g = x._grad.asnumpy()
+    assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
