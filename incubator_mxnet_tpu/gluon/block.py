@@ -107,7 +107,10 @@ class Block:
                                          force_reinit=force_reinit)
 
     def cast(self, dtype):
-        for p in self.collect_params().values():
+        # own params only; the child recursion covers descendants exactly once
+        for p in self._params.values():
+            p.cast(dtype)
+        for p in self._reg_params.values():
             p.cast(dtype)
         for child in self._children.values():
             child.cast(dtype)
@@ -120,13 +123,55 @@ class Block:
         return self
 
     # -- persistence ------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structural names ('features.0.weight'), independent of the
+        global auto-name counters (parity: reference block.py
+        _collect_params_with_prefix — what makes save/load work across
+        processes and across separately-constructed identical nets)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
     def save_parameters(self, filename, deduplicate=False):
-        self.collect_params().save(filename)
+        from ..ndarray import save as nd_save
+        params = self._collect_params_with_prefix()
+        arrays = {}
+        seen = {}
+        for name, p in params.items():
+            if p._data is None:
+                continue
+            if deduplicate and id(p) in seen:
+                continue
+            seen[id(p)] = name
+            arrays[name] = p.data()
+        nd_save(filename, arrays)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False):
-        self.collect_params().load(filename, ctx=ctx, allow_missing=allow_missing,
-                                   ignore_extra=ignore_extra)
+        from ..ndarray import load as nd_load
+        arrays = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        if arrays and not any(k in params for k in arrays):
+            # legacy/name-based file (or symbol checkpoint): fall back to
+            # the full-name ParameterDict path
+            self.collect_params().load(filename, ctx=ctx,
+                                       allow_missing=allow_missing,
+                                       ignore_extra=ignore_extra)
+            return
+        for name, p in params.items():
+            if name in arrays:
+                v = arrays[name]
+                p.set_data(v if ctx is None else v.as_in_context(ctx))
+            elif not allow_missing:
+                raise KeyError(f"Parameter {name} missing from {filename}")
+        if not ignore_extra:
+            extra = set(arrays) - set(params)
+            if extra:
+                raise KeyError(
+                    f"File {filename} has extra parameters {sorted(extra)}")
 
     # -- execution --------------------------------------------------------
     def __call__(self, *args, **kwargs):

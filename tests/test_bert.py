@@ -61,6 +61,7 @@ def test_bert_hybridize_parity():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_pretrain_loss_decreases():
     mx.random.seed(0)
     np.random.seed(0)

@@ -1,0 +1,121 @@
+"""Convergence smokes (SURVEY.md §4: LeNet→synthetic-MNIST high train acc,
+BERT MLM loss decreasing, SSD loss decreasing). Each smoke is small enough
+to finish in well under a minute on the CPU test backend."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models import get_model
+
+
+def _synthetic_mnist(n_per_class=16, classes=4, seed=0):
+    """Separable image classes: one noisy fixed template per class."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(classes, 28, 28, 1).astype(np.float32)
+    xs, ys = [], []
+    for c in range(classes):
+        noise = rng.randn(n_per_class, 28, 28, 1).astype(np.float32) * 0.3
+        xs.append(templates[c][None] + noise)
+        ys.append(np.full(n_per_class, c, np.int32))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+def test_lenet_synthetic_mnist_convergence():
+    mx.random.seed(0)
+    x_np, y_np = _synthetic_mnist()
+    net = get_model("lenet", classes=4, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    x, y = nd.array(x_np), nd.array(y_np)
+    for _ in range(60):
+        with autograd.record():
+            out = net(x)
+            loss = L(out, y)
+        loss.backward()
+        tr.step(x.shape[0])
+    pred = net(x).asnumpy().argmax(axis=1)
+    acc = (pred == y_np).mean()
+    assert acc > 0.95, f"LeNet train acc {acc:.3f} <= 0.95"
+
+
+def test_bert_mlm_loss_decreases():
+    from incubator_mxnet_tpu.models.bert import (
+        BERTModel, BERTForPretrain, BERTPretrainLoss)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    V, B, T, M = 32, 8, 16, 4
+    bert = BERTModel(num_layers=1, units=32, hidden_size=64, num_heads=4,
+                     max_length=T, vocab_size=V, dropout=0.0,
+                     token_type_vocab_size=2, use_pooler=True)
+    model = BERTForPretrain(bert, vocab_size=V)
+    model.initialize(init=mx.init.Normal(0.02))
+    model.hybridize()
+    L = BERTPretrainLoss()
+    tr = gluon.Trainer(model.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    ids = nd.array(rng.randint(0, V, (B, T)))
+    types = nd.zeros((B, T))
+    vlen = nd.array(np.full(B, T, np.int32))
+    pos = nd.array(np.stack([rng.choice(T, M, replace=False)
+                             for _ in range(B)]))
+    mlm_label = nd.array(rng.randint(0, V, (B, M)))
+    nsp_label = nd.array(rng.randint(0, 2, B))
+    losses = []
+    for _ in range(50):
+        with autograd.record():
+            mlm, nsp = model(ids, types, vlen, pos)
+            loss = L(mlm, nsp, mlm_label, nsp_label)
+        loss.backward()
+        tr.step(B)
+        losses.append(float(loss.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # overall downward trend, not a lucky endpoint
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.5
+
+
+def test_ssd_loss_decreases():
+    from incubator_mxnet_tpu.models.ssd import SSD, SSDLoss
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    backbone = gluon.nn.HybridSequential()
+    backbone.add(gluon.nn.Conv2D(16, 3, strides=2, padding=1, layout="NHWC",
+                                 activation="relu"),
+                 gluon.nn.Conv2D(32, 3, strides=2, padding=1, layout="NHWC",
+                                 activation="relu"))
+    net = SSD(backbone, num_classes=2,
+              sizes=[[0.2, 0.3], [0.5, 0.6]], ratios=[[1, 2]] * 2,
+              extra_channels=(64,), layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    L = SSDLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    B = 4
+    x = nd.array(rng.rand(B, 24, 24, 3).astype(np.float32))
+    # one gt box per image
+    label = np.zeros((B, 1, 5), np.float32)
+    for b in range(B):
+        x0, y0 = rng.rand(2) * 0.4
+        label[b, 0] = [rng.randint(0, 2), x0, y0, x0 + 0.4, y0 + 0.4]
+    label = nd.array(label)
+    # with hard-negative mining off the targets depend only on anchors and
+    # labels — compute once outside the loop (keeps the smoke fast)
+    with autograd.pause():
+        anchor0, cls_pred0, _ = net(x)
+        bt, bm, ct = net.targets(anchor0, cls_pred0, label,
+                                 negative_mining_ratio=-1)
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            anchor, cls_pred, box_pred = net(x)
+            loss = L(cls_pred, box_pred, ct, bt, bm)
+        loss.backward()
+        tr.step(B)
+        losses.append(float(loss.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

@@ -50,6 +50,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_grad_matches_dense(self):
         mesh = make_mesh({"sp": 4})
         rng = np.random.RandomState(2)
@@ -128,6 +129,7 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grad_flows(self):
         mesh = make_mesh({"pp": 4})
         rng = np.random.RandomState(2)
@@ -163,6 +165,7 @@ class TestPipeline:
 # ---------------------------------------------------------------------------
 
 class TestMoE:
+    @pytest.mark.slow
     def test_top1_routes_to_best_expert(self):
         # gate that deterministically prefers expert = token % E
         e, d = 4, 8
@@ -236,6 +239,7 @@ class TestBERTRingAttention:
         seq_r = net_r(nd.array(ids)).asnumpy()
         np.testing.assert_allclose(seq_r, seq_d, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_ring_bert_trains_fused(self):
         mesh = make_mesh({"sp": 8})
         net = self._build((mesh, "sp"))
@@ -269,6 +273,7 @@ class TestBERTRingAttention:
 # ---------------------------------------------------------------------------
 
 class TestTensorParallel:
+    @pytest.mark.slow
     def test_bert_tp_dp_step_matches_single(self):
         """FusedTrainStep on a dp×tp mesh == single-device step (same math,
         XLA inserts the Megatron collectives)."""

@@ -212,6 +212,7 @@ def test_ssd_forward_shapes(ssd_net):
     assert box_pred.shape == (2, A * 4)
 
 
+@pytest.mark.slow
 def test_ssd_train_step_decreases_loss(ssd_net):
     net = ssd_net
     L = SSDLoss()
