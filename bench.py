@@ -38,25 +38,66 @@ from incubator_mxnet_tpu.parallel import FusedTrainStep  # noqa: E402
 V100_BASELINE_IMG_S = 390.0  # MXNet ResNet-50 fp32, single V100 (published)
 
 
-def acquire_backend(attempts=6, first_delay=3.0):
-    """Backend init through the axon relay is occasionally UNAVAILABLE
-    (transient tunnel/contention); retry with backoff before giving up so
-    one flake doesn't forfeit the round's perf number."""
+class _PhaseTimeout(Exception):
+    pass
+
+
+class _phase_deadline:
+    """SIGALRM watchdog: the axon tunnel can HANG (not error) on init, and
+    a silent hang eats the driver's whole bench budget with no JSON line.
+    Convert hangs into exceptions the retry/error paths can handle."""
+
+    def __init__(self, seconds, what):
+        self.seconds = int(seconds)
+        self.what = what
+
+    def __enter__(self):
+        import signal
+
+        def handler(signum, frame):
+            raise _PhaseTimeout(f"{self.what} exceeded {self.seconds}s")
+
+        self._old = signal.signal(signal.SIGALRM, handler)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        return False
+
+
+def _log(msg):
+    print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr,
+          flush=True)
+
+
+def acquire_backend(attempts=4, first_delay=3.0,
+                    per_attempt_timeout=180):
+    """Backend init through the axon relay is occasionally UNAVAILABLE or
+    simply unresponsive (transient tunnel/contention); retry with backoff —
+    and a per-attempt watchdog — before giving up, so one flake doesn't
+    forfeit the round's perf number."""
     delay = first_delay
     last = None
     for i in range(attempts):
         try:
-            devs = jax.devices()
-            # force a real device computation, not just backend discovery
-            import jax.numpy as jnp
-            jnp.zeros((2, 2)).block_until_ready()
-            return devs
+            with _phase_deadline(per_attempt_timeout, "backend init"):
+                _log(f"backend attempt {i + 1}/{attempts}")
+                devs = jax.devices()
+                # force a real device computation, not just discovery
+                import jax.numpy as jnp
+                jnp.zeros((2, 2)).block_until_ready()
+                _log(f"backend ready: {devs[0]}")
+                return devs
         except Exception as e:  # noqa: BLE001
             last = e
-            print(f"bench: backend attempt {i + 1}/{attempts} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            time.sleep(delay)
-            delay = min(delay * 2, 60.0)
+            _log(f"backend attempt {i + 1}/{attempts} failed: "
+                 f"{type(e).__name__}: {e}")
+            if i < attempts - 1:
+                time.sleep(delay)
+                delay = min(delay * 2, 60.0)
     raise RuntimeError(f"backend unavailable after {attempts} attempts: {last}")
 
 
@@ -87,8 +128,13 @@ def main():
     # compile + warmup. NOTE: through the axon relay block_until_ready() does
     # not synchronize; a host value fetch is the only true barrier. Steps
     # chain through updated params, so fetching the final loss times them all.
+    _log("compiling fused train step (first call)")
+    with _phase_deadline(int(os.environ.get("BENCH_COMPILE_TIMEOUT", "2400")),
+                         "train step compile"):
+        float(step(x, y))
+    _log("compile done; warmup")
     float(step(x, y))
-    float(step(x, y))
+    _log(f"timing {steps} steps @ batch {batch} {dtype}")
 
     t0 = time.time()
     for _ in range(steps):

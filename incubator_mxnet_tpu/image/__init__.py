@@ -59,8 +59,7 @@ def imdecode(buf, flag=1, to_rgb=True):
     if isinstance(buf, NDArray):
         buf = buf.asnumpy().tobytes()
     img = Image.open(_io.BytesIO(bytes(buf)))
-    img = img.convert("L") if flag == 0 else img.convert(
-        "RGB" if to_rgb else "RGB")
+    img = img.convert("L" if flag == 0 else "RGB")
     arr = np.asarray(img, dtype=np.uint8)
     if not to_rgb and flag != 0:
         arr = arr[..., ::-1]  # reference BGR default when to_rgb=False
@@ -73,6 +72,25 @@ def imread(filename, flag=1, to_rgb=True):
     """Read an image file -> HWC uint8 NDArray (reference mx.image.imread)."""
     with open(filename, "rb") as f:
         return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def idx_path_for(path_imgrec):
+    """The reference's .rec → .idx naming convention (one place)."""
+    return (path_imgrec[:-4] + ".idx" if path_imgrec.endswith(".rec")
+            else path_imgrec + ".idx")
+
+
+def finalize_image(img, auglist, hw):
+    """Shared tail of the sample pipeline: augment → float32 → fix any
+    augmenter that left the wrong spatial size (reference iterators resize
+    as a last resort). Returns HWC float32 at exactly (h, w)."""
+    for aug in auglist:
+        img = aug(img)
+    img = _as_np(img).astype(np.float32, copy=False)
+    h, w = hw
+    if img.shape[:2] != (h, w):
+        img = _pil_resize(img.astype(np.uint8), w, h, 2).astype(np.float32)
+    return img
 
 
 def _pil_resize(arr, w, h, interp):
@@ -471,9 +489,8 @@ class ImageIter:
         self._samples = None
         if path_imgrec is not None:
             from ..recordio import MXIndexedRecordIO
-            idx_path = path_imgrec[:-4] + ".idx" \
-                if path_imgrec.endswith(".rec") else path_imgrec + ".idx"
-            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._rec = MXIndexedRecordIO(idx_path_for(path_imgrec),
+                                          path_imgrec, "r")
             self._order = list(self._rec.keys) if self._rec.keys else None
             if self._order is None:
                 raise ValueError(f"no index found for {path_imgrec}")
@@ -531,10 +548,8 @@ class ImageIter:
         return label, img
 
     def _augment(self, img):
-        out = img
-        for aug in self.auglist:
-            out = aug(out)
-        return _as_np(out)
+        c, h, w = self.data_shape
+        return finalize_image(img, self.auglist, (h, w))
 
     def next(self):
         if self._cursor >= len(self._order):
@@ -555,9 +570,7 @@ class ImageIter:
         for n, i in enumerate(idx):
             lab, img = self.read_sample(i)
             img = self._augment(img)
-            if img.shape[:2] != (h, w):
-                img = _pil_resize(img.astype(np.uint8), w, h, 2)
-            data[n] = np.transpose(img, (2, 0, 1)).astype(np.float32)
+            data[n] = np.transpose(img, (2, 0, 1))
             label[n] = lab[:self.label_width]
         lab_out = label[:, 0] if self.label_width == 1 else label
         return DataBatch([nd.array(data)], [nd.array(lab_out)], pad=pad,

@@ -225,7 +225,8 @@ class ImageRecordIter(DataIter):
                  aug_list=None, data_name="data",
                  label_name="softmax_label", round_batch=True, **kwargs):
         super().__init__(batch_size)
-        from ..image import CreateAugmenter, imdecode, _pil_resize
+        from ..image import CreateAugmenter, imdecode, finalize_image, \
+            idx_path_for
         from ..recordio import MXIndexedRecordIO, unpack
 
         if layout not in ("NCHW", "NHWC"):
@@ -239,9 +240,8 @@ class ImageRecordIter(DataIter):
         self._prefetch = max(1, prefetch_buffer)
         self.data_name, self.label_name = data_name, label_name
 
-        idx_path = path_imgrec[:-4] + ".idx" if path_imgrec.endswith(".rec") \
-            else path_imgrec + ".idx"
-        self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+        self._rec = MXIndexedRecordIO(idx_path_for(path_imgrec),
+                                      path_imgrec, "r")
         if not self._rec.keys:
             raise ValueError(f"no .idx index found for {path_imgrec}; "
                              "ImageRecordIter needs random access")
@@ -255,8 +255,8 @@ class ImageRecordIter(DataIter):
                 mean=mean if mean.any() else None,
                 std=std if (std != 1.0).any() else None)
         self._auglist = aug_list
-        self._unpack, self._imdecode, self._pil_resize = \
-            unpack, imdecode, _pil_resize
+        self._unpack, self._imdecode, self._finalize = \
+            unpack, imdecode, finalize_image
         self._lock = __import__("threading").Lock()
         self._gen = None
         self.reset()
@@ -286,14 +286,8 @@ class ImageRecordIter(DataIter):
         header, img_bytes = self._unpack(payload)
         label = np.atleast_1d(np.asarray(header.label, np.float32))
         img = self._imdecode(img_bytes).asnumpy()
-        for aug in self._auglist:
-            img = aug(img)
-        img = np.asarray(img, np.float32) if not isinstance(img, np.ndarray) \
-            else img.astype(np.float32, copy=False)
         c, h, w = self.data_shape
-        if img.shape[:2] != (h, w):
-            img = self._pil_resize(img.astype(np.uint8), w, h, 2)\
-                .astype(np.float32)
+        img = self._finalize(img, self._auglist, (h, w))
         if self._layout == "NCHW":
             img = np.transpose(img, (2, 0, 1))
         return img, label[:self.label_width]

@@ -255,6 +255,7 @@ class TokenQueue {
 
     // blocks while full; returns false if closed
     bool push(uint64_t tok) {
+        UserGuard g(this);
         std::unique_lock<std::mutex> lk(mu_);
         cv_push_.wait(lk, [&] { return closed_ || q_.size() < cap_; });
         if (closed_) return false;
@@ -265,6 +266,7 @@ class TokenQueue {
 
     // blocks while empty; returns false if closed and drained
     bool pop(uint64_t* tok) {
+        UserGuard g(this);
         std::unique_lock<std::mutex> lk(mu_);
         cv_pop_.wait(lk, [&] { return closed_ || !q_.empty(); });
         if (q_.empty()) return false;
@@ -282,16 +284,35 @@ class TokenQueue {
     }
 
     size_t size() {
+        UserGuard g(this);
         std::unique_lock<std::mutex> lk(mu_);
         return q_.size();
     }
 
+    // Safe teardown: a producer thread can still be inside push() (woken by
+    // close(), about to return) when the consumer drops the queue. Deleting
+    // then is a use-after-free. close + spin until no thread is inside.
+    void drain_users() {
+        close();
+        while (users_.load(std::memory_order_acquire) > 0)
+            std::this_thread::yield();
+    }
+
   private:
+    struct UserGuard {
+        explicit UserGuard(TokenQueue* q) : q_(q) {
+            q_->users_.fetch_add(1, std::memory_order_acq_rel);
+        }
+        ~UserGuard() { q_->users_.fetch_sub(1, std::memory_order_acq_rel); }
+        TokenQueue* q_;
+    };
+
     std::mutex mu_;
     std::condition_variable cv_push_, cv_pop_;
     std::deque<uint64_t> q_;
     size_t cap_;
     bool closed_ = false;
+    std::atomic<int> users_{0};
 };
 
 }  // namespace
@@ -331,7 +352,11 @@ void mxtpu_pool_stats(void* p, size_t* used, size_t* pooled) {
 }
 
 void* mxtpu_queue_create(size_t cap) { return new TokenQueue(cap); }
-void mxtpu_queue_destroy(void* q) { delete static_cast<TokenQueue*>(q); }
+void mxtpu_queue_destroy(void* q) {
+    auto* tq = static_cast<TokenQueue*>(q);
+    tq->drain_users();
+    delete tq;
+}
 int mxtpu_queue_push(void* q, uint64_t tok) {
     return static_cast<TokenQueue*>(q)->push(tok) ? 1 : 0;
 }
