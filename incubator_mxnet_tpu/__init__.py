@@ -31,6 +31,10 @@ from . import module as mod
 from . import metric
 from . import io
 from . import operator
+from . import callback
+from . import visualization
+from . import visualization as viz
+from . import distributed
 from . import recordio
 from . import image
 from . import amp

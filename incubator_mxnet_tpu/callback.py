@@ -1,0 +1,106 @@
+"""Training callbacks (parity: python/mxnet/callback.py): Speedometer,
+do_checkpoint, LogValidationMetricsCallback, ProgressBar — the classic
+Module.fit hooks."""
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
+           "log_train_metric", "ProgressBar", "LogValidationMetricsCallback"]
+
+
+class Speedometer:
+    """Logs throughput (samples/sec) and metrics every `frequent` batches
+    (parity: mx.callback.Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        if param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
+            metrics = "\t".join(f"{n}={v:.6f}" for n, v in name_value)
+            logging.info(msg, param.epoch, count, speed, metrics)
+        else:
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
+        self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving `prefix-NNNN.params` + symbol json every
+    `period` epochs (parity: mx.callback.do_checkpoint)."""
+    from .module import save_checkpoint
+    period = max(1, int(period))
+
+    def _callback(epoch, sym, arg_params, aux_params):
+        if (epoch + 1) % period == 0:
+            save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
+
+    return _callback
+
+
+module_checkpoint = do_checkpoint
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the metric every `period` batches."""
+
+    def _callback(param):
+        if param.nbatch % max(1, period) == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            metrics = "\t".join(f"{n}={v:.6f}" for n, v in name_value)
+            logging.info("Iter[%d] Batch[%d] Train-%s",
+                         param.epoch, param.nbatch, metrics)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    """Text progress bar over batches (parity: mx.callback.ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.total = max(1, total)
+        self.bar_len = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.bar_len * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        sys.stdout.write(f"[{bar}] {pct}%\r")
+        sys.stdout.flush()
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end eval callback logging each validation metric."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -1,8 +1,10 @@
 """gluon: the imperative/hybrid high-level API (parity: python/mxnet/gluon)."""
 from . import data, loss, nn, rnn
+from . import contrib
+from . import model_zoo
 from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict
 from .trainer import Trainer
 
 __all__ = ["Block", "HybridBlock", "Parameter", "ParameterDict", "Constant",
-           "Trainer", "nn", "loss", "rnn", "data"]
+           "Trainer", "nn", "loss", "rnn", "data", "contrib", "model_zoo"]

@@ -1,0 +1,3 @@
+"""gluon.contrib (parity: python/mxnet/gluon/contrib/) — the extras the
+reference ships outside the core layer set."""
+from . import nn  # noqa: F401

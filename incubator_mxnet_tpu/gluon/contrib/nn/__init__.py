@@ -1,0 +1,85 @@
+"""gluon.contrib.nn (parity: python/mxnet/gluon/contrib/nn/basic_layers.py):
+SyncBatchNorm, HybridConcurrent, Concurrent, Identity, SparseEmbedding.
+"""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ...nn import BatchNorm, Embedding, HybridSequential
+from ...block import HybridBlock
+
+__all__ = ["SyncBatchNorm", "HybridConcurrent", "Concurrent", "Identity",
+           "SparseEmbedding"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (parity:
+    gluon.contrib.nn.SyncBatchNorm, reference key=/num_devices API).
+
+    TPU-native semantics: the reference needs an explicit NCCL allreduce of
+    the batch statistics because each GPU sees only its slice. Under this
+    framework's compiled mesh path (pjit over a `Mesh` — FusedTrainStep,
+    dryrun_multichip) arrays are GLOBAL-view: `mean(x, axis=0)` inside the
+    jitted step is already the global-batch mean, and XLA inserts the
+    all-reduce over the data-parallel axis itself. So synchronized stats
+    are the DEFAULT here, not an extra kernel — this class exists for API
+    parity and asserts nothing extra is needed. (Per-device-view code
+    paths — shard_map kernels — must psum stats explicitly; none of the
+    shipped layers compute BN inside shard_map.)
+
+    `num_devices`/`key` are accepted and ignored, matching call sites
+    written for the reference.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", key=None, **kwargs):
+        super().__init__(axis=kwargs.pop("axis", 1), momentum=momentum,
+                         epsilon=epsilon, center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class HybridConcurrent(HybridSequential):
+    """Runs each child on the SAME input and concatenates the outputs along
+    `axis` (parity: contrib.nn.HybridConcurrent — Inception-style blocks)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._concat_axis = axis
+
+    def forward(self, x):
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self._concat_axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (the reference distinguishes Block vs HybridBlock;
+    both compile here)."""
+
+
+class Identity(HybridBlock):
+    """Passthrough (parity: contrib.nn.Identity — residual plumbing)."""
+
+    def forward(self, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row_sparse gradient (parity: contrib.nn.SparseEmbedding
+    — the reference stores the weight itself row_sparse for ps-lite; here
+    the weight is dense-on-HBM and the GRADIENT is RowSparse, which is the
+    part that matters for the optimizer's lazy row update)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)

@@ -1,0 +1,7 @@
+"""gluon.model_zoo (parity: python/mxnet/gluon/model_zoo/__init__.py) —
+namespace bridge so reference call sites
+(`from mxnet.gluon.model_zoo import vision; vision.get_model(...)`)
+work unchanged. The actual registry lives in incubator_mxnet_tpu.models."""
+from . import vision  # noqa: F401
+
+get_model = vision.get_model

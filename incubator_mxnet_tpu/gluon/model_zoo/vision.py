@@ -1,0 +1,32 @@
+"""gluon.model_zoo.vision (parity: python/mxnet/gluon/model_zoo/vision/):
+re-exports the vision model registry under the reference's namespace.
+
+No pretrained-weight download here (zero-egress TPU pods); `pretrained=True`
+raises with a pointer to `load_parameters` on a local checkpoint, which is
+how reference users on air-gapped clusters work anyway.
+"""
+from ...models import (  # noqa: F401
+    get_model as _get_model,
+    LeNet, lenet,
+    AlexNet, alexnet,
+    VGG, get_vgg, vgg11, vgg13, vgg16, vgg19,
+    vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn,
+    get_resnet, resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1,
+    resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2,
+    resnet152_v2,
+    MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75, mobilenet0_5,
+    mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5,
+    mobilenet_v2_0_25,
+    SqueezeNet, squeezenet1_0, squeezenet1_1,
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    Inception3, inception_v3,
+)
+
+
+def get_model(name, pretrained=False, classes=1000, **kwargs):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled (no model download in this "
+            "environment); build the model and load_parameters() from a "
+            "local checkpoint instead")
+    return _get_model(name, classes=classes, **kwargs)

@@ -1,0 +1,84 @@
+"""Network visualization (parity: python/mxnet/visualization.py).
+
+print_summary walks a Symbol graph and prints the reference's layer table
+(name, output shape, params, previous layers). plot_network requires
+graphviz, which is not in this image — it raises with instructions, rather
+than silently producing nothing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary of a Symbol graph (parity:
+    mx.viz.print_summary). `shape`: dict of input name -> shape, needed for
+    per-layer output shapes and param counts."""
+    from .symbol import Symbol
+    if not isinstance(symbol, Symbol):
+        raise TypeError("print_summary expects a Symbol")
+    shape = shape or {}
+    shapes = {}
+    if shape:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shapes[name] = s
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line += str(f)
+            line = line[:positions[i] - 1].ljust(positions[i])
+        print(line)
+
+    print("=" * line_length)
+    print_row(headers)
+    print("=" * line_length)
+
+    from .symbol import _topo
+    nodes = _topo(symbol._entries)
+
+    total_params = 0
+    # output shapes per node, via eval_shape on the whole graph
+    out_shape_by_name = {}
+    if shape:
+        try:
+            for name, s in zip(symbol.list_outputs(), out_shapes):
+                out_shape_by_name[name] = s
+        except Exception:
+            pass
+
+    for node in nodes:
+        if node.op is None:
+            continue  # variables are inputs, not layers
+        prevs = []
+        n_params = 0
+        for (pnode, _pi) in node.inputs:
+            if pnode.op is None and pnode.name not in shape \
+                    and shapes.get(pnode.name) is not None:
+                # a learned argument (weight/bias/...), not a data input
+                n_params += int(np.prod(shapes[pnode.name]))
+            else:
+                prevs.append(pnode.name)
+        total_params += n_params
+        oshape = out_shape_by_name.get(node.name + "_output", "")
+        print_row([f"{node.name} ({node.op})", oshape, n_params,
+                   ", ".join(prevs[:3])])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("=" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    raise ImportError(
+        "plot_network needs graphviz, which is not available in this "
+        "image; use print_summary(symbol, shape) for a text summary")

@@ -1,0 +1,142 @@
+"""gluon.contrib, gluon.model_zoo namespace, mx.callback, mx.visualization,
+mx.distributed (parity: python/mxnet/gluon/contrib, gluon/model_zoo,
+callback.py, visualization.py, the launcher topology env)."""
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+# -- contrib.nn -------------------------------------------------------------
+
+def test_sync_batchnorm_is_global_under_mesh():
+    """Under the compiled mesh path arrays are global-view, so BatchNorm
+    statistics are already cross-device — SyncBatchNorm == BatchNorm here.
+    Check dp-sharded fused step equals the single-device full-batch step
+    (the property the reference needs an NCCL allreduce for)."""
+    import jax
+    from incubator_mxnet_tpu.parallel import FusedTrainStep, make_mesh
+
+    def build():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, 3, padding=1, layout="NHWC"),
+                gluon.contrib.nn.SyncBatchNorm(axis=-1),
+                gluon.nn.Flatten(), gluon.nn.Dense(3))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 3, 16)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net1 = build()
+    step1 = FusedTrainStep(net1, L, mx.optimizer.create("sgd", learning_rate=0.1))
+    l1 = float(step1(nd.array(x), nd.array(y)))
+
+    net2 = build()
+    mesh = make_mesh({"dp": min(8, len(jax.devices()))})
+    step2 = FusedTrainStep(net2, L, mx.optimizer.create("sgd", learning_rate=0.1),
+                           mesh=mesh)
+    l2 = float(step2(nd.array(x), nd.array(y)))
+    assert abs(l1 - l2) < 1e-4, (l1, l2)
+    w1 = list(net1.collect_params().values())
+    w2 = list(net2.collect_params().values())
+    for p1, p2 in zip(w1, w2):
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_hybrid_concurrent_and_identity():
+    blk = gluon.contrib.nn.HybridConcurrent(axis=-1)
+    blk.add(gluon.nn.Dense(3), gluon.nn.Dense(2),
+            gluon.contrib.nn.Identity())
+    blk.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+    out = blk(x)
+    assert out.shape == (4, 3 + 2 + 5)
+    np.testing.assert_allclose(out.asnumpy()[:, 5:], x.asnumpy(), rtol=1e-6)
+
+
+def test_sparse_embedding_contrib():
+    emb = gluon.contrib.nn.SparseEmbedding(20, 4)
+    emb.initialize()
+    ids = nd.array(np.array([1, 5]))
+    with autograd.record():
+        loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    from incubator_mxnet_tpu.ndarray import sparse
+    assert isinstance(emb.weight.grad(), sparse.RowSparseNDArray)
+
+
+# -- model_zoo namespace ----------------------------------------------------
+
+def test_model_zoo_vision_namespace():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("squeezenet1_1", classes=7)
+    net.initialize()
+    assert net(nd.ones((1, 64, 64, 3))).shape == (1, 7)
+    net2 = vision.resnet18_v1(classes=4)
+    assert net2 is not None
+    with pytest.raises(ValueError, match="pretrained"):
+        vision.get_model("resnet18_v1", pretrained=True)
+
+
+# -- callbacks --------------------------------------------------------------
+
+class _Param:
+    def __init__(self, epoch, nbatch, metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = metric
+
+
+def test_speedometer_logs(caplog):
+    m = mx.metric.Accuracy()
+    m.update(nd.array(np.array([0, 1])), nd.array(np.array([[0.9, 0.1],
+                                                            [0.2, 0.8]])))
+    sp = mx.callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for nb in range(5):
+            sp(_Param(0, nb, m))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_saves(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    cb = mx.callback.do_checkpoint(str(tmp_path / "model"), period=1)
+    arg = {"fc_weight": nd.ones((3, 4)), "fc_bias": nd.zeros((3,))}
+    cb(0, out, arg, {})
+    assert (tmp_path / "model-0001.params").exists()
+    assert (tmp_path / "model-symbol.json").exists()
+
+
+# -- visualization ----------------------------------------------------------
+
+def test_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="act1")
+    out = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    total = mx.viz.print_summary(out, shape={"data": (1, 4)})
+    printed = capsys.readouterr().out
+    assert "fc1" in printed and "fc2" in printed
+    # fc1: 4*8+8, fc2: 8*2+2
+    assert total == (4 * 8 + 8) + (8 * 2 + 2)
+    with pytest.raises(ImportError, match="graphviz"):
+        mx.viz.plot_network(out)
+
+
+# -- distributed ------------------------------------------------------------
+
+def test_distributed_single_host():
+    assert mx.distributed.rank() == 0
+    assert mx.distributed.num_workers() == 1
+    mx.distributed.barrier()            # no-op single process
+    mesh = mx.distributed.global_mesh({"dp": -1})
+    assert mesh.devices.size == len(mx.distributed.global_devices())
