@@ -891,6 +891,40 @@ def gather_nd(x, indices):
     return _apply(f, [x, indices], name="gather_nd")
 
 
+def scatter_nd(data, indices, shape):
+    """Parity: mx.nd.scatter_nd (src/operator/tensor/indexing_op.cc) —
+    inverse of gather_nd; duplicate indices take the last write (the
+    reference leaves duplicates undefined)."""
+    data = _as_nd(data)
+    indices = _as_nd(indices)
+
+    def f(vals, idx):
+        idx = idx.astype(jnp.int32)
+        m = idx.shape[0]
+        out = jnp.zeros(tuple(shape), vals.dtype)
+        return out.at[tuple(idx[i] for i in range(m))].set(vals)
+    return _apply(f, [data, indices], name="scatter_nd")
+
+
+def batch_take(a, indices):
+    """Parity: mx.nd.batch_take — out[i] = a[i, indices[i]]."""
+    indices = _as_nd(indices)
+
+    def f(x, i):
+        return jnp.take_along_axis(x, i.astype(jnp.int32)[:, None],
+                                   axis=1)[:, 0]
+    return _apply(f, [a, indices], name="batch_take")
+
+
+def reverse(data, axis=0):
+    """Parity: mx.nd.reverse — flip along the given axis/axes."""
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _apply(lambda x: jnp.flip(x, axis=axes), [data], name="reverse")
+
+
+flip = reverse
+
+
 def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
     indices = _as_nd(indices)
 

@@ -20,7 +20,10 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
            "UpSampling", "multihead_attention", "box_iou", "box_nms",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
-           "ROIPooling", "im2col", "SliceChannel"]
+           "ROIPooling", "im2col", "SliceChannel",
+           "SequenceMask", "SequenceLast", "SequenceReverse",
+           "GridGenerator", "BilinearSampler", "SpatialTransformer",
+           "Correlation"]
 
 
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
@@ -171,6 +174,80 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
         return _raw.multihead_attention(qq, kk, vv, num_heads, m, dropout_rate,
                                         key, training, scale, causal)
     return _apply(f, inputs, name="multihead_attention")
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    """Parity: mx.nd.SequenceMask (src/operator/sequence_mask.cc)."""
+    if sequence_length is None:
+        return _apply(lambda x: _raw.sequence_mask(x, None, False, value,
+                                                   axis),
+                      [data], name="SequenceMask")
+    sequence_length = _as_nd(sequence_length)
+    return _apply(lambda x, ln: _raw.sequence_mask(x, ln,
+                                                   use_sequence_length,
+                                                   value, axis),
+                  [data, sequence_length], name="SequenceMask")
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0):
+    """Parity: mx.nd.SequenceLast (src/operator/sequence_last.cc)."""
+    if sequence_length is None:
+        return _apply(lambda x: _raw.sequence_last(x, None, False, axis),
+                      [data], name="SequenceLast")
+    sequence_length = _as_nd(sequence_length)
+    return _apply(lambda x, ln: _raw.sequence_last(x, ln,
+                                                   use_sequence_length, axis),
+                  [data, sequence_length], name="SequenceLast")
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0):
+    """Parity: mx.nd.SequenceReverse (src/operator/sequence_reverse.cc)."""
+    if sequence_length is None:
+        return _apply(lambda x: _raw.sequence_reverse(x, None, False, axis),
+                      [data], name="SequenceReverse")
+    sequence_length = _as_nd(sequence_length)
+    return _apply(lambda x, ln: _raw.sequence_reverse(
+        x, ln, use_sequence_length, axis),
+        [data, sequence_length], name="SequenceReverse")
+
+
+def GridGenerator(data, transform_type="affine", target_shape=None):
+    """Parity: mx.nd.GridGenerator (src/operator/grid_generator.cc)."""
+    return _apply(lambda d: _raw.grid_generator(d, transform_type,
+                                                target_shape),
+                  [data], name="GridGenerator")
+
+
+def BilinearSampler(data, grid):
+    """Parity: mx.nd.BilinearSampler (src/operator/bilinear_sampler.cc)."""
+    return _apply(_raw.bilinear_sampler, [data, grid],
+                  name="BilinearSampler")
+
+
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine", sampler_type="bilinear"):
+    """Parity: mx.nd.SpatialTransformer (src/operator/spatial_transformer.cc)
+    = GridGenerator(loc) + BilinearSampler, fused in one recorded op."""
+    if sampler_type != "bilinear":
+        raise ValueError("only bilinear sampler_type is supported")
+
+    def f(x, theta):
+        grid = _raw.grid_generator(theta, transform_type, target_shape)
+        return _raw.bilinear_sampler(x, grid)
+
+    return _apply(f, [data, loc], name="SpatialTransformer")
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Parity: mx.nd.Correlation (src/operator/correlation.cc, FlowNet)."""
+    return _apply(lambda a, b: _raw.correlation(
+        a, b, kernel_size, max_displacement, stride1, stride2, pad_size,
+        is_multiply),
+        [data1, data2], name="Correlation")
 
 
 # Mirror the op namespace onto mx.nd for reference-style calls, and expose

@@ -113,6 +113,158 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
     return y
 
 
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """GridGenerator (reference src/operator/grid_generator.cc): sampling
+    grid in [-1,1] normalized coords, (N, 2, H, W) with channel 0 = x.
+    affine: data (N,6) row-major 2x3; warp: data = flow (N,2,H,W) added to
+    the identity grid in pixel units."""
+    if transform_type == "affine":
+        h, w = target_shape
+        n = data.shape[0]
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        theta = data.reshape(n, 2, 3)
+        out = theta @ base                                        # (N,2,HW)
+        return out.reshape(n, 2, h, w)
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        ys = jnp.arange(h, dtype=data.dtype)
+        xs = jnp.arange(w, dtype=data.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        x = (data[:, 0] + gx) * (2.0 / jnp.maximum(w - 1, 1)) - 1.0
+        y = (data[:, 1] + gy) * (2.0 / jnp.maximum(h - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def bilinear_sampler(data, grid):
+    """BilinearSampler (reference src/operator/bilinear_sampler.cc): sample
+    NCHW `data` at normalized grid (N,2,Ho,Wo); zero padding outside.
+    One vectorized gather + 4-tap blend — XLA fuses it; no scalar loops."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0     # (N,Ho,Wo) in pixel coords
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(yi, xi):
+        inb = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        # gather per batch: data (N,C,H,W) at (N,Ho,Wo) points
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        vals = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        vals = vals.reshape(n, c, *xi.shape[1:])
+        return vals * inb[:, None].astype(data.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wx = wx[:, None].astype(data.dtype)
+    wy = wy[:, None].astype(data.dtype)
+    return ((1 - wy) * ((1 - wx) * v00 + wx * v01)
+            + wy * ((1 - wx) * v10 + wx * v11))
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Correlation (reference src/operator/correlation.cc, FlowNet):
+    zero-centered displacement grid (radius max_displacement//stride2 in
+    stride2 multiples), k x k patch sum normalized by k*k*C, centers
+    cropped by border = max_displacement + (k-1)//2 from the pad_size-padded
+    map, subsampled by stride1. The displacement loop is static, so it
+    unrolls into one fused XLA computation (no dynamic shapes)."""
+    import math
+    n, c, h, w = data1.shape
+    k = int(kernel_size)
+    d = int(max_displacement)
+    d2r = d // max(1, stride2)
+    offsets = [stride2 * i for i in range(-d2r, d2r + 1)]
+    border = d + (k - 1) // 2
+    h2, w2 = h + 2 * pad_size, w + 2 * pad_size
+    out_h = int(math.ceil((h2 - 2 * border) / float(stride1)))
+    out_w = int(math.ceil((w2 - 2 * border) / float(stride1)))
+    if out_h <= 0 or out_w <= 0:
+        raise ValueError(
+            f"correlation output would be empty: input {h}x{w}, pad "
+            f"{pad_size}, border {border}")
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    # extra d margin on data2 so every shifted slice stays in bounds
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size + d, pad_size + d),
+                         (pad_size + d, pad_size + d)))
+    norm = float(k * k * c)
+    outs = []
+    for dy in offsets:
+        for dx in offsets:
+            shifted = jax.lax.dynamic_slice(
+                p2, (0, 0, d + dy, d + dx), (n, c, h2, w2))
+            prod = ((p1 * shifted) if is_multiply
+                    else jnp.abs(p1 - shifted)).sum(axis=1)  # (N,H2,W2)
+            if k > 1:
+                prod = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "SAME")
+            outs.append(prod / norm)
+    out = jnp.stack(outs, axis=1)        # (N, D2, H2, W2)
+    out = out[:, :, border:border + (out_h - 1) * stride1 + 1:stride1,
+              border:border + (out_w - 1) * stride1 + 1:stride1]
+    return out
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """SequenceMask (reference src/operator/sequence_mask.cc): positions at
+    or beyond each sequence's length (along time `axis`) become `value`."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    t = data.shape[axis]
+    steps = jnp.arange(t)
+    ln = sequence_length.astype(jnp.int32)      # (N,)
+    if axis == 0:
+        mask = steps[:, None] < ln[None, :]     # (T, N)
+    else:
+        mask = steps[None, :] < ln[:, None]     # (N, T)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    """SequenceLast: the last valid element along `axis` per sequence."""
+    t = data.shape[axis]
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, t - 1, axis=axis)
+    ln = jnp.clip(sequence_length.astype(jnp.int32) - 1, 0, t - 1)  # (N,)
+    moved = jnp.moveaxis(data, axis, 0)          # (T, N, ...)
+    idx = ln.reshape((1, -1) + (1,) * (moved.ndim - 2))
+    idx = jnp.broadcast_to(idx, (1,) + moved.shape[1:])
+    return jnp.take_along_axis(moved, idx, axis=0)[0]
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    """SequenceReverse: reverse the first len_n steps of each sequence,
+    leaving padding in place."""
+    t = data.shape[axis]
+    moved = jnp.moveaxis(data, axis, 0)          # (T, N, ...)
+    if not use_sequence_length or sequence_length is None:
+        return jnp.moveaxis(moved[::-1], 0, axis)
+    ln = sequence_length.astype(jnp.int32)       # (N,)
+    steps = jnp.arange(t)[:, None]               # (T,1)
+    src = jnp.where(steps < ln[None, :], ln[None, :] - 1 - steps, steps)
+    src = src.reshape(src.shape + (1,) * (moved.ndim - 2))
+    src = jnp.broadcast_to(src, moved.shape)
+    out = jnp.take_along_axis(moved, src, axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
 def bilinear_kernel_1d(k, dtype=jnp.float32):
     """The reference's bilinear deconv filter row (same formula as
     mx.init.Bilinear / src/operator/nn/upsampling-inl.h)."""

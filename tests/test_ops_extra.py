@@ -1,0 +1,131 @@
+"""Sequence ops, spatial transformer family, Correlation, scatter_nd /
+batch_take / reverse (parity: src/operator/sequence_*.cc,
+grid_generator.cc, bilinear_sampler.cc, spatial_transformer.cc,
+correlation.cc, tensor/indexing_op.cc)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_sequence_mask():
+    data = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)  # (T,N,D)
+    out = mx.nd.SequenceMask(nd.array(data), nd.array(np.array([1, 2, 0])),
+                             use_sequence_length=True, value=-1.0).asnumpy()
+    assert (out[0, 0] == data[0, 0]).all()       # t=0 < len=1
+    assert (out[1, 0] == -1.0).all()             # t=1 >= len=1
+    assert (out[1, 1] == data[1, 1]).all()       # t=1 < len=2
+    assert (out[0, 2] == -1.0).all()             # len=0: all masked
+
+
+def test_sequence_last():
+    data = np.arange(3 * 2 * 2, dtype=np.float32).reshape(3, 2, 2)
+    out = mx.nd.SequenceLast(nd.array(data), nd.array(np.array([2, 3])),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_array_equal(out[0], data[1, 0])   # len 2 -> t=1
+    np.testing.assert_array_equal(out[1], data[2, 1])   # len 3 -> t=2
+    full = mx.nd.SequenceLast(nd.array(data)).asnumpy()
+    np.testing.assert_array_equal(full, data[-1])
+
+
+def test_sequence_reverse():
+    data = np.arange(4 * 2, dtype=np.float32).reshape(4, 2, 1)
+    out = mx.nd.SequenceReverse(nd.array(data), nd.array(np.array([3, 4])),
+                                use_sequence_length=True).asnumpy()
+    # seq 0 (len 3): steps 0..2 reversed, step 3 untouched
+    np.testing.assert_array_equal(out[:, 0, 0], [4, 2, 0, 6])
+    # seq 1 (len 4): fully reversed
+    np.testing.assert_array_equal(out[:, 1, 0], [7, 5, 3, 1])
+
+
+def test_grid_generator_identity_affine():
+    theta = nd.array(np.array([[1.0, 0, 0, 0, 1.0, 0]], np.float32))
+    grid = mx.nd.GridGenerator(theta, "affine", target_shape=(3, 5)).asnumpy()
+    assert grid.shape == (1, 2, 3, 5)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    x = np.random.RandomState(0).randn(2, 3, 4, 6).astype(np.float32)
+    theta = nd.array(np.tile(np.array([[1.0, 0, 0, 0, 1.0, 0]], np.float32),
+                             (2, 1)))
+    grid = mx.nd.GridGenerator(theta, "affine", target_shape=(4, 6))
+    out = mx.nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    """Translation by a full normalized unit in x shifts the image."""
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 1.0
+    # affine with tx shifting sample positions right by one pixel
+    theta = nd.array(np.array([[1.0, 0, 1.0, 0, 1.0, 0]], np.float32))
+    out = mx.nd.SpatialTransformer(nd.array(x), theta,
+                                   target_shape=(3, 3)).asnumpy()
+    # sampling coords shifted +1 in x -> output shifts content left
+    assert out[0, 0, 1, 0] == 1.0
+    assert out[0, 0, 1, 1] == 0.0
+
+
+def test_spatial_transformer_grad_flows():
+    x = nd.array(np.random.RandomState(1).randn(1, 2, 4, 4)
+                 .astype(np.float32))
+    theta = nd.array(np.array([[1.0, 0, 0.1, 0, 1.0, -0.1]], np.float32))
+    theta.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.SpatialTransformer(x, theta, target_shape=(4, 4))
+        loss = (y * y).sum()
+    loss.backward()
+    g = theta._grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_correlation_self_peak():
+    """Correlation of a map with itself peaks at zero displacement; output
+    is cropped by border = max_displacement (reference shape semantics)."""
+    x = np.random.RandomState(2).randn(1, 4, 6, 6).astype(np.float32)
+    out = mx.nd.Correlation(nd.array(x), nd.array(x),
+                            max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 4, 4)
+    center = out[0, 4]          # (dy,dx)=(0,0) of the 3x3 window
+    for k in range(9):
+        if k == 4:
+            continue
+        assert center.mean() >= out[0, k].mean()
+
+
+def test_correlation_kernel_and_pad():
+    """kernel_size patch-sums (normalized by k*k*C) and pad_size restores
+    output size: with k=3, d=1, pad=2 on a 6x6 map, border=2 and the
+    output is 6x6 again; constant inputs give exactly 1.0 everywhere in
+    the interior (partial patches at the crop edge see padding zeros)."""
+    x = np.ones((1, 2, 6, 6), np.float32)
+    out = mx.nd.Correlation(nd.array(x), nd.array(x), kernel_size=3,
+                            max_displacement=1, pad_size=2).asnumpy()
+    assert out.shape == (1, 9, 6, 6)
+    np.testing.assert_allclose(out[0, 4, 2:-2, 2:-2], 1.0, atol=1e-6)
+
+
+def test_scatter_nd_roundtrip():
+    # reference layout: indices (M, N) — one COLUMN per point
+    idx = np.array([[0, 2], [2, 0]])            # points (0,2) and (2,0)
+    vals = np.array([5.0, 7.0], np.float32)
+    out = mx.nd.scatter_nd(nd.array(vals), nd.array(idx),
+                           shape=(3, 4)).asnumpy()
+    expected = np.zeros((3, 4), np.float32)
+    expected[0, 2] = 5.0
+    expected[2, 0] = 7.0
+    np.testing.assert_array_equal(out, expected)
+    back = mx.nd.gather_nd(nd.array(out), nd.array(idx)).asnumpy()
+    np.testing.assert_array_equal(back, vals)
+
+
+def test_batch_take_and_reverse():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = mx.nd.batch_take(nd.array(a), nd.array(np.array([1, 3, 0])))
+    np.testing.assert_array_equal(out.asnumpy(), [1.0, 7.0, 8.0])
+    rev = mx.nd.reverse(nd.array(a), axis=1).asnumpy()
+    np.testing.assert_array_equal(rev, a[:, ::-1])
