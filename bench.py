@@ -42,6 +42,31 @@ class _PhaseTimeout(Exception):
     pass
 
 
+def _arm_hard_watchdog(seconds):
+    """SIGALRM can't interrupt a hang INSIDE a blocking C call (Python only
+    runs signal handlers between bytecodes), and backend-init hangs live in
+    C. A daemon thread with os._exit is the hard deadline: it emits the
+    parseable error JSON line first so the driver records a diagnosis
+    instead of rc=124 with empty output."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "resnet50_imagenet_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "error": f"hard watchdog: bench exceeded {seconds}s "
+                     "(backend or compile hang)",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 class _phase_deadline:
     """SIGALRM watchdog: the axon tunnel can HANG (not error) on init, and
     a silent hang eats the driver's whole bench budget with no JSON line.
@@ -106,6 +131,8 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
+    watchdog = _arm_hard_watchdog(
+        int(os.environ.get("BENCH_HARD_TIMEOUT", "3300")))
     acquire_backend()
     np.random.seed(0)
     mx.random.seed(0)
@@ -148,6 +175,7 @@ def main():
     peak = 197e12 if dtype == "bfloat16" else 99e12  # v5e chip
     mfu = img_s * flops_per_img / peak
 
+    watchdog.cancel()
     print(json.dumps({
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
         "value": round(img_s, 2),

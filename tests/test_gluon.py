@@ -238,6 +238,7 @@ def test_trainer_states_roundtrip(tmp_path):
     assert tr2._optimizer.num_update == tr._optimizer.num_update
 
 
+@pytest.mark.slow
 def test_lenet_convergence():
     np.random.seed(0)
     mx.random.seed(0)

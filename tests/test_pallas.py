@@ -60,6 +60,7 @@ def test_flash_grads(causal):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_flash_causal_cross_length():
     # bottom-right-aligned causal (decode semantics): query row r sees
     # cols <= r + (lk - lq), matching the XLA path's tril(k=lk-lq)

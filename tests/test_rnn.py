@@ -67,6 +67,7 @@ def test_gru_cell_vs_torch():
     np.testing.assert_allclose(_np(h1), th.numpy(), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_cell_unroll_matches_layer():
     T, N, I, H = 5, 3, 4, 6
     cell = rnn.LSTMCell(H)
@@ -185,6 +186,7 @@ def test_variable_length_masking():
     assert not np.allclose(o[1, :3], 0)
 
 
+@pytest.mark.slow
 def test_rnn_backward_flows():
     layer = rnn.GRU(8, num_layers=2, layout="NTC")
     layer.initialize()
@@ -251,6 +253,7 @@ def test_bidirectional_layer_valid_length():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bidirectional_cell_valid_length():
     l, r = rnn.LSTMCell(4), rnn.LSTMCell(4)
     bi = rnn.BidirectionalCell(l, r)
