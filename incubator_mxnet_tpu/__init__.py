@@ -32,6 +32,8 @@ from . import metric
 from . import io
 from . import operator
 from . import callback
+from . import monitor
+from .monitor import Monitor
 from . import visualization
 from . import visualization as viz
 from . import distributed

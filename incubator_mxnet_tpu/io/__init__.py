@@ -14,6 +14,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "BucketSentenceIter", "LibSVMIter",
            "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
@@ -22,13 +23,14 @@ DataDesc.__new__.__defaults__ = (np.float32, "NCHW")
 
 class DataBatch:
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         self.data = data
         self.label = label
         self.pad = pad
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.bucket_key = bucket_key
 
 
 class DataIter:
@@ -456,3 +458,180 @@ class PrefetchingIter(DataIter):
         if isinstance(item, Exception):
             raise item
         return item
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed variable-length sequence iterator (parity:
+    python/mxnet/rnn/io.py BucketSentenceIter): sentences are assigned to
+    the smallest bucket that fits, padded to the bucket length, and each
+    batch carries its `bucket_key` so BucketingModule switches to the
+    matching static-shape executable."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if layout not in ("NT", "TN"):
+            raise ValueError(f"unknown layout {layout!r}; expected NT or TN")
+        if buckets is None:
+            buckets = sorted({len(s) for s in sentences})
+        self.buckets = sorted(buckets)
+        self.data_name, self.label_name = data_name, label_name
+        self.invalid_label = invalid_label
+        self._dtype = np.dtype(dtype)
+        self._layout = layout
+        rows_by_bucket = {b: [] for b in self.buckets}
+        ndiscard = 0
+        for s in sentences:
+            b = next((b for b in self.buckets if b >= len(s)), None)
+            if b is None:
+                ndiscard += 1
+                continue
+            row = np.full(b, invalid_label, dtype=self._dtype)
+            row[:len(s)] = s
+            rows_by_bucket[b].append(row)
+        if ndiscard:
+            import logging
+            logging.warning("BucketSentenceIter: discarded %d sentences "
+                            "longer than the largest bucket", ndiscard)
+        self._arrays = {b: np.stack(v) if v else np.zeros((0, b), self._dtype)
+                        for b, v in rows_by_bucket.items()}
+        self.default_bucket_key = max(self.buckets)
+        self.reset()
+
+    def _shape(self, b):
+        return ((self.batch_size, b) if self._layout == "NT"
+                else (b, self.batch_size))
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, self._shape(self.default_bucket_key),
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         self._shape(self.default_bucket_key), self._dtype)]
+
+    def reset(self):
+        self._plan = []
+        for b in self.buckets:
+            arr = self._arrays[b]
+            idx = np.random.permutation(len(arr))
+            for i in range(0, len(arr) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((b, idx[i:i + self.batch_size]))
+        np.random.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        b, idx = self._plan[self._cursor]
+        self._cursor += 1
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        rows = self._arrays[b][idx]
+        # next-token labels: shift left, pad with invalid_label
+        labels = np.full_like(rows, self.invalid_label)
+        labels[:, :-1] = rows[:, 1:]
+        if self._layout == "TN":
+            rows, labels = rows.T, labels.T
+        data = NDArray(jnp.asarray(rows))
+        label = NDArray(jnp.asarray(labels))
+        return DataBatch(
+            [data], [label], bucket_key=b,
+            provide_data=[DataDesc(self.data_name, self._shape(b),
+                                   self._dtype)],
+            provide_label=[DataDesc(self.label_name, self._shape(b),
+                                    self._dtype)])
+
+
+class LibSVMIter(DataIter):
+    """Sparse libsvm-format iterator (parity: mx.io.LibSVMIter,
+    src/io/iter_libsvm.cc): each batch's data is a CSRNDArray. Feed
+    `sparse.dot(csr, dense_weight)` models, or call `.todense()` for dense
+    layers — on TPU the dense carrier after embedding IS the fast path."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, shuffle=False,
+                 data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data_name, self.label_name = data_name, label_name
+        n_feat = int(data_shape[0]) if isinstance(data_shape, (tuple, list)) \
+            else int(data_shape)
+        self._n_feat = n_feat
+        self._label_shape = (tuple(label_shape)
+                             if label_shape not in (None, (1,), 1) else ())
+        labels, rows_idx, rows_val = [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append([float(parts[0])])
+                idx, val = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows_idx.append(np.asarray(idx, np.int64))
+                rows_val.append(np.asarray(val, np.float32))
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.strip():
+                        labels.append([float(t) for t in line.split()])
+        self._labels = np.asarray(labels, np.float32)
+        if self._label_shape:
+            if self._labels.shape[1:] != self._label_shape:
+                raise ValueError(
+                    f"label file rows have shape {self._labels.shape[1:]}, "
+                    f"label_shape says {self._label_shape}")
+        else:
+            self._labels = self._labels[:, 0]
+        self._rows_idx = rows_idx
+        self._rows_val = rows_val
+        self._shuffle = shuffle
+        self.num_data = len(self._labels)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size, self._n_feat),
+                         np.float32)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self._label_shape, np.float32)]
+
+    def reset(self):
+        self._order = np.arange(self.num_data)
+        if self._shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= self.num_data:
+            raise StopIteration
+        sel = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        pad = self.batch_size - len(sel)
+        if pad:  # reference behavior: pad the final batch, report .pad
+            sel = np.concatenate([sel, self._order[:pad]])
+        from ..ndarray import sparse as _sparse
+        indices = np.concatenate([self._rows_idx[i] for i in sel]) \
+            if len(sel) else np.zeros(0, np.int64)
+        values = np.concatenate([self._rows_val[i] for i in sel]) \
+            if len(sel) else np.zeros(0, np.float32)
+        indptr = np.zeros(self.batch_size + 1, np.int64)
+        for n, i in enumerate(sel):
+            indptr[n + 1] = indptr[n] + len(self._rows_idx[i])
+        csr = _sparse.CSRNDArray(values, indices, indptr,
+                                 (self.batch_size, self._n_feat))
+        label = nd.array(self._labels[sel])
+        return DataBatch([csr], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

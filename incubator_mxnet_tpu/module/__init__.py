@@ -25,7 +25,8 @@ from ..ndarray import NDArray
 from ..ndarray import random as ndrandom
 from .. import symbol as sym_mod
 
-__all__ = ["Module", "BaseModule", "save_checkpoint", "load_checkpoint"]
+__all__ = ["Module", "BaseModule", "BucketingModule",
+           "save_checkpoint", "load_checkpoint"]
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
@@ -168,7 +169,13 @@ class Module(BaseModule):
 
     # -- bind -------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             shared_module=None):
+        """`shared_module` (parity: Module.bind shared_module): reuse the
+        other module's parameter/gradient/aux NDArray objects so the two
+        executors train the same weights (the BucketingModule mechanism —
+        grads are written in-place, so updates through either are seen by
+        both)."""
         if self.binded and not force_rebind:
             return
         shapes = {}
@@ -190,6 +197,17 @@ class Module(BaseModule):
             else:
                 req[n] = grad_req
         self._exec = self._symbol.simple_bind(self._ctx, grad_req=req, **shapes)
+        if shared_module is not None and shared_module._exec is not None:
+            sx = shared_module._exec
+            for n in self._param_names:
+                if n in sx.arg_dict and n in self._exec.arg_dict:
+                    self._exec.arg_dict[n] = sx.arg_dict[n]
+                    if n in sx.grad_dict and n in self._exec.grad_dict:
+                        self._exec.grad_dict[n] = sx.grad_dict[n]
+            for n in self._aux_names:
+                if n in sx.aux_dict:
+                    self._exec.aux_dict[n] = sx.aux_dict[n]
+            self.params_initialized = shared_module.params_initialized
         self.binded = True
         self.for_training = for_training
         self._data_shapes = shapes
@@ -290,8 +308,9 @@ class Module(BaseModule):
                 continue
             lr, wd = self._optimizer._get_lr_wd(i)
             new_w, new_s = self._optimizer.update_step(
-                w._data, g._data, self._opt_states[n], lr, wd,
-                self._num_update, rescale=self._optimizer.rescale_grad,
+                w._data, g._data, self._opt_states[n], jnp.float32(lr),
+                jnp.float32(wd), jnp.int32(self._num_update),
+                rescale=self._optimizer.rescale_grad,
                 clip=self._optimizer.clip_gradient)
             w._data = new_w
             self._opt_states[n] = new_s
@@ -328,3 +347,133 @@ def _special_init(name):
     if name.endswith(("_gamma", "_moving_var")):
         return init_mod.One()
     return init_mod.Zero()
+
+
+class BucketingModule(BaseModule):
+    """Variable-length training over bucketed shapes (parity:
+    python/mxnet/module/bucketing_module.py).
+
+    `sym_gen(bucket_key) -> symbol | (symbol, data_names, label_names)`.
+    One Module per bucket; every bucket binds with
+    `shared_module=<default bucket>`, so all buckets train the SAME
+    parameter/gradient arrays. TPU-native note: each bucket is its own
+    static-shape XLA executable (jit caches per shape) — exactly the
+    compilation model buckets were invented for; the optimizer runs once,
+    on the default module, over the shared arrays.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, fixed_param_names=None):
+        if default_bucket_key is None:
+            raise ValueError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._ctx = context
+        self._fixed = fixed_param_names
+        self._buckets = {}
+        self._curr = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+
+    def _gen(self, key):
+        out = self._sym_gen(key)
+        if isinstance(out, tuple):
+            sym, data_names, label_names = out
+        else:
+            sym, data_names, label_names = out, ("data",), ("softmax_label",)
+        return sym, data_names, label_names
+
+    @property
+    def _default_mod(self):
+        return self._buckets[self._default_key]
+
+    @property
+    def symbol(self):
+        return self._default_mod.symbol
+
+    # -- bind / switch ----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        # a rebind allocates NEW parameter arrays: stale bucket modules
+        # would keep training the old ones — drop them all
+        self._buckets = {}
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        sym, dn, ln = self._gen(self._default_key)
+        mod = Module(sym, data_names=dn, label_names=ln, context=self._ctx,
+                     fixed_param_names=self._fixed)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 grad_req=grad_req)
+        self._buckets[self._default_key] = mod
+        self._curr = mod
+        self._grad_req = grad_req
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        assert self.binded, "bind() first"
+        if bucket_key not in self._buckets:
+            sym, dn, ln = self._gen(bucket_key)
+            mod = Module(sym, data_names=dn, label_names=ln,
+                         context=self._ctx, fixed_param_names=self._fixed)
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     grad_req=self._grad_req,
+                     shared_module=self._default_mod)
+            self._buckets[bucket_key] = mod
+        self._curr = self._buckets[bucket_key]
+
+    # -- params / optimizer (always on the default bucket: arrays shared) --
+    def init_params(self, *args, **kwargs):
+        self._default_mod.init_params(*args, **kwargs)
+        self.params_initialized = True
+        for m in self._buckets.values():
+            m.params_initialized = True
+
+    def get_params(self):
+        return self._default_mod.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True):
+        self._default_mod.set_params(arg_params, aux_params,
+                                     allow_missing=allow_missing,
+                                     force_init=force_init)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        self._default_mod.init_optimizer(kvstore, optimizer,
+                                         optimizer_params, force_init)
+        self.optimizer_initialized = True
+
+    # -- execution (forward picks the bucket from the batch) ---------------
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._default_key
+        self.switch_bucket(key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr.params_initialized = self.params_initialized
+        self._curr.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._default_mod.update()
+
+    def get_outputs(self):
+        return self._curr.get_outputs()
+
+    def get_input_grads(self):
+        return self._curr.get_input_grads()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._default_mod.save_checkpoint(prefix, epoch,
+                                          save_optimizer_states)

@@ -1,0 +1,159 @@
+"""BucketingModule + BucketSentenceIter (parity:
+python/mxnet/module/bucketing_module.py + python/mxnet/rnn/io.py).
+
+Variable-length training: each bucket compiles its own static-shape XLA
+executable while every bucket trains the SAME shared parameter arrays."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _sym_gen(seq_len):
+    """Tiny bucketed classifier: embed -> mean over time -> FC."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=20, output_dim=8,
+                           name="embed")
+    pooled = mx.sym.mean(emb, axis=1)
+    fc = mx.sym.FullyConnected(pooled, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+    return out, ("data",), ("softmax_label",)
+
+
+def _batch(bucket, batch_size=4, seed=0):
+    rng = np.random.RandomState(seed + bucket)
+    from incubator_mxnet_tpu.io import DataBatch, DataDesc
+    data = nd.array(rng.randint(0, 20, (batch_size, bucket)))
+    label = nd.array(rng.randint(0, 3, batch_size))
+    return DataBatch(
+        [data], [label], bucket_key=bucket,
+        provide_data=[DataDesc("data", (batch_size, bucket), np.float32)],
+        provide_label=[DataDesc("softmax_label", (batch_size,), np.float32)])
+
+
+def test_bucketing_module_shares_params():
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=12)
+    b0 = _batch(12)
+    mod.bind(data_shapes=b0.provide_data, label_shapes=b0.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    # forward through two different buckets
+    for bucket in (12, 5, 8):
+        batch = _batch(bucket)
+        mod.forward(batch, is_train=True)
+        out = mod.get_outputs()[0]
+        assert out.shape == (4, 3)
+        mod.backward()
+        mod.update()
+    # all buckets share the default bucket's arrays (same objects)
+    emb_default = mod._buckets[12]._exec.arg_dict["embed_weight"]
+    for key in (5, 8):
+        assert mod._buckets[key]._exec.arg_dict["embed_weight"] is emb_default
+
+
+def test_bucketing_module_learns():
+    """Loss decreases training across interleaved bucket sizes."""
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    b0 = _batch(10)
+    mod.bind(data_shapes=b0.provide_data, label_shapes=b0.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rng = np.random.RandomState(42)
+    from incubator_mxnet_tpu.io import DataBatch, DataDesc
+
+    def fixed_batch(bucket):
+        # deterministic, learnable mapping: label = first token % 3
+        data = rng.randint(0, 20, (8, bucket))
+        label = data[:, 0] % 3
+        return DataBatch(
+            [nd.array(data)], [nd.array(label)], bucket_key=bucket,
+            provide_data=[DataDesc("data", (8, bucket), np.float32)],
+            provide_label=[DataDesc("softmax_label", (8,), np.float32)])
+
+    batches = [fixed_batch(b) for b in (10, 6, 10, 6, 10, 6)]
+    metric = mx.metric.Accuracy()
+
+    def epoch_acc():
+        metric.reset()
+        for batch in batches:
+            mod.forward(batch, is_train=False)
+            mod.update_metric(metric, batch.label)
+        return metric.get_name_value()[0][1]
+
+    acc0 = epoch_acc()
+    for _ in range(40):
+        for batch in batches:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    acc1 = epoch_acc()
+    assert acc1 > max(acc0, 0.7), (acc0, acc1)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 20, rng.randint(2, 15)))
+                 for _ in range(100)]
+    it = mx.io.BucketSentenceIter(sentences, batch_size=4,
+                                  buckets=[5, 10, 15])
+    assert it.default_bucket_key == 15
+    seen_buckets = set()
+    n = 0
+    for batch in it:
+        b = batch.bucket_key
+        seen_buckets.add(b)
+        assert batch.data[0].shape == (4, b)
+        assert batch.label[0].shape == (4, b)
+        n += 1
+    assert n > 0 and len(seen_buckets) >= 2
+    # labels are next tokens
+    it.reset()
+    batch = next(iter(it))
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+
+
+def test_bucketing_with_sentence_iter_end_to_end():
+    rng = np.random.RandomState(1)
+    sentences = [list(rng.randint(1, 20, rng.randint(3, 10)))
+                 for _ in range(64)]
+    it = mx.io.BucketSentenceIter(sentences, batch_size=8, buckets=[5, 10])
+    mod = mx.mod.BucketingModule(_sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for batch in it:
+        # classifier head: use first label column as the class (toy)
+        batch.label = [nd.array(batch.label[0].asnumpy()[:, 0] % 3)]
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert len(mod._buckets) >= 2
+
+
+def test_bucket_sentence_iter_tn_layout():
+    sentences = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+    it = mx.io.BucketSentenceIter(sentences * 4, batch_size=4, buckets=[5],
+                                  layout="TN", dtype="int32")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 4)          # time-major
+    assert it.provide_data[0].shape == (5, 4)
+
+
+def test_bucketing_rebind_clears_buckets():
+    mod = mx.mod.BucketingModule(_sym_gen, default_bucket_key=10)
+    b = _batch(10)
+    mod.bind(data_shapes=b.provide_data, label_shapes=b.provide_label)
+    mod.init_params()
+    mod.forward(_batch(6), is_train=False)
+    assert 6 in mod._buckets
+    mod.bind(data_shapes=b.provide_data, label_shapes=b.provide_label,
+             force_rebind=True)
+    assert 6 not in mod._buckets                  # stale buckets dropped
+    assert not mod.params_initialized

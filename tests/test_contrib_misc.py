@@ -140,3 +140,69 @@ def test_distributed_single_host():
     mx.distributed.barrier()            # no-op single process
     mesh = mx.distributed.global_mesh({"dp": -1})
     assert mesh.devices.size == len(mx.distributed.global_devices())
+
+
+# -- Monitor ----------------------------------------------------------------
+
+def test_monitor_collects_stats():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    mod = mx.mod.Module(out, label_names=[])
+    mod.bind(data_shapes=[("data", (4, 3))], label_shapes=None)
+    mod.init_params()
+    mon = mx.Monitor(interval=2, pattern=".*weight.*|.*output.*")
+    mon.install(mod)
+    from incubator_mxnet_tpu.io import DataBatch
+    collected = []
+    for step in range(4):
+        mon.tic()
+        mod.forward(DataBatch([nd.ones((4, 3))]), is_train=True)
+        mod.backward()
+        collected.append(mon.toc())
+    assert collected[0] and collected[2]          # interval=2: steps 0,2
+    assert collected[1] == [] and collected[3] == []
+    names = {name for _, name, _ in collected[0]}
+    assert "fc_weight" in names and "output0" in names
+    assert all(np.isfinite(v) for _, _, v in collected[0])
+
+
+# -- LibSVMIter -------------------------------------------------------------
+
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "train.libsvm"
+    path.write_text("1 0:1.5 3:2.0\n"
+                    "0 1:1.0\n"
+                    "1 2:3.0 4:1.0\n"
+                    "0 0:0.5 4:2.5\n")
+    it = mx.io.LibSVMIter(str(path), data_shape=(5,), batch_size=2)
+    from incubator_mxnet_tpu.ndarray import sparse
+    batches = list(it)
+    assert len(batches) == 2
+    csr = batches[0].data[0]
+    assert isinstance(csr, sparse.CSRNDArray)
+    dense = csr.asnumpy()
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0, 0])
+    np.testing.assert_allclose(dense[1], [0, 1.0, 0, 0, 0])
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), [1.0, 0.0])
+    # sparse.dot consumes the batch directly
+    w = nd.array(np.random.RandomState(0).randn(5, 3).astype(np.float32))
+    out = sparse.dot(csr, w)
+    np.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_libsvm_iter_pads_last_batch(tmp_path):
+    path = tmp_path / "odd.libsvm"
+    path.write_text("1 0:1.0\n0 1:1.0\n1 2:1.0\n")
+    it = mx.io.LibSVMIter(str(path), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0 and batches[1].pad == 1
+    assert batches[1].data[0].shape == (2, 4)
+
+
+def test_monitor_rejects_garbage_and_sees_buckets():
+    import pytest as _pytest
+    mon = mx.Monitor(interval=1)
+    with _pytest.raises(TypeError, match="cannot monitor"):
+        mon.install(object())
