@@ -169,6 +169,8 @@ class Engine:
 
     def new_var(self) -> int:
         if self._lib is not None:
+            if not self._h:
+                return -1  # destroyed (GC finalization order)
             return self._lib.mxtpu_engine_new_var(self._h)
         return self._py.new_var()
 
@@ -176,6 +178,8 @@ class Engine:
         if self._lib is None:
             self._py.push(fn, const_vars, mutable_vars)
             return
+        if not self._h:
+            return  # destroyed (GC finalization order)
         with self._cb_lock:
             self._cb_id += 1
             cid = self._cb_id
@@ -190,6 +194,9 @@ class Engine:
 
     def wait_for_var(self, var: int):
         if self._lib is not None:
+            if not self._h:
+                self._raise_pending(var)  # still surface stashed errors
+                return
             self._lib.mxtpu_engine_wait_for_var(self._h, var)
             self._raise_pending(var)
         else:
@@ -197,6 +204,9 @@ class Engine:
 
     def wait_all(self):
         if self._lib is not None:
+            if not self._h:
+                self._raise_pending()  # still surface stashed errors
+                return
             self._lib.mxtpu_engine_wait_all(self._h)
             self._raise_pending()
         else:
@@ -323,6 +333,8 @@ class StoragePool:
 
     def alloc(self, size):
         if self._lib is not None:
+            if not self._h:
+                return None  # destroyed (GC finalization order)
             return self._lib.mxtpu_pool_alloc(self._h, size)
         b = self._round(size)
         with self._plock:
@@ -339,7 +351,8 @@ class StoragePool:
 
     def free(self, ptr):
         if self._lib is not None:
-            self._lib.mxtpu_pool_free(self._h, ptr)
+            if self._h:
+                self._lib.mxtpu_pool_free(self._h, ptr)
             return
         with self._plock:
             ent = self._live.pop(ptr, None)
@@ -352,6 +365,8 @@ class StoragePool:
 
     def stats(self):
         if self._lib is not None:
+            if not self._h:
+                return {"bytes_in_use": 0, "bytes_pooled": 0}
             used = ctypes.c_size_t()
             pooled = ctypes.c_size_t()
             self._lib.mxtpu_pool_stats(self._h, ctypes.byref(used),
@@ -393,6 +408,8 @@ class TokenQueue:
 
     def push(self, token) -> bool:
         if self._lib is not None:
+            if not self._h:
+                return False  # destroyed (GC finalization order)
             return bool(self._lib.mxtpu_queue_push(self._h, token))
         with self._not_full:
             while not self._closed and len(self._q) >= self._cap:
@@ -406,6 +423,8 @@ class TokenQueue:
     def pop(self):
         """Returns token or None when closed+drained."""
         if self._lib is not None:
+            if not self._h:
+                return None  # destroyed (GC finalization order)
             tok = ctypes.c_uint64()
             ok = self._lib.mxtpu_queue_pop(self._h, ctypes.byref(tok))
             return tok.value if ok else None
@@ -420,7 +439,10 @@ class TokenQueue:
 
     def close(self):
         if self._lib is not None:
-            self._lib.mxtpu_queue_close(self._h)
+            # _h is None once __del__ ran: GC may finalize this queue
+            # before an abandoned generator's finally calls close()
+            if self._h:
+                self._lib.mxtpu_queue_close(self._h)
             return
         with self._qlock:
             self._closed = True
@@ -429,6 +451,8 @@ class TokenQueue:
 
     def __len__(self):
         if self._lib is not None:
+            if not self._h:
+                return 0
             return self._lib.mxtpu_queue_size(self._h)
         with self._qlock:
             return len(self._q)

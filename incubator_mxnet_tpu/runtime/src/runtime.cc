@@ -324,47 +324,69 @@ class TokenQueue {
 extern "C" {
 
 void* mxtpu_engine_create(int num_threads) { return new Engine(num_threads); }
-void mxtpu_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+void mxtpu_engine_destroy(void* e) {
+    if (!e) return;
+    delete static_cast<Engine*>(e);
+}
 int64_t mxtpu_engine_new_var(void* e) {
+    if (!e) return -1;
     return static_cast<Engine*>(e)->new_var();
 }
 void mxtpu_engine_push(void* e, op_fn fn, void* arg,
                        const int64_t* const_vars, int n_const,
                        const int64_t* mut_vars, int n_mut) {
+    if (!e) return;  // destroyed handle (python GC finalization order)
     static_cast<Engine*>(e)->push(fn, arg, const_vars, n_const, mut_vars,
                                   n_mut);
 }
 void mxtpu_engine_wait_for_var(void* e, int64_t v) {
+    if (!e) return;
     static_cast<Engine*>(e)->wait_for_var(v);
 }
-void mxtpu_engine_wait_all(void* e) { static_cast<Engine*>(e)->wait_all(); }
+void mxtpu_engine_wait_all(void* e) {
+    if (!e) return;
+    static_cast<Engine*>(e)->wait_all();
+}
 
 void* mxtpu_pool_create() { return new Pool(); }
-void mxtpu_pool_destroy(void* p) { delete static_cast<Pool*>(p); }
+void mxtpu_pool_destroy(void* p) {
+    if (!p) return;
+    delete static_cast<Pool*>(p);
+}
 void* mxtpu_pool_alloc(void* p, size_t size) {
+    if (!p) return nullptr;
     return static_cast<Pool*>(p)->alloc(size);
 }
 void mxtpu_pool_free(void* p, void* ptr) {
+    if (!p) return;
     static_cast<Pool*>(p)->release(ptr);
 }
 void mxtpu_pool_stats(void* p, size_t* used, size_t* pooled) {
+    if (!p) { *used = 0; *pooled = 0; return; }
     static_cast<Pool*>(p)->stats(used, pooled);
 }
 
 void* mxtpu_queue_create(size_t cap) { return new TokenQueue(cap); }
 void mxtpu_queue_destroy(void* q) {
+    if (!q) return;
     auto* tq = static_cast<TokenQueue*>(q);
     tq->drain_users();
     delete tq;
 }
 int mxtpu_queue_push(void* q, uint64_t tok) {
+    if (!q) return 0;
     return static_cast<TokenQueue*>(q)->push(tok) ? 1 : 0;
 }
 int mxtpu_queue_pop(void* q, uint64_t* tok) {
+    if (!q) return 0;
     return static_cast<TokenQueue*>(q)->pop(tok) ? 1 : 0;
 }
-void mxtpu_queue_close(void* q) { static_cast<TokenQueue*>(q)->close(); }
+void mxtpu_queue_close(void* q) {
+    if (!q) return;
+    static_cast<TokenQueue*>(q)->close();
+}
 size_t mxtpu_queue_size(void* q) {
+    if (!q) return 0;
     return static_cast<TokenQueue*>(q)->size();
 }
 

@@ -14,6 +14,19 @@ import jax  # noqa: E402
 # startup; override before any backend is materialized.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall-time is dominated by XLA CPU
+# compiles; caching them makes repeat runs (CI re-runs, -x iterating) start
+# hot. Safe to delete the directory at any time.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_test_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

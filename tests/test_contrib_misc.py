@@ -30,7 +30,7 @@ def test_sync_batchnorm_is_global_under_mesh():
         net.initialize(init=mx.init.Xavier())
         return net
 
-    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype(np.float32)
+    x = np.random.RandomState(0).randn(16, 6, 6, 3).astype(np.float32)
     y = np.random.RandomState(1).randint(0, 3, 16)
     L = gluon.loss.SoftmaxCrossEntropyLoss()
 
