@@ -32,6 +32,8 @@ __all__ = [
     "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
     "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
     "RandomOrderAug", "CreateAugmenter", "ImageIter",
+    "DetAugmenter", "DetBorderAug", "DetHorizontalFlipAug",
+    "DetRandomCropAug", "CreateDetAugmenter", "ImageDetIter",
 ]
 
 _GRAY = np.array([0.299, 0.587, 0.114], dtype=np.float32)
@@ -579,6 +581,283 @@ class ImageIter:
 
     def __iter__(self):
         return self
+
+    def __next__(self):
+        return self.next()
+
+
+# ---------------------------------------------------------------------------
+# Detection augmenters + ImageDetIter (parity: python/mxnet/image/detection.py)
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Detection augmenter: __call__(img HWC, label (N,5) [cls,x0,y0,x1,y1]
+    normalized) -> (img, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorderAug(DetAugmenter):
+    """Resize to exactly (w, h); normalized boxes are size-invariant."""
+
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1], self.interp), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image AND boxes with probability p (reference
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = _as_np(src)[:, ::-1]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x0 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x0
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping box overlap >= min_object_covered (simplified
+    reference sampler: tries `max_attempts` crops, falls back to identity).
+    Boxes are clipped to the crop and dropped when their center is out."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), max_attempts=20):
+        self.min_cov = min_object_covered
+        self.ar_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        img = _as_np(src)
+        h, w = img.shape[:2]
+        valid = label[:, 0] >= 0
+        boxes = label[valid, 1:5]
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ar = _pyrandom.uniform(*self.ar_range)
+            cw = min(1.0, np.sqrt(area * ar))
+            ch = min(1.0, np.sqrt(area / ar))
+            cx = _pyrandom.uniform(0, 1.0 - cw)
+            cy = _pyrandom.uniform(0, 1.0 - ch)
+            if len(boxes) == 0:
+                keep = np.zeros((0,), bool)
+            else:
+                centers = (boxes[:, :2] + boxes[:, 2:]) / 2.0
+                keep = ((centers[:, 0] >= cx) & (centers[:, 0] <= cx + cw)
+                        & (centers[:, 1] >= cy) & (centers[:, 1] <= cy + ch))
+                if keep.sum() == 0:
+                    continue
+                ix0 = np.maximum(boxes[:, 0], cx)
+                iy0 = np.maximum(boxes[:, 1], cy)
+                ix1 = np.minimum(boxes[:, 2], cx + cw)
+                iy1 = np.minimum(boxes[:, 3], cy + ch)
+                inter = (np.clip(ix1 - ix0, 0, None)
+                         * np.clip(iy1 - iy0, 0, None))
+                barea = ((boxes[:, 2] - boxes[:, 0])
+                         * (boxes[:, 3] - boxes[:, 1]))
+                cov = inter / np.maximum(barea, 1e-12)
+                if (cov[keep] < self.min_cov).any():
+                    continue
+            # accept: crop pixels, remap surviving boxes to crop coords
+            px0, py0 = int(cx * w), int(cy * h)
+            px1, py1 = int((cx + cw) * w), int((cy + ch) * h)
+            out_img = img[py0:max(py1, py0 + 1), px0:max(px1, px0 + 1)]
+            new_label = np.full_like(label, -1.0)
+            n = 0
+            for i, k in enumerate(np.nonzero(valid)[0]):
+                if not keep[i]:
+                    continue
+                b = boxes[i]
+                nb = [(max(b[0], cx) - cx) / cw, (max(b[1], cy) - cy) / ch,
+                      (min(b[2], cx + cw) - cx) / cw,
+                      (min(b[3], cy + ch) - cy) / ch]
+                new_label[n, 0] = label[k, 0]
+                new_label[n, 1:5] = np.clip(nb, 0, 1)
+                n += 1
+            return out_img, new_label
+        return img, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_mirror=False,
+                       mean=None, std=None, brightness=0, contrast=0,
+                       saturation=0, inter_method=2, min_object_covered=0.3,
+                       area_range=(0.3, 3.0)):
+    """Detection augmenter stack (reference mx.image.CreateDetAugmenter).
+    data_shape CHW; pixel augmenters wrap the plain image augmenters.
+    Unknown options raise (no silent **kwargs swallow); mean=True/std=True
+    expand to the ImageNet constants like CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        class _DetResizeShort(DetAugmenter):
+            def __call__(self, src, label):
+                # normalized boxes are invariant under aspect-preserving
+                # resize
+                return resize_short(src, resize, inter_method), label
+        auglist.append(_DetResizeShort())
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(
+            min_object_covered=min_object_covered,
+            area_range=(min(area_range[0], 1.0), min(area_range[1], 1.0))))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorderAug((data_shape[2], data_shape[1]), inter_method))
+
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    pixel = []
+    if brightness or contrast or saturation:
+        pixel.append(ColorJitterAug(brightness, contrast, saturation))
+    pixel.append(CastAug())
+    if mean is not None or std is not None:
+        pixel.append(ColorNormalizeAug(
+            mean if mean is not None else np.zeros(3, np.float32),
+            std if std is not None else np.ones(3, np.float32)))
+
+    class _Pixel(DetAugmenter):
+        def __init__(self, ts):
+            self.ts = ts
+
+        def __call__(self, src, label):
+            for t in self.ts:
+                src = t(src)
+            return src, label
+
+    auglist.append(_Pixel(pixel))
+    return auglist
+
+
+class ImageDetIter:
+    """Detection iterator (parity: mx.image.ImageDetIter): yields DataBatch
+    with data (B,C,H,W) float32 and label (B, max_objs, 5) normalized
+    [cls, x0, y0, x1, y1], padding rows = -1 — exactly what
+    ops.MultiBoxTarget / SSD.targets consume."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None, imglist=None,
+                 path_root="", shuffle=False, aug_list=None,
+                 data_name="data", label_name="label", max_objs=None,
+                 **aug_kwargs):
+        from ..io import DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._shuffle = shuffle
+        self.data_name, self.label_name = data_name, label_name
+        self._samples = []      # (img source, label (N,5))
+        if path_imgrec is not None:
+            from ..recordio import MXIndexedRecordIO, unpack
+            self._rec = MXIndexedRecordIO(idx_path_for(path_imgrec),
+                                          path_imgrec, "r")
+            for k in self._rec.keys:
+                header, _ = unpack(self._rec.read_idx(k))
+                lab = np.asarray(header.label, np.float32)
+                self._samples.append((("rec", k), self._parse_label(lab)))
+        elif imglist is not None:
+            import os
+            self._rec = None
+            for entry in imglist:
+                lab = np.asarray(entry[:-1], np.float32)
+                path = os.path.join(path_root, entry[-1])
+                self._samples.append((("file", path), self._parse_label(lab)))
+        else:
+            raise ValueError("need path_imgrec or imglist")
+        self._max_objs = max_objs or max(
+            (len(l) for _, l in self._samples), default=1)
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(self.data_shape, **aug_kwargs)
+        self.auglist = aug_list
+        self._desc = DataDesc
+        self.reset()
+
+    @staticmethod
+    def _parse_label(lab):
+        """Reference det-record label: [header_width A, obj_width B,
+        (extra header...), (cls, x0, y0, x1, y1, extra...)*]."""
+        if lab.ndim > 1:
+            return lab.astype(np.float32)
+        a, b = int(lab[0]), int(lab[1])
+        body = lab[a:]
+        n = len(body) // b
+        out = body[:n * b].reshape(n, b)[:, :5]
+        return out.astype(np.float32)
+
+    def __len__(self):
+        return len(self._samples)
+
+    @property
+    def provide_data(self):
+        c, h, w = self.data_shape
+        return [self._desc(self.data_name, (self.batch_size, c, h, w),
+                           np.float32)]
+
+    @property
+    def provide_label(self):
+        return [self._desc(self.label_name,
+                           (self.batch_size, self._max_objs, 5), np.float32)]
+
+    def reset(self):
+        self._order = list(range(len(self._samples)))
+        if self._shuffle:
+            _pyrandom.shuffle(self._order)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def _read_img(self, source):
+        kind, ref = source
+        if kind == "rec":
+            from ..recordio import unpack
+            _, img_bytes = unpack(self._rec.read_idx(ref))
+            return imdecode(img_bytes).asnumpy()
+        return imread(ref).asnumpy()
+
+    def next(self):
+        from ..io import DataBatch
+        if self._cursor >= len(self._samples):
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        npad = self.batch_size - len(idx)
+        if npad:  # pad the final batch with wrap-around, report .pad
+            idx = list(idx) + self._order[:npad]
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        labels = np.full((self.batch_size, self._max_objs, 5), -1.0,
+                         np.float32)
+        for n, i in enumerate(idx):
+            src, lab = self._samples[i]
+            img = self._read_img(src)
+            lab = lab.copy()
+            pad = np.full((self._max_objs, 5), -1.0, np.float32)
+            pad[:len(lab)] = lab[:self._max_objs]
+
+            def det_tail(im):
+                nonlocal pad
+                for aug in self.auglist:
+                    im, pad = aug(im, pad)
+                return im
+
+            img = finalize_image(img, [det_tail], (h, w))
+            data[n] = np.transpose(img, (2, 0, 1))
+            labels[n] = pad
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        return DataBatch([NDArray(jnp.asarray(data))],
+                         [NDArray(jnp.asarray(labels))], pad=npad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def __next__(self):
         return self.next()

@@ -1133,6 +1133,7 @@ block_grad = stop_gradient
 from . import random  # noqa: E402  (registers nd.random namespace)
 from .random import shuffle  # noqa: E402
 from . import sparse  # noqa: E402  (registers nd.sparse namespace)
+from . import linalg  # noqa: E402  (registers nd.linalg namespace)
 
 
 def Custom(*args, op_type=None, **kwargs):

@@ -1,0 +1,184 @@
+"""Linear-algebra operator family (parity: python/mxnet/ndarray/linalg.py,
+src/operator/tensor/la_op.cc).
+
+Batched throughout (leading dims broadcast), differentiable through the
+tape like every other op. The matmul-shaped ops (gemm/gemm2/trmm/syrk) land
+on the MXU; the factorizations (potrf/syevd/gelqf) lower to XLA's native
+kernels. `lower=True` defaults match the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import NDArray, _apply, _as_nd
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "sumlogdiag",
+           "syrk", "gelqf", "syevd", "inverse", "det", "slogdet",
+           "makediag", "extractdiag", "maketrian", "extracttrian"]
+
+
+def _mt(a, transpose):
+    return jnp.swapaxes(a, -1, -2) if transpose else a
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False):
+    """alpha * op(A) @ op(B) + beta * C."""
+    C = _as_nd(C)
+    return _apply(lambda a, b, c: alpha * _mt(a, transpose_a)
+                  @ _mt(b, transpose_b) + beta * c,
+                  [A, B, C], name="linalg_gemm")
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False):
+    """alpha * op(A) @ op(B)."""
+    return _apply(lambda a, b: alpha * _mt(a, transpose_a)
+                  @ _mt(b, transpose_b),
+                  [A, B], name="linalg_gemm2")
+
+
+def potrf(A, lower=True):
+    """Cholesky factor (reference: positive-definite A = L @ L.T)."""
+    def f(a):
+        ch = jnp.linalg.cholesky(a)
+        return ch if lower else jnp.swapaxes(ch, -1, -2)
+    return _apply(f, [A], name="linalg_potrf")
+
+
+def potri(A, lower=True):
+    """Inverse from a Cholesky factor: (L @ L.T)^-1 given L."""
+    def f(l):
+        lt = l if lower else jnp.swapaxes(l, -1, -2)
+        eye = jnp.broadcast_to(jnp.eye(lt.shape[-1], dtype=lt.dtype),
+                               lt.shape)
+        linv = jax.scipy.linalg.solve_triangular(lt, eye, lower=True)
+        return jnp.swapaxes(linv, -1, -2) @ linv
+    return _apply(f, [A], name="linalg_potri")
+
+
+def trmm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    """Triangular matrix multiply: alpha * op(tri(A)) @ B (or B @ op)."""
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        tri = _mt(tri, transpose)
+        return alpha * (b @ tri if rightside else tri @ b)
+    return _apply(f, [A, B], name="linalg_trmm")
+
+
+def trsm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    """Solve op(tri(A)) @ X = alpha * B (or X @ op(tri(A)))."""
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        lo = lower != transpose
+        if rightside:
+            # X @ op(T) = aB  <=>  op(T).T @ X.T = a B.T
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(_mt(tri, transpose), -1, -2),
+                jnp.swapaxes(alpha * b, -1, -2), lower=not lo)
+            return jnp.swapaxes(sol, -1, -2)
+        return jax.scipy.linalg.solve_triangular(
+            _mt(tri, transpose), alpha * b, lower=lo)
+    return _apply(f, [A, B], name="linalg_trsm")
+
+
+def sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (reference log-det helper)."""
+    return _apply(lambda a: jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1))
+                  .sum(axis=-1), [A], name="linalg_sumlogdiag")
+
+
+def syrk(A, alpha=1.0, transpose=False):
+    """alpha * A @ A.T (or A.T @ A)."""
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * ((at @ a) if transpose else (a @ at))
+    return _apply(f, [A], name="linalg_syrk")
+
+
+def gelqf(A):
+    """LQ factorization A = L @ Q with Q orthonormal rows (m <= n)."""
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return _apply(f, [A], n_out=2, name="linalg_gelqf")
+
+
+def syevd(A):
+    """Symmetric eigendecomposition: returns (U, lam) with A = U.T diag(lam) U
+    (reference row-eigenvector convention)."""
+    def f(a):
+        lam, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), lam
+    return _apply(f, [A], n_out=2, name="linalg_syevd")
+
+
+def inverse(A):
+    return _apply(jnp.linalg.inv, [A], name="linalg_inverse")
+
+
+def det(A):
+    return _apply(jnp.linalg.det, [A], name="linalg_det")
+
+
+def slogdet(A):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return sign, logabs
+    return _apply(f, [A], n_out=2, name="linalg_slogdet")
+
+
+def makediag(A, offset=0):
+    """Vector(s) -> diagonal matrix (reference linalg.makediag)."""
+    return _apply(lambda a: _batched_diag(a, offset), [A],
+                  name="linalg_makediag")
+
+
+def _batched_diag(a, offset):
+    n = a.shape[-1] + abs(offset)
+    out_shape = a.shape[:-1] + (n, n)
+    flat = a.reshape(-1, a.shape[-1])
+    mats = jax.vmap(lambda v: jnp.diag(v, k=offset))(flat)
+    return mats.reshape(out_shape)
+
+
+def extractdiag(A, offset=0):
+    return _apply(lambda a: jnp.diagonal(a, offset=offset, axis1=-2,
+                                         axis2=-1),
+                  [A], name="linalg_extractdiag")
+
+
+def _trian_indices(n, offset, lower):
+    """Reference la_op semantics: the offset SIGN picks the triangle
+    (positive → upper band, negative → lower band); `lower` only breaks
+    the tie at offset=0."""
+    if offset > 0:
+        return jnp.triu_indices(n, k=offset)
+    if offset < 0:
+        return jnp.tril_indices(n, k=offset)
+    return jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+
+def maketrian(A, offset=0, lower=True):
+    """Packed vector(s) -> triangular matrix (reference maketrian)."""
+    def f(a):
+        import math
+        k = a.shape[-1]
+        n = int((math.isqrt(8 * k + 1) - 1) // 2) + abs(offset)
+        idx = _trian_indices(n, offset, lower)
+        flat = a.reshape(-1, k)
+
+        def one(v):
+            return jnp.zeros((n, n), a.dtype).at[idx].set(v)
+        return jax.vmap(one)(flat).reshape(a.shape[:-1] + (n, n))
+    return _apply(f, [A], name="linalg_maketrian")
+
+
+def extracttrian(A, offset=0, lower=True):
+    """Triangular part of matrix(es) packed into a vector."""
+    def f(a):
+        n = a.shape[-1]
+        idx = _trian_indices(n, offset, lower)
+        flat = a.reshape(-1, n, n)
+        return jax.vmap(lambda m: m[idx])(flat).reshape(
+            a.shape[:-2] + (len(idx[0]),))
+    return _apply(f, [A], name="linalg_extracttrian")

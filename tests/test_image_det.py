@@ -1,0 +1,105 @@
+"""ImageDetIter + detection augmenters (parity:
+python/mxnet/image/detection.py) feeding SSD targets."""
+import io as _io
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image, nd
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def det_rec(tmp_path):
+    """Synthetic detection record file: colored boxes on black."""
+    from incubator_mxnet_tpu import recordio
+    rec_path = str(tmp_path / "det.rec")
+    idx_path = str(tmp_path / "det.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = np.zeros((48, 64, 3), np.uint8)
+        cls = i % 3
+        x0, y0 = rng.uniform(0.1, 0.4, 2)
+        x1, y1 = x0 + 0.3, y0 + 0.4
+        img[int(y0 * 48):int(y1 * 48), int(x0 * 64):int(x1 * 64), cls] = 255
+        # reference det label: [header_w=2, obj_w=5, (cls,x0,y0,x1,y1)]
+        label = [2, 5, float(cls), x0, y0, x1, y1]
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack(header, _png_bytes(img)))
+    rec.close()
+    return rec_path
+
+
+def test_image_det_iter_shapes(det_rec):
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=det_rec)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape[0] == 4 and batch.label[0].shape[2] == 5
+    lab = batch.label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert len(valid) == 4                     # one object per image
+    assert ((valid[:, 1:] >= 0) & (valid[:, 1:] <= 1)).all()
+
+
+def test_det_flip_flips_boxes():
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    img = np.zeros((10, 10, 3), np.uint8)
+    label = np.array([[1.0, 0.1, 0.2, 0.4, 0.8],
+                      [-1, -1, -1, -1, -1]], np.float32)
+    img2, lab2 = aug(img, label)
+    np.testing.assert_allclose(lab2[0], [1.0, 0.6, 0.2, 0.9, 0.8],
+                               rtol=1e-6)
+    assert (lab2[1] == -1).all()               # padding untouched
+
+
+def test_det_random_crop_keeps_coverage():
+    rng = np.random.RandomState(1)
+    aug = image.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 1.0))
+    img = rng.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+    label = np.array([[2.0, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    for _ in range(10):
+        img2, lab2 = aug(img, label)
+        if (lab2[:, 0] >= 0).any():
+            b = lab2[0]
+            assert 0 <= b[1] <= b[3] <= 1 and 0 <= b[2] <= b[4] <= 1
+
+
+def test_det_iter_feeds_ssd_targets(det_rec):
+    """End-to-end: ImageDetIter batches flow into MultiBoxTarget."""
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=det_rec, rand_mirror=True)
+    batch = next(iter(it))
+    anchors = mx.nd.contrib.MultiBoxPrior(
+        nd.zeros((1, 8, 8, 16)), sizes=[0.4, 0.6], ratios=[1, 2],
+        layout="NHWC")
+    A = anchors.shape[1]
+    cls_pred = nd.zeros((4, 4, A))             # (B, C+1, A)
+    bt, bm, ct = mx.nd.contrib.MultiBoxTarget(anchors, batch.label[0],
+                                              cls_pred)
+    assert ct.shape == (4, A)
+    assert (ct.asnumpy() >= 0).any()           # some anchors matched
+
+
+def test_det_iter_pads_last_batch(det_rec):
+    it = image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            path_imgrec=det_rec)   # 8 samples, bs 3
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 1
+
+
+def test_det_augmenter_rejects_unknown_kwargs(det_rec):
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                           path_imgrec=det_rec, rand_miror=True)  # typo

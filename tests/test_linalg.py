@@ -1,0 +1,126 @@
+"""linalg op family vs numpy/scipy references (parity:
+python/mxnet/ndarray/linalg.py, src/operator/tensor/la_op.cc)."""
+import numpy as np
+import scipy.linalg as sla
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray import linalg
+
+rng = np.random.RandomState(0)
+
+
+def _spd(n, batch=()):
+    a = rng.randn(*batch, n, n).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32)
+
+
+def test_gemm_and_gemm2():
+    A = rng.randn(3, 4).astype(np.float32)
+    B = rng.randn(4, 5).astype(np.float32)
+    C = rng.randn(3, 5).astype(np.float32)
+    out = linalg.gemm(nd.array(A), nd.array(B), nd.array(C),
+                      alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2 * A @ B + 0.5 * C, rtol=1e-5)
+    out2 = linalg.gemm2(nd.array(A), nd.array(B.T),
+                        transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out2, A @ B, rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    S = _spd(4)
+    L = linalg.potrf(nd.array(S)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-4, atol=1e-4)
+    Sinv = linalg.potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(Sinv, np.linalg.inv(S), rtol=1e-3, atol=1e-3)
+    sld = float(linalg.sumlogdiag(nd.array(L)).asnumpy())
+    np.testing.assert_allclose(2 * sld, np.linalg.slogdet(S)[1], rtol=1e-4)
+
+
+def test_trmm_trsm():
+    L = np.tril(rng.randn(4, 4).astype(np.float32)) + 4 * np.eye(4, dtype=np.float32)
+    B = rng.randn(4, 3).astype(np.float32)
+    out = linalg.trmm(nd.array(L), nd.array(B), alpha=2.0).asnumpy()
+    np.testing.assert_allclose(out, 2 * L @ B, rtol=1e-5)
+    X = linalg.trsm(nd.array(L), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(L @ X, B, rtol=1e-4, atol=1e-5)
+    # rightside + transpose
+    B2 = rng.randn(3, 4).astype(np.float32)
+    X2 = linalg.trsm(nd.array(L), nd.array(B2), rightside=True,
+                     transpose=True).asnumpy()
+    np.testing.assert_allclose(X2 @ L.T, B2, rtol=1e-4, atol=1e-5)
+
+
+def test_syrk_batched():
+    A = rng.randn(2, 3, 5).astype(np.float32)
+    out = linalg.syrk(nd.array(A), alpha=0.5).asnumpy()
+    np.testing.assert_allclose(out, 0.5 * A @ np.swapaxes(A, -1, -2),
+                               rtol=1e-5)
+
+
+def test_gelqf():
+    A = rng.randn(3, 6).astype(np.float32)
+    L, Q = linalg.gelqf(nd.array(A))
+    L, Q = L.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(L @ Q, A, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-5)
+    assert np.allclose(np.triu(L, 1), 0, atol=1e-5)
+
+
+def test_syevd():
+    S = _spd(5)
+    U, lam = linalg.syevd(nd.array(S))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(U.T @ np.diag(lam) @ U, S, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_inverse_det_slogdet():
+    S = _spd(4)
+    np.testing.assert_allclose(linalg.inverse(nd.array(S)).asnumpy(),
+                               np.linalg.inv(S), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(linalg.det(nd.array(S)).asnumpy()),
+                               np.linalg.det(S), rtol=1e-3)
+    sign, logabs = linalg.slogdet(nd.array(S))
+    np.testing.assert_allclose(float(logabs.asnumpy()),
+                               np.linalg.slogdet(S)[1], rtol=1e-4)
+
+
+def test_diag_trian_roundtrips():
+    v = rng.randn(2, 4).astype(np.float32)
+    D = linalg.makediag(nd.array(v)).asnumpy()
+    assert D.shape == (2, 4, 4)
+    np.testing.assert_allclose(D[0], np.diag(v[0]), rtol=1e-6)
+    back = linalg.extractdiag(nd.array(D)).asnumpy()
+    np.testing.assert_allclose(back, v)
+    # packed triangle roundtrip
+    M = np.tril(rng.randn(4, 4).astype(np.float32))
+    packed = linalg.extracttrian(nd.array(M)).asnumpy()
+    assert packed.shape == (10,)
+    M2 = linalg.maketrian(nd.array(packed)).asnumpy()
+    np.testing.assert_allclose(M2, M, rtol=1e-6)
+
+
+def test_linalg_grad_flows():
+    S = _spd(3)
+    a = nd.array(S)
+    a.attach_grad()
+    with mx.autograd.record():
+        L = linalg.potrf(a)
+        loss = linalg.sumlogdiag(L)
+    loss.backward()
+    g = a._grad.asnumpy()
+    # d/dA of 0.5*logdet(A) = 0.5*A^-1
+    np.testing.assert_allclose(g, 0.5 * np.linalg.inv(S), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_trian_offset_band():
+    # positive offset selects the UPPER band (reference offset-sign rule)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    M = linalg.maketrian(nd.array(v), offset=1).asnumpy()
+    assert M.shape == (3, 3)
+    expected = np.array([[0, 1, 2], [0, 0, 3], [0, 0, 0]], np.float32)
+    np.testing.assert_allclose(M, expected)
+    back = linalg.extracttrian(nd.array(M), offset=1).asnumpy()
+    np.testing.assert_allclose(back, v)
