@@ -1,0 +1,76 @@
+"""Toy BERT MLM+NSP pretraining loop (the GluonNLP scripts/bert shape),
+optionally with ring-attention sequence parallelism for long context.
+
+    python examples/bert_pretrain_toy.py --steps 30
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/bert_pretrain_toy.py --ring-sp 8 --seq-len 512
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models.bert import (BERTModel, BERTForPretrain,
+                                             BERTPretrainLoss)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--units", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1000)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--masked", type=int, default=16)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--ring-sp", type=int, default=0,
+                   help="ring-attention sequence-parallel degree")
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    ring = None
+    if args.ring_sp:
+        from incubator_mxnet_tpu.parallel import make_mesh
+        ring = (make_mesh({"sp": args.ring_sp}), "sp")
+
+    bert = BERTModel(num_layers=args.layers, units=args.units,
+                     hidden_size=args.units * 4, num_heads=args.heads,
+                     max_length=args.seq_len, vocab_size=args.vocab,
+                     dropout=0.1, use_pooler=True, ring=ring)
+    model = BERTForPretrain(bert, vocab_size=args.vocab)
+    model.initialize(init=mx.init.Normal(0.02))
+    loss_fn = BERTPretrainLoss()
+    trainer = gluon.Trainer(
+        model.collect_params(), "adamw",
+        {"learning_rate": 1e-3, "wd": 0.01,
+         "lr_scheduler": mx.optimizer.lr_scheduler.CosineScheduler(
+             args.steps, base_lr=1e-3,
+             warmup_steps=max(1, args.steps // 10))})
+
+    B, T, M = args.batch_size, args.seq_len, args.masked
+    for step in range(args.steps):
+        ids = nd.array(rng.randint(0, args.vocab, (B, T)))
+        types = nd.zeros((B, T))
+        vlen = nd.array(np.full(B, T, np.int32))
+        pos = nd.array(np.stack([rng.choice(T, M, replace=False)
+                                 for _ in range(B)]))
+        mlm_label = nd.array(rng.randint(0, args.vocab, (B, M)))
+        nsp_label = nd.array(rng.randint(0, 2, B))
+        with autograd.record():
+            mlm, nsp = model(ids, types, vlen, pos)
+            loss = loss_fn(mlm, nsp, mlm_label, nsp_label)
+        loss.backward()
+        trainer.step(B)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {float(loss.asnumpy().mean()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Toy SSD detection training: synthetic record file -> ImageDetIter with
+box-aware augmentation -> SSD targets/loss -> detect() with NMS.
+
+    python examples/train_ssd_toy.py --epochs 3
+"""
+import argparse
+import io as _io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, image, nd, recordio
+from incubator_mxnet_tpu.models.ssd import SSD, SSDLoss
+
+
+def make_dataset(path, n=24, seed=0):
+    from PIL import Image as PILImage
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(image.idx_path_for(path), path, "w")
+    for i in range(n):
+        img = np.zeros((64, 64, 3), np.uint8)
+        cls = i % 2
+        x0, y0 = rng.uniform(0.1, 0.4, 2)
+        x1, y1 = x0 + 0.4, y0 + 0.4
+        img[int(y0 * 64):int(y1 * 64), int(x0 * 64):int(x1 * 64), cls] = 255
+        buf = _io.BytesIO()
+        PILImage.fromarray(img).save(buf, format="PNG")
+        header = recordio.IRHeader(0, [2, 5, float(cls), x0, y0, x1, y1],
+                                   i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.getvalue()))
+    rec.close()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=4)
+    args = p.parse_args()
+
+    mx.random.seed(0)
+    rec_path = os.path.join(tempfile.mkdtemp(), "toy_det.rec")
+    make_dataset(rec_path)
+    it = image.ImageDetIter(batch_size=args.batch_size,
+                            data_shape=(3, 32, 32), path_imgrec=rec_path,
+                            rand_mirror=True)
+
+    backbone = gluon.nn.HybridSequential()
+    backbone.add(gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                 activation="relu"))
+    net = SSD(backbone, num_classes=2, sizes=[[0.3, 0.5], [0.6, 0.8]],
+              ratios=[[1, 2]] * 2, extra_channels=(32,), layout="NCHW")
+    net.initialize(init=mx.init.Xavier())
+    loss_fn = SSDLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9,
+                             "clip_gradient": 10.0})
+
+    for epoch in range(args.epochs):
+        it.reset()
+        losses = []
+        for batch in it:
+            with autograd.record():
+                anchor, cls_pred, box_pred = net(batch.data[0])
+                with autograd.pause():
+                    bt, bm, ct = net.targets(anchor, cls_pred,
+                                             batch.label[0])
+                loss = loss_fn(cls_pred, box_pred, ct, bt, bm)
+            loss.backward()
+            trainer.step(args.batch_size)
+            losses.append(float(loss.asnumpy().mean()))
+        print(f"epoch {epoch}: loss {np.mean(losses):.3f}")
+
+    it.reset()
+    batch = next(iter(it))
+    det = net.detect(batch.data[0], threshold=0.05)  # toy-training scores
+    kept = (det.asnumpy()[:, :, 0] >= 0).sum()
+    print(f"detect(): {kept} boxes above threshold after NMS")
+
+
+if __name__ == "__main__":
+    main()

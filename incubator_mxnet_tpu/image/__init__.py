@@ -565,7 +565,9 @@ class ImageIter:
                 self._cursor = len(self._order)
                 raise StopIteration
             pad = self.batch_size - len(idx)
-            idx = list(idx) + self._order[:pad]
+            idx = list(idx)
+            while len(idx) < self.batch_size:  # dataset may be < batch
+                idx.extend(self._order[:self.batch_size - len(idx)])
         self._cursor += self.batch_size
         data = np.empty((self.batch_size, c, h, w), np.float32)
         label = np.empty((self.batch_size, self.label_width), np.float32)
@@ -831,7 +833,9 @@ class ImageDetIter:
         self._cursor += self.batch_size
         npad = self.batch_size - len(idx)
         if npad:  # pad the final batch with wrap-around, report .pad
-            idx = list(idx) + self._order[:npad]
+            idx = list(idx)
+            while len(idx) < self.batch_size:  # dataset may be < batch
+                idx.extend(self._order[:self.batch_size - len(idx)])
         c, h, w = self.data_shape
         data = np.empty((self.batch_size, c, h, w), np.float32)
         labels = np.full((self.batch_size, self._max_objs, 5), -1.0,

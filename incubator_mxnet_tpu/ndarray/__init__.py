@@ -1144,3 +1144,101 @@ def Custom(*args, op_type=None, **kwargs):
     if op_type is None:
         raise ValueError("Custom requires op_type=")
     return _operator.eager_custom(list(args), dict(kwargs, op_type=op_type))
+
+
+def meshgrid(*arrays, indexing="xy"):
+    """Parity: np.meshgrid surface used by reference scripts."""
+    arrs = [_as_nd(a) for a in arrays]
+    if len(arrs) == 1:
+        return [_apply(lambda r: jnp.meshgrid(r, indexing=indexing)[0],
+                       arrs, name="meshgrid")]
+    outs = _apply(lambda *raws: tuple(jnp.meshgrid(*raws, indexing=indexing)),
+                  arrs, n_out=len(arrs), name="meshgrid")
+    return list(outs)
+
+
+def shape_array(x):
+    """Parity: mx.nd.shape_array — the shape as a 1-D integer array
+    (int32 here: the TPU-native index dtype; the reference uses int64)."""
+    return NDArray(jnp.asarray(np.asarray(x.shape, np.int32)))
+
+
+def size_array(x):
+    """Parity: mx.nd.size_array (int32, see shape_array)."""
+    return NDArray(jnp.asarray(np.asarray([x.size], np.int32)))
+
+
+def gamma(x):
+    """Parity: mx.nd.gamma — the gamma function Γ(x), including the
+    alternating sign on the negative non-integer axis (exp(gammaln) alone
+    is |Γ|)."""
+    def f(a):
+        mag = jnp.exp(jax.scipy.special.gammaln(a))
+        neg_sign = jnp.where(jnp.floor(a) % 2 == 0, 1.0, -1.0)
+        return jnp.where(a > 0, mag, neg_sign * mag).astype(mag.dtype)
+    return _unary(f, x, name="gamma")
+
+
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    """Parity: mx.nd.hard_sigmoid."""
+    return _unary(lambda a: jnp.clip(alpha * a + beta, 0.0, 1.0), x,
+                  name="hard_sigmoid")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return _unary(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                           neginf=neginf), x,
+                  name="nan_to_num")
+
+
+def depth_to_space(x, block_size):
+    """Parity: mx.nd.depth_to_space (NCHW, DCR order like the reference)."""
+    b = int(block_size)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, b, b, c // (b * b), h, w)
+        a = jnp.transpose(a, (0, 3, 4, 1, 5, 2))
+        return a.reshape(n, c // (b * b), h * b, w * b)
+    return _unary(f, x, name="depth_to_space")
+
+
+def space_to_depth(x, block_size):
+    """Parity: mx.nd.space_to_depth (inverse of depth_to_space)."""
+    b = int(block_size)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // b, b, w // b, b)
+        a = jnp.transpose(a, (0, 3, 5, 1, 2, 4))
+        return a.reshape(n, c * b * b, h // b, w // b)
+    return _unary(f, x, name="space_to_depth")
+
+
+def ravel_multi_index(data, shape):
+    """Parity: mx.nd.ravel_multi_index — data (M, N) column-per-point."""
+    def f(a):
+        idx = a.astype(jnp.int32)
+        strides = np.cumprod([1] + list(shape[::-1]))[::-1][1:]
+        strides = jnp.asarray(np.asarray(strides, np.int32))
+        return (idx * strides[:, None]).sum(axis=0)
+    return _unary(f, _as_nd(data), name="ravel_multi_index")
+
+
+def unravel_index(data, shape):
+    """Parity: mx.nd.unravel_index — returns (M, N) column-per-point."""
+    def f(a):
+        outs = jnp.unravel_index(a.astype(jnp.int32), shape)
+        return jnp.stack(outs, axis=0)
+    return _unary(f, _as_nd(data), name="unravel_index")
+
+
+def hsplit(x, num_outputs):
+    return split(x, num_outputs, axis=1)
+
+
+def vsplit(x, num_outputs):
+    return split(x, num_outputs, axis=0)
+
+
+Pad = pad

@@ -103,3 +103,14 @@ def test_det_augmenter_rejects_unknown_kwargs(det_rec):
     with _pytest.raises(TypeError):
         image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
                            path_imgrec=det_rec, rand_miror=True)  # typo
+
+
+def test_det_iter_batch_larger_than_dataset(det_rec):
+    it = image.ImageDetIter(batch_size=20, data_shape=(3, 32, 32),
+                            path_imgrec=det_rec)    # only 8 samples
+    batch = next(iter(it))
+    assert batch.pad == 12
+    assert np.isfinite(batch.data[0].asnumpy()).all()
+    # wrapped rows repeat real samples, not uninitialized memory
+    d = batch.data[0].asnumpy()
+    np.testing.assert_allclose(d[8], d[0])

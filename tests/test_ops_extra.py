@@ -129,3 +129,34 @@ def test_batch_take_and_reverse():
     np.testing.assert_array_equal(out.asnumpy(), [1.0, 7.0, 8.0])
     rev = mx.nd.reverse(nd.array(a), axis=1).asnumpy()
     np.testing.assert_array_equal(rev, a[:, ::-1])
+
+
+def test_small_op_gap_fills():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    gx, gy = mx.nd.meshgrid(nd.array([1.0, 2.0]), nd.array([3.0, 4.0, 5.0]))
+    assert gx.shape == (3, 2) and gy.shape == (3, 2)
+    np.testing.assert_array_equal(mx.nd.shape_array(nd.array(a)).asnumpy(),
+                                  [2, 3])
+    assert int(mx.nd.size_array(nd.array(a)).asnumpy()[0]) == 6
+    np.testing.assert_allclose(mx.nd.gamma(nd.array(np.array([4.0]))).asnumpy(),
+                               [6.0], rtol=1e-5)
+    hs = mx.nd.hard_sigmoid(nd.array(np.array([-10.0, 0.0, 10.0])))
+    np.testing.assert_allclose(hs.asnumpy(), [0.0, 0.5, 1.0])
+    nn = mx.nd.nan_to_num(nd.array(np.array([np.nan, 1.0])))
+    np.testing.assert_array_equal(nn.asnumpy(), [0.0, 1.0])
+
+
+def test_depth_space_roundtrip():
+    x = np.random.RandomState(0).randn(2, 8, 3, 4).astype(np.float32)
+    d = mx.nd.depth_to_space(nd.array(x), 2)
+    assert d.shape == (2, 2, 6, 8)
+    back = mx.nd.space_to_depth(d, 2)
+    np.testing.assert_allclose(back.asnumpy(), x)
+
+
+def test_ravel_unravel_roundtrip():
+    pts = np.array([[0, 1, 2], [2, 0, 3]])    # (M=2, N=3) in shape (3, 4)
+    flat = mx.nd.ravel_multi_index(nd.array(pts), shape=(3, 4))
+    np.testing.assert_array_equal(flat.asnumpy(), [2, 4, 11])
+    back = mx.nd.unravel_index(flat, shape=(3, 4))
+    np.testing.assert_array_equal(back.asnumpy(), pts)
