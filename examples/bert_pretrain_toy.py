@@ -58,7 +58,9 @@ def main():
     for step in range(args.steps):
         ids = nd.array(rng.randint(0, args.vocab, (B, T)))
         types = nd.zeros((B, T))
-        vlen = nd.array(np.full(B, T, np.int32))
+        # ring attention shards full sequences; a valid_length mask is a
+        # dense-attention feature (the model raises if both are given)
+        vlen = None if ring else nd.array(np.full(B, T, np.int32))
         pos = nd.array(np.stack([rng.choice(T, M, replace=False)
                                  for _ in range(B)]))
         mlm_label = nd.array(rng.randint(0, args.vocab, (B, M)))

@@ -57,10 +57,12 @@ def main():
     print("compiling fused step...")
     loss = float(step(x, y))            # compile + warmup
     t0 = time.time()
+    out = None
     for _ in range(args.steps):
         out = step(x, y)
-    final = float(out)                  # host fetch = the only true barrier
-    dt = time.time() - t0
+    # host fetch = the only true barrier
+    final = float(out) if out is not None else loss
+    dt = max(time.time() - t0, 1e-9)
     print(f"{args.batch_size * args.steps / dt:.1f} img/s "
           f"(loss {loss:.3f} -> {final:.3f}, mesh={mesh})")
 

@@ -317,3 +317,39 @@ class TestTensorParallel:
             losses[mode] = ls
         np.testing.assert_allclose(losses["tp"], losses["single"],
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_ring_attention_long_context_8k():
+    """Long-context evidence: exact ring attention at 8192 tokens sharded
+    over 8 devices matches dense attention (within bf16-free fp32
+    tolerance) — per-device memory is O(L/n)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.parallel import make_mesh, ring_attention
+
+    mesh = make_mesh({"sp": 8})
+    L, D = 8192, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 2, L, D), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, L, D), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, L, D), jnp.float32)
+    spec = NamedSharding(mesh, P(None, None, "sp", None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, "sp",
+                                                 causal=True))(qs, ks, vs)
+    # dense reference on a SLICE of query rows (full dense is O(L^2) host
+    # memory); rows from the middle and the end cross shard boundaries
+    rows = np.r_[0:64, 4080:4144, L - 64:L]
+    scale = 1.0 / np.sqrt(D)
+    qr = np.asarray(q)[0, 0][rows]
+    scores = (qr @ np.asarray(k)[0, 0].T) * scale            # (R, L)
+    mask = rows[:, None] >= np.arange(L)[None, :]            # causal
+    scores = np.where(mask, scores, -1e30)
+    p = np.exp(scores - scores.max(axis=-1, keepdims=True))
+    p /= p.sum(axis=-1, keepdims=True)
+    expected = p @ np.asarray(v)[0, 0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0][rows], expected,
+                               rtol=2e-4, atol=2e-4)
