@@ -32,7 +32,14 @@ class FusedTrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh: Mesh | None = None,
-                 data_axis: str = "dp", donate: bool = True):
+                 data_axis: str = "dp", donate: bool = True,
+                 remat: bool = False):
+        """remat=True rematerializes the forward during backward
+        (jax.checkpoint with the dots-saveable policy) — the TPU-native
+        form of the reference's memonger/mirror_stage memory trade:
+        activations are recomputed instead of stored, buying batch size /
+        sequence length for ~1/3 extra FLOPs, with matmul outputs still
+        saved so the MXU work is not repeated."""
         self.net = net
         self.loss_fn = loss_fn
         if isinstance(optimizer, Trainer):
@@ -44,6 +51,7 @@ class FusedTrainStep:
         self.mesh = mesh
         self.data_axis = data_axis
         self.donate = donate
+        self.remat = remat
         self._jitted = None
         self._num_update = 0
         self.params = None      # resolved at first call (after deferred init)
@@ -93,6 +101,10 @@ class FusedTrainStep:
                                 for j, aid in enumerate(aux_ids)]
                 return loss_raw, aux_new
 
+            if self.remat:
+                loss_of = jax.checkpoint(
+                    loss_of,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
             (loss, aux_new), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_raws)
             new_train, new_states = [], []

@@ -110,3 +110,31 @@ def test_mesh_helpers():
     assert m.shape["dp"] == 2 and m.shape["tp"] == 4
     with pytest.raises(ValueError):
         make_mesh({"dp": 64})
+
+
+def test_fused_step_remat_matches_plain():
+    """remat recomputes activations in backward; the math is identical."""
+    from incubator_mxnet_tpu.parallel import FusedTrainStep
+
+    def build():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dense(8, activation="relu"),
+                gluon.nn.Dense(3))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    x = nd.array(np.random.RandomState(0).randn(8, 6).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randint(0, 3, 8))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = {}
+    for remat in (False, True):
+        net = build()
+        step = FusedTrainStep(net, L,
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              remat=remat)
+        losses[remat] = [float(step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
