@@ -82,6 +82,20 @@ def idx_path_for(path_imgrec):
             else path_imgrec + ".idx")
 
 
+def next_padded_indices(order, cursor, batch_size):
+    """Shared batching tail for the image iterators: the index window at
+    `cursor`, wrap-padded to a full batch (repeating from the start as
+    many times as needed when the dataset is smaller than one batch).
+    Returns (indices, n_pad); raises StopIteration at the end."""
+    if cursor >= len(order):
+        raise StopIteration
+    idx = list(order[cursor:cursor + batch_size])
+    pad = batch_size - len(idx)
+    while len(idx) < batch_size:
+        idx.extend(order[:batch_size - len(idx)])
+    return idx, pad
+
+
 def finalize_image(img, auglist, hw):
     """Shared tail of the sample pipeline: augment → float32 → fix any
     augmenter that left the wrong spatial size (reference iterators resize
@@ -554,20 +568,13 @@ class ImageIter:
         return finalize_image(img, self.auglist, (h, w))
 
     def next(self):
-        if self._cursor >= len(self._order):
-            raise StopIteration
         from ..io import DataBatch
         c, h, w = self.data_shape
-        idx = self._order[self._cursor:self._cursor + self.batch_size]
-        pad = 0
-        if len(idx) < self.batch_size:
-            if self._last == "discard":
-                self._cursor = len(self._order)
-                raise StopIteration
-            pad = self.batch_size - len(idx)
-            idx = list(idx)
-            while len(idx) < self.batch_size:  # dataset may be < batch
-                idx.extend(self._order[:self.batch_size - len(idx)])
+        idx, pad = next_padded_indices(self._order, self._cursor,
+                                       self.batch_size)
+        if pad and self._last == "discard":
+            self._cursor = len(self._order)
+            raise StopIteration
         self._cursor += self.batch_size
         data = np.empty((self.batch_size, c, h, w), np.float32)
         label = np.empty((self.batch_size, self.label_width), np.float32)
@@ -827,15 +834,9 @@ class ImageDetIter:
 
     def next(self):
         from ..io import DataBatch
-        if self._cursor >= len(self._samples):
-            raise StopIteration
-        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        idx, npad = next_padded_indices(self._order, self._cursor,
+                                        self.batch_size)
         self._cursor += self.batch_size
-        npad = self.batch_size - len(idx)
-        if npad:  # pad the final batch with wrap-around, report .pad
-            idx = list(idx)
-            while len(idx) < self.batch_size:  # dataset may be < batch
-                idx.extend(self._order[:self.batch_size - len(idx)])
         c, h, w = self.data_shape
         data = np.empty((self.batch_size, c, h, w), np.float32)
         labels = np.full((self.batch_size, self._max_objs, 5), -1.0,

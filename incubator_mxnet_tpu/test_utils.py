@@ -10,7 +10,8 @@ from .ndarray import NDArray, array
 
 __all__ = ["assert_almost_equal", "almost_equal", "same", "default_context",
            "set_default_context", "rand_ndarray", "rand_shape_nd",
-           "default_dtype"]
+           "default_dtype", "numeric_grad", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward"]
 
 
 def _to_np(a):
@@ -57,3 +58,70 @@ def rand_shape_nd(ndim, dim=10):
 
 def rand_ndarray(shape, dtype="float32", ctx=None):
     return array(np.random.uniform(-1.0, 1.0, shape).astype(dtype), ctx=ctx)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar-valued f at NDArray x."""
+    x0 = x.asnumpy().astype(np.float64)
+    g = np.zeros_like(x0)
+    it = np.nditer(x0, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x0[idx]
+        x0[idx] = orig + eps
+        fp = float(f(NDArray(x0.astype(np.float32))).asnumpy().sum())
+        x0[idx] = orig - eps
+        fm = float(f(NDArray(x0.astype(np.float32))).asnumpy().sum())
+        x0[idx] = orig
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g.astype(np.float32)
+
+
+def check_numeric_gradient(f, inputs, rtol=1e-2, atol=1e-3, eps=1e-3):
+    """Parity: mx.test_utils.check_numeric_gradient — compare the tape's
+    gradients of sum(f(*inputs)) against central differences, input by
+    input. `inputs` are NDArrays; each gets attach_grad()."""
+    import numpy as np
+    from . import autograd
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*inputs)
+        loss = out.sum()
+    loss.backward()
+    for i, x in enumerate(inputs):
+        def fi(xi, i=i):
+            args = list(inputs)
+            args[i] = xi
+            return f(*args)
+        expected = numeric_grad(fi, x, eps)
+        assert_almost_equal(x.grad.asnumpy(), expected, rtol=rtol, atol=atol,
+                            names=(f"autograd_grad[{i}]",
+                                   f"numeric_grad[{i}]"))
+
+
+def check_symbolic_forward(sym, args, expected, rtol=1e-5, atol=1e-20):
+    """Parity: mx.test_utils.check_symbolic_forward — bind and compare."""
+    ex = sym.bind(args={k: v if isinstance(v, NDArray) else NDArray(v)
+                        for k, v in args.items()}, grad_req="null")
+    outs = ex.forward()
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o.asnumpy(), np.asarray(e), rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, args, out_grads, expected_grads,
+                            rtol=1e-4, atol=1e-6):
+    """Parity: mx.test_utils.check_symbolic_backward."""
+    nd_args = {k: v if isinstance(v, NDArray) else NDArray(v)
+               for k, v in args.items()}
+    grads = {k: NDArray(np.zeros_like(v.asnumpy())) for k, v in nd_args.items()}
+    ex = sym.bind(args=nd_args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward([g if isinstance(g, NDArray) else NDArray(g)
+                 for g in out_grads])
+    for k, e in expected_grads.items():
+        assert_almost_equal(ex.grad_dict[k].asnumpy(), np.asarray(e),
+                            rtol=rtol, atol=atol, names=(f"grad[{k}]", "expected"))
+    return ex.grad_dict

@@ -93,3 +93,21 @@ def test_model_alias_checkpoint(tmp_path):
     s2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
     np.testing.assert_array_equal(arg2["w"].asnumpy(), arg["w"].asnumpy())
     assert aux2 == {}
+
+
+def test_check_numeric_gradient():
+    from incubator_mxnet_tpu import test_utils, nd
+    x = nd.array(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    test_utils.check_numeric_gradient(lambda a: (a * a).sum() + a.sum(), [x])
+
+
+def test_check_symbolic_forward_backward():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import test_utils
+    data = mx.sym.Variable("data")
+    out = mx.sym.square(data)
+    x = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+    test_utils.check_symbolic_forward(out, {"data": x}, [x * x])
+    og = np.ones_like(x)
+    test_utils.check_symbolic_backward(out, {"data": x}, [og],
+                                       {"data": 2 * x})
