@@ -106,6 +106,9 @@ def check_symbolic_forward(sym, args, expected, rtol=1e-5, atol=1e-20):
     ex = sym.bind(args={k: v if isinstance(v, NDArray) else NDArray(v)
                         for k, v in args.items()}, grad_req="null")
     outs = ex.forward()
+    if len(outs) != len(expected):
+        raise AssertionError(f"symbol produced {len(outs)} outputs, "
+                             f"expected {len(expected)}")
     for o, e in zip(outs, expected):
         assert_almost_equal(o.asnumpy(), np.asarray(e), rtol=rtol, atol=atol)
     return outs
