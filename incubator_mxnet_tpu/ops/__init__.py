@@ -7,8 +7,12 @@ names on `mx.nd`.
 """
 from __future__ import annotations
 
+import jax
+import numpy as np
+import jax.numpy as jnp
+
 from .. import autograd
-from ..ndarray import NDArray, _apply, _as_nd
+from ..ndarray import NDArray, _apply, _as_nd, _is_tracer
 from ..ndarray import random as ndrandom
 from . import _raw
 
@@ -23,7 +27,7 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "ROIPooling", "im2col", "SliceChannel",
            "SequenceMask", "SequenceLast", "SequenceReverse",
            "GridGenerator", "BilinearSampler", "SpatialTransformer",
-           "Correlation"]
+           "Correlation", "foreach", "while_loop", "cond"]
 
 
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
@@ -250,6 +254,210 @@ def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
         [data1, data2], name="Correlation")
 
 
+def _as_nd_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Parity: mx.nd.contrib.foreach (src/operator/control_flow.cc).
+    body(data_slice, states) -> (outputs, new_states); iterates over axis 0
+    of `data`.
+
+    Two execution modes, matching the reference's imperative semantics:
+    while `autograd.record()` is active the loop runs eagerly step by step
+    (the tape sees every op, so gradients flow to closure variables too);
+    otherwise it lowers to ONE compiled lax.scan. Under hybridize/jit
+    tracing the eager path simply unrolls into the trace."""
+    from .. import ndarray as nd
+    data_list = _as_nd_list(data)
+    if not data_list:
+        raise ValueError("foreach requires non-empty `data`")
+    states_list = _as_nd_list(init_states)
+    n_data = len(data_list)
+    single_data = not isinstance(data, (list, tuple))
+    single_states = not isinstance(init_states, (list, tuple))
+    T = data_list[0].shape[0]
+
+    if autograd.is_recording():
+        states = init_states
+        outs_acc = None
+        single_out = True
+        for t in range(T):
+            xs = [d[t] for d in data_list]
+            outs, states = body(xs[0] if single_data else xs, states)
+            single_out = not isinstance(outs, (list, tuple))
+            outs = _as_nd_list(outs)
+            if outs_acc is None:
+                outs_acc = [[] for _ in outs]
+            for acc, o in zip(outs_acc, outs):
+                acc.append(o)
+        stacked = [nd.stack(*acc, axis=0) for acc in (outs_acc or [])]
+        return (stacked[0] if single_out and stacked else stacked, states)
+
+    import jax.lax as _lax
+    meta = {}
+
+    def fn(*raws):
+        d_raws, s_raws = raws[:n_data], raws[n_data:]
+
+        def step(carry, xs):
+            s_nd = [NDArray(c) for c in carry]
+            x_nd = [NDArray(x) for x in xs]
+            outs, new_s = body(x_nd[0] if single_data else x_nd,
+                               s_nd[0] if single_states else s_nd)
+            meta["single_out"] = not isinstance(outs, (list, tuple))
+            outs = _as_nd_list(outs)
+            new_s = _as_nd_list(new_s)
+            meta["n_out"] = len(outs)
+            return (tuple(o._data for o in new_s),
+                    tuple(o._data for o in outs))
+
+        final, stacked = _lax.scan(step, tuple(s_raws), tuple(d_raws))
+        return tuple(stacked) + tuple(final)
+
+    all_in = data_list + states_list
+    # probe ONE step (not the whole scan) just to learn the output count
+    carry_avals = tuple(jax.ShapeDtypeStruct(s.shape, s._data.dtype)
+                        for s in states_list)
+    slice_avals = tuple(jax.ShapeDtypeStruct(d.shape[1:], d._data.dtype)
+                        for d in data_list)
+
+    def _one_step(c, xs):
+        s_nd = [NDArray(r) for r in c]
+        x_nd = [NDArray(r) for r in xs]
+        outs, new_s = body(x_nd[0] if single_data else x_nd,
+                           s_nd[0] if single_states else s_nd)
+        meta["single_out"] = not isinstance(outs, (list, tuple))
+        meta["n_out"] = len(_as_nd_list(outs))
+        return tuple(o._data for o in _as_nd_list(new_s))
+
+    jax.eval_shape(_one_step, carry_avals, slice_avals)
+    n_out = meta["n_out"]
+    res = _apply(fn, all_in, n_out=n_out + len(states_list), name="foreach")
+    res = _as_nd_list(res)
+    out_part = res[:n_out]
+    state_part = res[n_out:]
+    return (out_part[0] if meta["single_out"] else out_part,
+            state_part[0] if single_states and len(state_part) == 1
+            else state_part)
+
+
+def while_loop(cond, func, loop_vars, max_iterations):
+    """Parity: mx.nd.contrib.while_loop. func(loop_vars) ->
+    (step_output, new_loop_vars); runs while cond(loop_vars) is true, at
+    most max_iterations steps. Outputs are stacked padded to
+    max_iterations (reference shape semantics).
+
+    Eager Python loop while recording (tape/closure gradients exact);
+    otherwise a cond-gated lax.scan of static length — XLA-compilable AND
+    reverse-mode differentiable (a raw while_loop is not). NOTE (matches
+    the reference's imperative behavior): in recording mode a loop whose
+    condition is false on entry returns an empty outputs list — output
+    shapes are unknowable without running the body."""
+    from .. import ndarray as nd
+    lv = _as_nd_list(loop_vars)
+    single = not isinstance(loop_vars, (list, tuple))
+    n_lv = len(lv)
+
+    if autograd.is_recording():
+        cur = loop_vars
+        outs_acc = None
+        n_steps = 0
+        while n_steps < max_iterations:
+            pred = cond(cur)
+            if not bool(np.asarray(pred._data if isinstance(pred, NDArray)
+                                   else pred)):
+                break
+            outs, cur = func(cur)
+            outs = _as_nd_list(outs)
+            if outs_acc is None:
+                outs_acc = [[] for _ in outs]
+            for acc, o in zip(outs_acc, outs):
+                acc.append(o)
+            n_steps += 1
+        stacked = []
+        for acc in (outs_acc or []):
+            pad = [nd.zeros_like(acc[0])] * (max_iterations - len(acc))
+            stacked.append(nd.stack(*(acc + pad), axis=0))
+        return stacked, cur
+
+    import jax.lax as _lax
+    meta = {}
+
+    def fn(*raws):
+        def step(carry, _):
+            vars_raw, active = carry
+            v_nd = [NDArray(r) for r in vars_raw]
+            pred = cond(v_nd[0] if single else v_nd)
+            pred_raw = pred._data if isinstance(pred, NDArray) else pred
+            go = jnp.logical_and(
+                active, jnp.asarray(pred_raw).astype(bool).reshape(()))
+            outs, new_vars = func(v_nd[0] if single else v_nd)
+            outs = _as_nd_list(outs)
+            new_vars = _as_nd_list(new_vars)
+            meta["n_out"] = len(outs)
+            kept = tuple(jnp.where(go, nv._data, ov)
+                         for nv, ov in zip(new_vars, vars_raw))
+            out_raw = tuple(jnp.where(go, o._data,
+                                      jnp.zeros_like(o._data))
+                            for o in outs)
+            return (kept, go), out_raw
+
+        (final, _), stacked = _lax.scan(
+            step, (tuple(raws), jnp.bool_(True)), None,
+            length=max_iterations)
+        return tuple(stacked) + tuple(final)
+
+    def _one_step(raws):
+        v_nd = [NDArray(r) for r in raws]
+        outs, new_vars = func(v_nd[0] if single else v_nd)
+        meta["n_out"] = len(_as_nd_list(outs))
+        return tuple(o._data for o in _as_nd_list(new_vars))
+
+    jax.eval_shape(_one_step,
+                   tuple(jax.ShapeDtypeStruct(v.shape, v._data.dtype)
+                         for v in lv))
+    n_out = meta["n_out"]
+    res = _as_nd_list(_apply(fn, lv, n_out=n_out + n_lv,
+                             name="while_loop"))
+    out_part = res[:n_out]
+    var_part = res[n_out:n_out + n_lv]
+    return (out_part, var_part[0] if single and n_lv == 1 else var_part)
+
+
+def cond(pred, then_func, else_func, inputs):
+    """Parity: mx.nd.contrib.cond. On a concrete predicate (eager mode) the
+    chosen branch runs directly — tape gradients exact, branches need not
+    match shapes. On a traced predicate both branches compile into
+    lax.cond and XLA picks at runtime (shapes must match)."""
+    import jax.lax as _lax
+    ins = _as_nd_list(inputs)
+    single = not isinstance(inputs, (list, tuple))
+    pred_nd = pred if isinstance(pred, NDArray) else _as_nd(pred)
+
+    if not _is_tracer(pred_nd._data):
+        branch = then_func if bool(np.asarray(pred_nd._data)) else else_func
+        return branch(inputs)
+
+    def fn(p, *raws):
+        def wrap(f):
+            def g(rs):
+                nds = [NDArray(r) for r in rs]
+                out = f(nds[0] if single else nds)
+                return tuple(o._data for o in _as_nd_list(out))
+            return g
+        outs = _lax.cond(p.astype(bool).reshape(()), wrap(then_func),
+                         wrap(else_func), tuple(raws))
+        return outs if len(outs) > 1 else outs[0]
+
+    probe = jax.eval_shape(fn, pred_nd._data, *[x._data for x in ins])
+    n_out = len(probe) if isinstance(probe, tuple) else 1
+    res = _as_nd_list(_apply(fn, [pred_nd] + ins, n_out=n_out, name="cond"))
+    return res[0] if len(res) == 1 else res
+
+
 # Mirror the op namespace onto mx.nd for reference-style calls, and expose
 # the box/SSD family under mx.nd.contrib.* like the reference.
 def _mirror_into_nd():
@@ -260,7 +468,8 @@ def _mirror_into_nd():
         setattr(nd_mod, name, globals()[name])
     contrib = types.ModuleType("incubator_mxnet_tpu.ndarray.contrib")
     for name in ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
-                 "MultiBoxDetection", "multihead_attention"]:
+                 "MultiBoxDetection", "multihead_attention",
+                 "foreach", "while_loop", "cond"]:
         setattr(contrib, name, globals()[name])
     nd_mod.contrib = contrib
     sys.modules["incubator_mxnet_tpu.ndarray.contrib"] = contrib

@@ -1,0 +1,56 @@
+"""Examples stay runnable (slow tier): each script is executed with tiny
+arguments in a subprocess on the CPU backend."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script), *args],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+@pytest.mark.slow
+def test_example_mnist():
+    out = _run("train_mnist_gluon.py", "--epochs", "1",
+               "--num-examples", "512", "--batch-size", "64")
+    assert "accuracy=" in out
+
+
+@pytest.mark.slow
+def test_example_resnet_mesh():
+    out = _run("train_resnet_mesh.py", "--model", "resnet18_v1", "--dp", "8",
+               "--batch-size", "16", "--size", "32", "--steps", "2",
+               "--dtype", "float32")
+    assert "img/s" in out
+
+
+@pytest.mark.slow
+def test_example_bert():
+    out = _run("bert_pretrain_toy.py", "--steps", "4", "--layers", "1",
+               "--seq-len", "32")
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_example_bert_ring():
+    out = _run("bert_pretrain_toy.py", "--steps", "2", "--layers", "1",
+               "--seq-len", "64", "--ring-sp", "8")
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_example_ssd():
+    out = _run("train_ssd_toy.py", "--epochs", "1")
+    assert "detect()" in out
