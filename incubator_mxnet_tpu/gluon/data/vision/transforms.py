@@ -17,7 +17,9 @@ from ...nn import HybridSequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "CropResize"]
+           "RandomBrightness", "RandomContrast", "CropResize",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting", "RandomGray"]
 
 
 class Compose(HybridSequential):
@@ -188,3 +190,100 @@ class RandomResizedCrop(Block):
                               [crop], name="rrc_resize")
         return _apply(lambda a: _resize_hwc(a, self._size, self._interp), [x],
                       name="rrc_resize")
+
+
+class RandomSaturation(Block):
+    """Parity: transforms.RandomSaturation."""
+
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        f = 1.0 + float(ndrandom.uniform(-self._s, self._s,
+                                         shape=(1,)).asnumpy()[0])
+        coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32))
+        gray = (x * coef).sum(axis=-1, keepdims=True)
+        return x * f + gray * (1.0 - f)
+
+
+class RandomHue(Block):
+    """Parity: transforms.RandomHue (YIQ rotation, reference math)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        alpha = float(ndrandom.uniform(-self._h, self._h,
+                                       shape=(1,)).asnumpy()[0])
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return nd.dot(x, nd.array(m.T.astype(np.float32)))
+
+
+class RandomColorJitter(Block):
+    """Parity: transforms.RandomColorJitter — brightness/contrast/
+    saturation/hue applied in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        import random as _pyrandom
+        order = list(range(len(self._ts)))
+        _pyrandom.shuffle(order)
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """Parity: transforms.RandomLighting (AlexNet-style PCA noise)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = np.asarray(ndrandom.normal(0, self._alpha,
+                                       shape=(3,)).asnumpy())
+        rgb = (self._eigvec * a) @ self._eigval
+        return x + nd.array(rgb.astype(np.float32))
+
+
+class RandomGray(Block):
+    """Parity: transforms.RandomGray — grayscale with probability p."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        import random as _pyrandom
+        if _pyrandom.random() < self._p:
+            coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32))
+            gray = (x * coef).sum(axis=-1, keepdims=True)
+            return nd.concat(gray, gray, gray, dim=-1)
+        return x

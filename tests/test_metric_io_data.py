@@ -160,3 +160,22 @@ def test_prefetching_iter_exhaustion_no_hang():
         it.next()   # must raise again, not hang
     it.reset()
     assert len(list(it)) == 2
+
+
+def test_color_transforms():
+    from incubator_mxnet_tpu.gluon.data.vision import transforms as T
+    import incubator_mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(8, 8, 3).astype(np.float32))
+    for t in (T.RandomSaturation(0.3), T.RandomHue(0.2),
+              T.RandomColorJitter(0.2, 0.2, 0.2, 0.1),
+              T.RandomLighting(0.1)):
+        y = t(x)
+        assert y.shape == x.shape
+        assert np.isfinite(y.asnumpy()).all()
+    g = T.RandomGray(p=1.0)(x).asnumpy()
+    assert np.allclose(g[..., 0], g[..., 1]) and np.allclose(g[..., 1],
+                                                             g[..., 2])
+    # saturation=identity factor 0 keeps the image
+    y0 = T.RandomSaturation(0.0)(x)
+    np.testing.assert_allclose(y0.asnumpy(), x.asnumpy(), rtol=1e-6)
