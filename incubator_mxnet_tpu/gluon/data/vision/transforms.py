@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .... import ndarray as nd
+from ....image import _GRAY
 from ....ndarray import NDArray, _apply
 from ....ndarray import random as ndrandom
 from ...block import Block, HybridBlock
@@ -160,8 +161,9 @@ class RandomContrast(Block):
 
     def forward(self, x):
         f = 1.0 + float(ndrandom.uniform(-self._c, self._c, shape=(1,)).asnumpy()[0])
-        mean = x.mean()
-        return x * f + mean * (1 - f)
+        # luminance-weighted gray mean (reference contrast semantics)
+        gray_mean = (x * nd.array(_GRAY)).sum() / (x.shape[0] * x.shape[1])
+        return x * f + gray_mean * (1 - f)
 
 
 class RandomResizedCrop(Block):
@@ -202,13 +204,19 @@ class RandomSaturation(Block):
     def forward(self, x):
         f = 1.0 + float(ndrandom.uniform(-self._s, self._s,
                                          shape=(1,)).asnumpy()[0])
-        coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32))
-        gray = (x * coef).sum(axis=-1, keepdims=True)
+        gray = (x * nd.array(_GRAY)).sum(axis=-1, keepdims=True)
         return x * f + gray * (1.0 - f)
 
 
 class RandomHue(Block):
     """Parity: transforms.RandomHue (YIQ rotation, reference math)."""
+
+    _T_YIQ = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], np.float32)
+    _T_RGB = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
 
     def __init__(self, hue):
         super().__init__()
@@ -218,14 +226,8 @@ class RandomHue(Block):
         alpha = float(ndrandom.uniform(-self._h, self._h,
                                        shape=(1,)).asnumpy()[0])
         u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
-        t_yiq = np.array([[0.299, 0.587, 0.114],
-                          [0.596, -0.274, -0.321],
-                          [0.211, -0.523, 0.311]], np.float32)
-        t_rgb = np.array([[1.0, 0.956, 0.621],
-                          [1.0, -0.272, -0.647],
-                          [1.0, -1.107, 1.705]], np.float32)
         rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
-        m = t_rgb @ rot @ t_yiq
+        m = self._T_RGB @ rot @ self._T_YIQ
         return nd.dot(x, nd.array(m.T.astype(np.float32)))
 
 
@@ -246,10 +248,10 @@ class RandomColorJitter(Block):
             self._ts.append(RandomHue(hue))
 
     def forward(self, x):
-        import random as _pyrandom
-        order = list(range(len(self._ts)))
-        _pyrandom.shuffle(order)
-        for i in order:
+        # order drawn from the framework RNG chain -> reproducible under
+        # mx.random.seed
+        keys = ndrandom.uniform(0, 1, shape=(len(self._ts),)).asnumpy()
+        for i in np.argsort(keys):
             x = self._ts[i](x)
         return x
 
@@ -281,9 +283,8 @@ class RandomGray(Block):
         self._p = p
 
     def forward(self, x):
-        import random as _pyrandom
-        if _pyrandom.random() < self._p:
-            coef = nd.array(np.array([0.299, 0.587, 0.114], np.float32))
-            gray = (x * coef).sum(axis=-1, keepdims=True)
+        coin = float(ndrandom.uniform(0, 1, shape=(1,)).asnumpy()[0])
+        if coin < self._p:
+            gray = (x * nd.array(_GRAY)).sum(axis=-1, keepdims=True)
             return nd.concat(gray, gray, gray, dim=-1)
         return x

@@ -64,9 +64,17 @@ def _key():
 
 
 def seed(seed_state, ctx="all"):
+    """Seed every RNG the framework draws from: the jax key chain AND the
+    python/numpy global generators the host-side augmenters use (random
+    crop/flip/jitter order) — one call makes data augmentation and device
+    randomness reproducible together."""
     global _global_key
+    import random as _pyrandom
+    import numpy as _np
     with _lock:
         _global_key = jax.random.PRNGKey(int(seed_state))
+    _pyrandom.seed(int(seed_state))
+    _np.random.seed(int(seed_state) % (2 ** 32))
 
 
 def _shape(shape):
