@@ -2,9 +2,11 @@
 from . import data, loss, nn, rnn
 from . import contrib
 from . import model_zoo
+from . import utils
 from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Constant, Parameter, ParameterDict
 from .trainer import Trainer
 
 __all__ = ["Block", "HybridBlock", "Parameter", "ParameterDict", "Constant",
-           "Trainer", "nn", "loss", "rnn", "data", "contrib", "model_zoo"]
+           "Trainer", "nn", "loss", "rnn", "data", "contrib", "model_zoo",
+           "utils"]

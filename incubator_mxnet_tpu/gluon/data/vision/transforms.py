@@ -11,6 +11,16 @@ import numpy as np
 
 from .... import ndarray as nd
 from ....image import _GRAY
+
+_gray_cache = {}
+
+
+def _gray_nd():
+    """_GRAY as a cached device NDArray (lazy: no backend init at import)."""
+    if "v" not in _gray_cache:
+        _gray_cache["v"] = nd.array(_GRAY)
+    return _gray_cache["v"]
+
 from ....ndarray import NDArray, _apply
 from ....ndarray import random as ndrandom
 from ...block import Block, HybridBlock
@@ -161,8 +171,10 @@ class RandomContrast(Block):
 
     def forward(self, x):
         f = 1.0 + float(ndrandom.uniform(-self._c, self._c, shape=(1,)).asnumpy()[0])
-        # luminance-weighted gray mean (reference contrast semantics)
-        gray_mean = (x * nd.array(_GRAY)).sum() / (x.shape[0] * x.shape[1])
+        # luminance-weighted gray mean over pixels (reference semantics;
+        # shape-agnostic: channels are the last axis)
+        n_px = x.size // x.shape[-1]
+        gray_mean = (x * _gray_nd()).sum() / n_px
         return x * f + gray_mean * (1 - f)
 
 
@@ -204,7 +216,7 @@ class RandomSaturation(Block):
     def forward(self, x):
         f = 1.0 + float(ndrandom.uniform(-self._s, self._s,
                                          shape=(1,)).asnumpy()[0])
-        gray = (x * nd.array(_GRAY)).sum(axis=-1, keepdims=True)
+        gray = (x * _gray_nd()).sum(axis=-1, keepdims=True)
         return x * f + gray * (1.0 - f)
 
 
@@ -285,6 +297,6 @@ class RandomGray(Block):
     def forward(self, x):
         coin = float(ndrandom.uniform(0, 1, shape=(1,)).asnumpy()[0])
         if coin < self._p:
-            gray = (x * nd.array(_GRAY)).sum(axis=-1, keepdims=True)
+            gray = (x * _gray_nd()).sum(axis=-1, keepdims=True)
             return nd.concat(gray, gray, gray, dim=-1)
         return x

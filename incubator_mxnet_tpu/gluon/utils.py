@@ -1,0 +1,130 @@
+"""gluon.utils (parity: python/mxnet/gluon/utils.py): split_data,
+split_and_load, clip_global_norm, check_sha1.
+
+TPU-native note on the multi-device idiom: the reference's per-GPU loop
+(`split_and_load` -> per-ctx forward/backward -> kvstore sum) exists here
+for API compatibility and host-side sharding, but the throughput path on a
+mesh is `parallel.FusedTrainStep`, where the batch split, collective, and
+update all live inside one compiled computation.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice parts (parity:
+    gluon.utils.split_data). With even_split the batch must divide; without
+    it the last slice takes the remainder."""
+    if not isinstance(data, NDArray):
+        data = NDArray(jnp.asarray(data))
+    size = data.shape[batch_axis]
+    if num_slice > size:
+        raise ValueError(
+            f"cannot split {size} samples into {num_slice} slices")
+    if even_split and size % num_slice:
+        raise ValueError(
+            f"batch {size} not divisible by {num_slice}; pass "
+            "even_split=False to allow a ragged final slice")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        start = i * step
+        stop = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(start, stop)
+        slices.append(NDArray(data._data[tuple(idx)]))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """split_data + placement of each slice on its context (parity:
+    gluon.utils.split_and_load)."""
+    if not isinstance(ctx_list, (list, tuple)):
+        ctx_list = [ctx_list]
+    if len(ctx_list) == 1:
+        arr = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        return [arr.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) if isinstance(ctx, Context) else s
+            for s, ctx in zip(slices, ctx_list)]
+
+
+@jax.jit
+def _clip_impl(rs, max_norm):
+    total = sum(jnp.sum(jnp.square(r.astype(jnp.float32))) for r in rs)
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return norm, [(r * scale.astype(r.dtype)) for r in rs]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale `arrays` in place so their joint L2 norm is at most max_norm;
+    returns the pre-clip global norm (parity: gluon.utils.clip_global_norm —
+    the BERT/RNN training staple). One fused jitted computation, cached
+    across steps (module-level jit; max_norm is a traced argument)."""
+    if not arrays:
+        raise ValueError("clip_global_norm needs at least one array")
+    raws = [a._data for a in arrays]
+    norm, new = _clip_impl(raws, jnp.float32(max_norm))
+    norm_val = float(norm)
+    if check_isfinite and not np.isfinite(norm_val):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    for a, r in zip(arrays, new):
+        a._data = r
+    return norm_val
+
+
+def check_sha1(filename, sha1_hash):
+    """Parity: gluon.utils.check_sha1."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Parity surface for gluon.utils.download. TPU pods here are
+    zero-egress: `file://` and existing local paths work; network URLs
+    raise with instructions instead of hanging."""
+    import os
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+        if not os.path.exists(src):
+            raise RuntimeError(f"download({url!r}): local file not found")
+    elif os.path.exists(url):
+        src = url
+    else:
+        raise RuntimeError(
+            f"download({url!r}): no network egress in this environment; "
+            "stage the file locally and pass its path (or file:// URL)")
+    if path is None:
+        if sha1_hash and not check_sha1(src, sha1_hash):
+            raise RuntimeError(f"sha1 mismatch for {src}")
+        return src
+    import shutil
+    dest = os.path.join(path, os.path.basename(src)) \
+        if os.path.isdir(path) else path
+    if os.path.abspath(src) != os.path.abspath(dest) and (
+            overwrite or not os.path.exists(dest)):
+        shutil.copy(src, dest)
+    if sha1_hash and not check_sha1(dest, sha1_hash):
+        raise RuntimeError(f"sha1 mismatch for {dest}")
+    return dest

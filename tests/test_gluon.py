@@ -320,3 +320,39 @@ def test_constant_param():
     c.initialize()
     assert_close(c.data().asnumpy(), [1, 2])
     assert c.grad_req == "null"
+
+
+def test_split_and_load_and_clip_global_norm():
+    from incubator_mxnet_tpu.gluon import utils as gutils
+    import incubator_mxnet_tpu as mx
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(8, 3))
+    parts = gutils.split_data(x, 4)
+    assert [p.shape for p in parts] == [(2, 3)] * 4
+    np.testing.assert_allclose(parts[1].asnumpy(), x.asnumpy()[2:4])
+    ragged = gutils.split_data(x, 3, even_split=False)
+    assert [p.shape[0] for p in ragged] == [2, 2, 4]
+    loaded = gutils.split_and_load(x.asnumpy(), [mx.cpu()])
+    assert loaded[0].shape == (8, 3)
+    # clip_global_norm: joint norm scaled to max_norm
+    a = nd.array(np.full((3,), 3.0, np.float32))
+    b = nd.array(np.full((4,), 4.0, np.float32))
+    pre = np.sqrt(3 * 9.0 + 4 * 16.0)
+    norm = gutils.clip_global_norm([a, b], 1.0)
+    np.testing.assert_allclose(norm, pre, rtol=1e-5)
+    post = np.sqrt((a.asnumpy() ** 2).sum() + (b.asnumpy() ** 2).sum())
+    np.testing.assert_allclose(post, 1.0, rtol=1e-5)
+
+
+def test_check_sha1_and_local_download(tmp_path):
+    from incubator_mxnet_tpu.gluon import utils as gutils
+    import hashlib
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"hello tpu")
+    digest = hashlib.sha1(b"hello tpu").hexdigest()
+    assert gutils.check_sha1(str(src), digest)
+    dest = gutils.download(str(src), path=str(tmp_path / "copy.bin"),
+                           sha1_hash=digest)
+    assert open(dest, "rb").read() == b"hello tpu"
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="no network egress"):
+        gutils.download("https://example.com/x.bin")
