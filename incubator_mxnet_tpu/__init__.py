@@ -26,6 +26,7 @@ from . import kvstore as kv
 from . import gluon
 from . import symbol
 from . import symbol as sym
+from .symbol import AttrScope
 from . import module
 from . import module as mod
 from . import metric

@@ -441,9 +441,36 @@ def load(fname):
 # constructors
 # ---------------------------------------------------------------------------
 
+class AttrScope:
+    """Parity: mx.AttrScope / python/mxnet/attribute.py — `with
+    AttrScope(lr_mult="2", __group__="stage1"):` applies the attrs to every
+    Variable created inside the scope (nested scopes merge, inner wins)."""
+
+    _stack: list = []
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    def __enter__(self):
+        AttrScope._stack.append(self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._stack.pop()
+        return False
+
+    @staticmethod
+    def current_attrs():
+        merged = {}
+        for frame in AttrScope._stack:
+            merged.update(frame)
+        return merged
+
+
 def Variable(name, shape=None, dtype=None, init=None, lr_mult=None,
              wd_mult=None, **kwargs):
     node = _Node(None, name)
+    node.user_attrs.update(AttrScope.current_attrs())
     if shape is not None:
         node.user_attrs["__shape__"] = tuple(shape)
     if dtype is not None:

@@ -198,3 +198,15 @@ def test_load_json_no_name_collision():
     f2 = sym.FullyConnected(loaded, num_hidden=2)
     args = f2.list_arguments()
     assert len(args) == len(set(args)), args
+
+
+def test_attr_scope():
+    import incubator_mxnet_tpu as mx
+    with mx.AttrScope(group="stage1", lr_mult="2"):
+        a = mx.sym.Variable("a")
+        with mx.AttrScope(group="stage2"):
+            b = mx.sym.Variable("b")
+    c = mx.sym.Variable("c")
+    assert a.attr("group") == "stage1" and a.attr("lr_mult") == "2"
+    assert b.attr("group") == "stage2" and b.attr("lr_mult") == "2"
+    assert c.attr("group") is None
