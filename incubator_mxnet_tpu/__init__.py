@@ -29,6 +29,7 @@ from . import symbol as sym
 from .symbol import AttrScope
 from . import module
 from . import module as mod
+from . import model
 from . import metric
 from . import io
 from . import operator

@@ -1,0 +1,11 @@
+"""mx.model namespace shim (parity: python/mxnet/model.py).
+
+The reference keeps `save_checkpoint`/`load_checkpoint` in mx.model (the
+Module docs and many downstream scripts call them there). The
+implementations live in `module/`; this re-export keeps those call sites
+working. The deprecated FeedForward trainer is intentionally absent — use
+`mx.mod.Module` (same `fit` surface).
+"""
+from .module import save_checkpoint, load_checkpoint  # noqa: F401
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
