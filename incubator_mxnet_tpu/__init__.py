@@ -47,7 +47,6 @@ from . import engine
 from . import test_utils
 from . import utils
 from .utils import profiler
-from . import module as model  # mx.model.save_checkpoint/load_checkpoint
 
 from .ndarray import NDArray
 from .ndarray import random as _ndrandom
