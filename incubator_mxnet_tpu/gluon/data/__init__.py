@@ -268,3 +268,8 @@ class DataLoader:
         finally:
             q.close()       # unblocks any producer stuck in push
             eng.wait_all()  # only the in-flight window remains
+
+
+# vision importable as an attribute (mx.gluon.data.vision.MNIST etc.);
+# at the end of the module so vision's `from .. import Dataset` resolves
+from . import vision  # noqa: E402,F401

@@ -13,12 +13,13 @@ import struct
 import numpy as np
 
 from .... import ndarray as nd
+from ....recordio import unpack_img
 from ....ndarray import NDArray
-from .. import ArrayDataset, Dataset
+from .. import ArrayDataset, Dataset, RecordFileDataset
 from . import transforms
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageFolderDataset", "transforms"]
+           "ImageFolderDataset", "ImageRecordDataset", "transforms"]
 
 
 def _read_idx(path):
@@ -163,3 +164,29 @@ class ImageFolderDataset(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """`.rec` image records -> (image NDArray HWC, label) samples
+    (reference: python/mxnet/gluon/data/vision/datasets.py
+    ImageRecordDataset). Each record is an IRHeader + encoded image; the
+    header's label (scalar or vector) rides along. Decode is host-side
+    (PIL), feeding numpy/NDArray batches to the chip via DataLoader."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        header, img = unpack_img(self._record[idx], iscolor=self._flag)
+        label = header.label
+        if isinstance(label, np.ndarray) and label.size == 1:
+            label = float(label[0])
+        img = nd.array(img.astype(np.float32))
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record)

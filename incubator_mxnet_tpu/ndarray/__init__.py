@@ -459,6 +459,18 @@ def full_like(x: NDArray, val) -> NDArray:
     return _apply(lambda a: jnp.full_like(a, val), [x], name="full_like")
 
 
+def empty_like(x: NDArray) -> NDArray:
+    return zeros_like(x)
+
+
+def mod(lhs, rhs) -> NDArray:
+    return lhs % rhs if isinstance(lhs, NDArray) else NDArray(lhs) % rhs
+
+
+def astype(x: NDArray, dtype, copy=True) -> NDArray:
+    return x.astype(dtype, copy=copy)
+
+
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
     a = jnp.arange(start, stop, step, normalize_dtype(dtype))
     if repeat != 1:

@@ -242,3 +242,33 @@ def test_image_record_iter_feeds_fused_step(rec_file):
     for batch in it:
         losses.append(float(step(batch.data[0], batch.label[0])))
     assert len(losses) == 4 and all(np.isfinite(l) for l in losses)
+
+
+def test_image_record_dataset(rec_file):
+    """gluon.data.vision.ImageRecordDataset: .rec -> (HWC image, label)
+    samples, DataLoader-composable (reference
+    python/mxnet/gluon/data/vision/datasets.py ImageRecordDataset)."""
+    from incubator_mxnet_tpu.gluon.data.vision import ImageRecordDataset
+    rec_path, imgs = rec_file
+    ds = ImageRecordDataset(rec_path)
+    assert len(ds) == 32
+    img, label = ds[5]
+    assert img.shape == imgs[5].shape and label == 5 % 4
+    # exact parity with the direct recordio decode of the same record
+    from incubator_mxnet_tpu.gluon.data import RecordFileDataset
+    _, direct = recordio.unpack_img(RecordFileDataset(rec_path)[5])
+    assert np.array_equal(img.asnumpy(), direct.astype(np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=8)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (8, 40, 32, 3) and yb.shape == (8,)
+
+
+def test_nd_module_level_surface():
+    """mx.nd module functions mirroring NDArray methods (reference nd API)."""
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert mx.nd.empty_like(a).shape == (2, 2)
+    assert np.allclose(mx.nd.mod(a, 2).asnumpy(), [[1, 0], [1, 0]])
+    assert mx.nd.astype(a, "float16").dtype == np.float16
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    assert np.allclose(b.asnumpy(), a.asnumpy())
