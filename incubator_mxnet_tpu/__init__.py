@@ -21,12 +21,19 @@ from . import ops
 from . import initializer
 from . import initializer as init
 from . import optimizer
+from .optimizer import lr_scheduler
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
 from . import symbol
 from . import symbol as sym
 from .symbol import AttrScope
+from .symbol import executor
+from . import attribute
+from . import contrib
+from . import registry
+from . import util
+from . import rnn
 from . import module
 from . import module as mod
 from . import model

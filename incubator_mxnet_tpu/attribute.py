@@ -1,0 +1,11 @@
+"""Scoped symbol attributes (reference parity: python/mxnet/attribute.py).
+
+`AttrScope` itself lives with the Symbol implementation; this module is the
+reference's import location (`mx.attribute.AttrScope`).
+"""
+from .symbol import AttrScope
+
+__all__ = ["AttrScope"]
+
+# reference attribute.py exposes the merged active attrs via the scope object
+current = AttrScope.current_attrs

@@ -1,0 +1,12 @@
+"""mx.contrib namespace (reference parity: python/mxnet/contrib/__init__.py).
+
+Routes to the contrib op families that live with their subsystems:
+`contrib.ndarray`/`nd` (box/SSD ops, control flow, attention) and
+`contrib.symbol`/`sym` (their symbolic mirrors).
+"""
+from ..ndarray import contrib as ndarray
+from ..ndarray import contrib as nd
+from ..symbol import contrib as symbol
+from ..symbol import contrib as sym
+
+__all__ = ["ndarray", "nd", "symbol", "sym"]

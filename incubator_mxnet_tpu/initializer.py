@@ -22,6 +22,20 @@ create = registry.create
 class Initializer:
     """Base class. Subclasses implement _init(key, shape, dtype)."""
 
+    def to_attr_str(self):
+        """Serialize for the Variable __init__ attr (json name+params form
+        that Module.init_params re-creates; reference dumps initializers
+        the same way for InitDesc dispatch)."""
+        import json
+        params = {k: v for k, v in vars(self).items()
+                  if not k.startswith("_")}
+        try:
+            json.dumps(params)
+        except TypeError:
+            params = {}
+        return json.dumps({"name": type(self).__name__.lower(),
+                           "params": params})
+
     def __call__(self, key, shape, dtype="float32"):
         return self._init(key, tuple(shape), normalize_dtype(dtype))
 

@@ -223,6 +223,7 @@ class Module(BaseModule):
             # the reference's load→fit resume workflow keeps them.
             arg_params, aux_params = self._preloaded
         initializer = initializer or init_mod.Uniform(0.01)
+        sym_attrs = self._symbol.attr_dict()
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params and name not in arg_params and not allow_missing:
@@ -236,7 +237,12 @@ class Module(BaseModule):
                     arr._data.dtype)
             else:
                 ini = initializer
-                if isinstance(ini, init_mod.Mixed):
+                attr_init = sym_attrs.get(name, {}).get("__init__")
+                if attr_init:
+                    # Variable(init=...) wins over name rules, like the
+                    # reference's InitDesc attr dispatch
+                    ini = _init_from_attr(attr_init)
+                elif isinstance(ini, init_mod.Mixed):
                     ini = ini.init_for(name)
                 elif _is_special(name):
                     ini = _special_init(name)
@@ -336,6 +342,18 @@ class Module(BaseModule):
         mod._preloaded = (arg_params, aux_params)
         return mod
 
+
+
+def _init_from_attr(attr):
+    """Variable __init__ attr -> initializer: a registered name
+    ('xavier') or the json form '{"name": ..., "params": {...}}'
+    that Initializer.to_attr_str emits."""
+    s = str(attr)
+    if s.startswith("{"):
+        import json
+        spec = json.loads(s)
+        return init_mod.create(spec["name"], **spec.get("params", {}))
+    return init_mod.create(s)
 
 
 def _is_special(name):

@@ -245,17 +245,25 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
     return _apply(f, [data], name="box_nms")
 
 
-def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
-                  offsets=(0.5, 0.5), layout="NCHW"):
-    """Anchor generation (reference: mx.nd.contrib.MultiBoxPrior).
-    data: feature map; returns (1, H*W*K, 4) corner anchors."""
-    def f(x):
-        h, w = (x.shape[2], x.shape[3]) if layout == "NCHW" else \
-               (x.shape[1], x.shape[2])
-        return _multibox_prior(h, w, sizes, ratios, steps, offsets,
-                               x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
-                               else jnp.float32)[None]
-    return _apply(f, [data], name="MultiBoxPrior")
+def _multibox_prior_raw(x, sizes, ratios, steps, offsets, clip, layout):
+    """Shared raw body for nd.contrib/sym.contrib MultiBoxPrior."""
+    h, w = (x.shape[2], x.shape[3]) if layout == "NCHW" else \
+           (x.shape[1], x.shape[2])
+    out = _multibox_prior(h, w, sizes, ratios, steps, offsets,
+                          x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.float32)[None]
+    return jnp.clip(out, 0.0, 1.0) if clip else out
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), layout="NCHW"):
+    """Anchor generation (reference: mx.nd.contrib.MultiBoxPrior; argument
+    order matches the reference op — clip before steps). data: feature
+    map; returns (1, H*W*K, 4) corner anchors; clip=True clamps anchors
+    to [0, 1]."""
+    return _apply(lambda x: _multibox_prior_raw(
+        x, sizes, ratios, steps, offsets, clip, layout),
+        [data], name="MultiBoxPrior")
 
 
 def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
@@ -266,18 +274,28 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
     mx.nd.contrib.MultiBoxTarget). anchor (1,A,4); label (B,M,5);
     cls_pred (B,C+1,A). Returns (box_target (B,A*4), box_mask (B,A*4),
     cls_target (B,A))."""
-    def f(anc, lab, cp):
-        def one(lab_i, cp_i):
-            bt, bm, ct = _multibox_target(anc[0], lab_i, cp_i,
-                                          overlap_threshold,
-                                          negative_mining_ratio,
-                                          negative_mining_thresh,
-                                          ignore_label,
-                                          minimum_negative_samples,
-                                          variances)
-            return bt.reshape(-1), bm.reshape(-1), ct
-        return jax.vmap(one)(lab, cp)
-    return _apply(f, [anchor, label, cls_pred], n_out=3, name="MultiBoxTarget")
+    return _apply(lambda anc, lab, cp: _multibox_target_raw(
+        anc, lab, cp, overlap_threshold, negative_mining_ratio,
+        negative_mining_thresh, ignore_label, minimum_negative_samples,
+        variances),
+        [anchor, label, cls_pred], n_out=3, name="MultiBoxTarget")
+
+
+def _multibox_target_raw(anc, lab, cp, overlap_threshold,
+                         negative_mining_ratio, negative_mining_thresh,
+                         ignore_label, minimum_negative_samples,
+                         variances=_VAR):
+    """Shared raw body for nd.contrib/sym.contrib MultiBoxTarget."""
+    def one(lab_i, cp_i):
+        bt, bm, ct = _multibox_target(anc[0], lab_i, cp_i,
+                                      overlap_threshold,
+                                      negative_mining_ratio,
+                                      negative_mining_thresh,
+                                      ignore_label,
+                                      minimum_negative_samples,
+                                      variances)
+        return bt.reshape(-1), bm.reshape(-1), ct
+    return tuple(jax.vmap(one)(lab, cp))
 
 
 def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
@@ -287,19 +305,26 @@ def MultiBoxDetection(cls_prob, loc_pred, anchor, threshold=0.01,
     cls_prob (B,C+1,A); loc_pred (B,A*4); anchor (1,A,4).
     Returns (B, A, 6) rows [class_id, score, x0, y0, x1, y1]; suppressed
     rows have class_id = -1."""
-    def f(cp, lp, anc):
-        b = cp.shape[0]
-        a = anc.shape[1]
-        boxes = _decode_boxes(lp.reshape(b, a, 4), anc, clip,
-                              variances)                         # (B,A,4)
-        # best non-background class per anchor
-        cls_id = jnp.argmax(cp[:, 1:, :], axis=1)                # (B,A)
-        score = jnp.max(cp[:, 1:, :], axis=1)
-        keep = score > threshold
-        rows = jnp.concatenate([
-            jnp.where(keep, cls_id, -1).astype(boxes.dtype)[..., None],
-            jnp.where(keep, score, -1.0)[..., None], boxes], axis=-1)
-        return _box_nms(rows, nms_threshold, threshold, nms_topk,
-                        coord_start=2, score_index=1, id_index=0,
-                        force_suppress=force_suppress, background_id=-1)
-    return _apply(f, [cls_prob, loc_pred, anchor], name="MultiBoxDetection")
+    return _apply(lambda cp, lp, anc: _multibox_detection_raw(
+        cp, lp, anc, threshold, clip, nms_threshold, force_suppress,
+        nms_topk, variances),
+        [cls_prob, loc_pred, anchor], name="MultiBoxDetection")
+
+
+def _multibox_detection_raw(cp, lp, anc, threshold, clip, nms_threshold,
+                            force_suppress, nms_topk, variances=_VAR):
+    """Shared raw body for nd.contrib/sym.contrib MultiBoxDetection."""
+    b = cp.shape[0]
+    a = anc.shape[1]
+    boxes = _decode_boxes(lp.reshape(b, a, 4), anc, clip,
+                          variances)                         # (B,A,4)
+    # best non-background class per anchor
+    cls_id = jnp.argmax(cp[:, 1:, :], axis=1)                # (B,A)
+    score = jnp.max(cp[:, 1:, :], axis=1)
+    keep = score > threshold
+    rows = jnp.concatenate([
+        jnp.where(keep, cls_id, -1).astype(boxes.dtype)[..., None],
+        jnp.where(keep, score, -1.0)[..., None], boxes], axis=-1)
+    return _box_nms(rows, nms_threshold, threshold, nms_topk,
+                    coord_start=2, score_index=1, id_index=0,
+                    force_suppress=force_suppress, background_id=-1)

@@ -1,0 +1,87 @@
+"""Generic class registries (reference parity: python/mxnet/registry.py).
+
+The reference builds per-kind register/alias/create functions that
+optimizer/initializer/metric wire up; here those subsystems each own a
+`base._Registry`, and this module exposes the same factory surface over
+them for user code that extends the framework.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import _Registry
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_KINDS: dict[str, _Registry] = {}
+
+
+def _registry_for(base_class, nickname):
+    reg = _KINDS.get(nickname)
+    if reg is None:
+        # known kinds share state with their subsystem's registry, like the
+        # reference where mx.registry factories back the built-in ones
+        if nickname == "optimizer":
+            from . import optimizer as _m
+            reg = _m.registry
+        elif nickname == "initializer":
+            from . import initializer as _m
+            reg = _m.registry
+        elif nickname == "metric":
+            from . import metric as _m
+            reg = _m.registry
+        else:
+            reg = _Registry(nickname)
+        _KINDS[nickname] = reg
+    return reg
+
+
+def get_register_func(base_class, nickname):
+    """Return register(cls, name=None) for the kind (reference
+    registry.get_register_func)."""
+    reg = _registry_for(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), (
+            f"can only register subclasses of {base_class.__name__}")
+        reg.register(name or klass.__name__)(klass)
+        return klass
+
+    register.__name__ = f"register_{nickname}"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Return alias(*aliases) decorator (reference registry.get_alias_func)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    alias.__name__ = f"alias_{nickname}"
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Return create(name_or_instance, **kwargs) (reference
+    registry.get_create_func). Accepts an instance, a name, or the
+    reference's json string form '{"name": ..., "params": {...}}'."""
+    reg = _registry_for(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], str):
+            if args[0].startswith("{"):
+                spec = json.loads(args[0])
+                return reg.create(spec["name"], **spec.get("params", {}))
+            return reg.create(args[0], *args[1:], **kwargs)
+        if args and isinstance(args[0], base_class):
+            assert not kwargs and len(args) == 1
+            return args[0]
+        return reg.create(kwargs.pop(nickname), **kwargs)
+
+    create.__name__ = f"create_{nickname}"
+    return create

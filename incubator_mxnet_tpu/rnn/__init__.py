@@ -1,0 +1,525 @@
+"""Symbol-API RNN cells (reference parity: python/mxnet/rnn/rnn_cell.py).
+
+The reference's pre-Gluon recurrent API: cells that compose Symbol ops and
+`unroll` into a static graph, used with `Module`/`BucketingModule` plus
+`io.BucketSentenceIter`.
+
+TPU-first design notes:
+- `unroll()` emits a T-step static graph; the symbol executor jit-compiles
+  it into ONE XLA program, so the whole unrolled loop fuses (no per-step
+  kernel launches to amortize, unlike the reference's imperative path).
+- `FusedRNNCell` emits the single fused `RNN` op — one `lax.scan` on
+  device, the analogue of the reference's cuDNN fused kernel
+  (src/operator/rnn.cc) — preferred for long sequences where an unrolled
+  graph would blow up compile time.
+- Cell math matches gluon.rnn (LSTM gates i,f,g,o; GRU r,z,n with the
+  reset gate applied to the h2h candidate), so fused/unfused/gluon paths
+  are numerically interchangeable.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for cell weights: creates (and caches) prefixed symbol
+    Variables on demand (reference rnn_cell.RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell: one-step `__call__(inputs, states)` plus `unroll`."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def reset(self):
+        """Reset the step counter before building a fresh graph."""
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        """One timestep -> (output, new_states)."""
+        raise NotImplementedError
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info()]
+
+    def begin_state(self, func=None, batch_size=0, **kwargs):
+        """Initial states. With batch_size > 0 returns concrete
+        `sym.zeros`; with batch_size == 0 returns named Variables the
+        caller binds (the reference defers via shape inference; binding
+        is this executor's explicit equivalent)."""
+        assert not self._modified, (
+            "After applying modifier cells (e.g. DropoutCell), call "
+            "begin_state on the base cell instead")
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            name = f"{self._prefix}begin_state_{self._init_counter}"
+            if func is not None:
+                states.append(func(name=name, **kwargs))
+            elif batch_size > 0:
+                states.append(sym.zeros(shape=info["shape"], name=name))
+            else:
+                states.append(sym.Variable(name, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll for `length` steps -> (outputs, states).
+
+        inputs: merged Symbol ((N,T,C) for NTC / (T,N,C) for TNC) or a
+        list of `length` step Symbols. merge_outputs=True stacks step
+        outputs back into one Symbol on the time axis."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """list <-> merged Symbol on the layout's time axis."""
+    assert layout in ("NTC", "TNC"), f"unsupported layout {layout}"
+    axis = layout.find("T")
+    in_axis = in_layout.find("T") if in_layout else axis
+    if isinstance(inputs, sym.Symbol):
+        if merge is False:
+            inputs = list(sym.SliceChannel(inputs, num_outputs=length,
+                                           axis=in_axis, squeeze_axis=True))
+    else:
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = sym.stack(*inputs, axis=axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla cell: h' = act(W_i x + b_i + W_h h + b_h)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._num_hidden),
+                 "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "h2h")
+        output = sym.Activation(i2h + h2h, act_type=self._activation,
+                                name=name + "out")
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM (gates i,f,g,o — reference rnn_cell.LSTMCell)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        # forget_bias rides the bias initializer, like the reference's
+        # LSTMBias init (Module.init_params honors the __init__ attr)
+        from .. import initializer as _init
+        self._iB = self.params.get(
+            "i2h_bias", init=_init.LSTMBias(forget_bias))
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._num_hidden), "__layout__": "NC"},
+                {"shape": (batch_size, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name=name + "h2h")
+        gates = i2h + h2h
+        i, f, g, o = sym.SliceChannel(gates, num_outputs=4, axis=-1,
+                                      name=name + "slice")
+        in_gate = sym.Activation(i, act_type="sigmoid")
+        forget_gate = sym.Activation(f, act_type="sigmoid")
+        in_transform = sym.Activation(g, act_type="tanh")
+        out_gate = sym.Activation(o, act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU (gates r,z,n; reset applied to the h2h candidate — reference
+    rnn_cell.GRUCell)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._num_hidden),
+                 "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        prev_h = states[0]
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(data=prev_h, weight=self._hW, bias=self._hB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name=name + "h2h")
+        i2h_r, i2h_z, i2h_n = sym.SliceChannel(
+            i2h, num_outputs=3, axis=-1, name=name + "i2h_slice")
+        h2h_r, h2h_z, h2h_n = sym.SliceChannel(
+            h2h, num_outputs=3, axis=-1, name=name + "h2h_slice")
+        reset = sym.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = sym.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        cand = sym.Activation(i2h_n + reset * h2h_n, act_type="tanh")
+        ones = update * 0 + 1  # symbolic 1 with update's shape
+        next_h = (ones - update) * cand + update * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over one packed parameter vector — emits the
+    `RNN` op (one lax.scan on device; reference: cuDNN path of
+    src/operator/rnn.cc). Only `unroll` is supported, like the reference."""
+
+    _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 prefix=None, params=None):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._param = self.params.get("parameters")
+
+    @property
+    def _num_gates(self):
+        return self._GATES[self._mode]
+
+    def state_info(self, batch_size=0):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        info = [{"shape": (b, batch_size, self._num_hidden),
+                 "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": (b, batch_size, self._num_hidden),
+                         "__layout__": "LNC"})
+        return info
+
+    def param_size(self, input_size):
+        """Length of the packed parameter vector (rnn-inl.h layout:
+        weights for every (layer, direction), then biases)."""
+        D = 2 if self._bidirectional else 1
+        G, H = self._num_gates, self._num_hidden
+        size = 0
+        for layer in range(self._num_layers):
+            il = input_size if layer == 0 else D * H
+            size += D * (G * H * il + G * H * H)   # i2h + h2h weights
+        size += self._num_layers * D * 2 * G * H   # i2h + h2h biases
+        return size
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, True)
+        if layout == "NTC":
+            inputs = sym.transpose(inputs, axes=(1, 0, 2))
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = list(begin_state)
+        rnn = sym.RNN(data=inputs, parameters=self._param,
+                      state=states[0],
+                      state_cell=states[1] if self._mode == "lstm" else None,
+                      mode=self._mode, state_size=self._num_hidden,
+                      num_layers=self._num_layers,
+                      bidirectional=self._bidirectional, p=self._dropout,
+                      state_outputs=self._get_next_state,
+                      name=self._prefix + "rnn")
+        if self._get_next_state:
+            outputs = rnn[0]
+            next_states = [rnn[i] for i in range(1, len(self.state_info()) + 1)]
+        else:
+            outputs, next_states = rnn, []
+        if layout == "NTC":
+            outputs = sym.transpose(outputs, axes=(1, 0, 2))
+        if merge_outputs is False:
+            outputs = list(sym.SliceChannel(
+                outputs, num_outputs=length, axis=layout.find("T"),
+                squeeze_axis=True))
+        return outputs, next_states
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference
+        FusedRNNCell.unfuse) — same math, stepping-capable."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden, "relu", p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden, "tanh", p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, p),
+            "gru": lambda p: GRUCell(self._num_hidden, p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell(f"{self._prefix}l{i}_"),
+                    get_cell(f"{self._prefix}r{i}_"),
+                    output_prefix=f"{self._prefix}bi_l{i}_"))
+            else:
+                stack.add(get_cell(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f"{self._prefix}_dropout{i}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells vertically (reference rnn_cell.SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for c in self._cells:
+            infos.extend(c.state_info(batch_size))
+        return infos
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        states = []
+        for c in self._cells:
+            states.extend(c.begin_state(**kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, FusedRNNCell)
+            n = len(cell.state_info())
+            inputs, cstates = cell(inputs, states[p:p + n])
+            next_states.extend(cstates)
+            p += n
+        return inputs, next_states
+
+    def reset(self):
+        super().reset()
+        for c in getattr(self, "_cells", ()):
+            c.reset()
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run two cells over the sequence in opposite directions and concat
+    their step outputs (reference rnn_cell.BidirectionalCell). Only
+    `unroll` is defined, as in the reference."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return (self._l_cell.state_info(batch_size)
+                + self._r_cell.state_info(batch_size))
+
+    def begin_state(self, **kwargs):
+        return (self._l_cell.begin_state(**kwargs)
+                + self._r_cell.begin_state(**kwargs))
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell cannot be stepped; "
+                                  "use unroll()")
+
+    def reset(self):
+        super().reset()
+        for c in (getattr(self, "_l_cell", None),
+                  getattr(self, "_r_cell", None)):
+            if c is not None:
+                c.reset()
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        nl = len(self._l_cell.state_info())
+        l_out, l_states = self._l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, merge_outputs=False)
+        r_out, r_states = self._r_cell.unroll(
+            length, list(reversed(inputs)), begin_state[nl:], layout,
+            merge_outputs=False)
+        outputs = [
+            sym.Concat(l, r, dim=1,
+                       name=f"{self._output_prefix}t{i}")
+            for i, (l, r) in enumerate(zip(l_out, reversed(r_out)))]
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, l_states + r_states
+
+
+class ModifierCell(BaseRNNCell):
+    """Wrap a cell, reusing its params/states (reference
+    rnn_cell.ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "base_cell"):
+            self.base_cell.reset()
+
+
+class DropoutCell(BaseRNNCell):
+    """Apply dropout on the input sequence (reference
+    rnn_cell.DropoutCell). Stateless."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = sym.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ResidualCell(ModifierCell):
+    """output = base(inputs) + inputs (reference rnn_cell.ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = sym.elemwise_add(output, inputs,
+                                  name=f"{output.name}_plus_residual")
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs, begin_state, layout, merge_outputs=False)
+        self.base_cell._modified = True
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        outputs = [sym.elemwise_add(o, i) for o, i in zip(outputs, inputs)]
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states

@@ -153,6 +153,15 @@ class Symbol:
     def list_attr(self):
         return dict(self._entries[0][0].user_attrs)
 
+    def attr_dict(self):
+        """{node_name: user attrs} over the whole reachable graph
+        (reference symbol.attr_dict)."""
+        out = {}
+        for node in _topo(self._entries):
+            if node.user_attrs:
+                out[node.name] = dict(node.user_attrs)
+        return out
+
     def __repr__(self):
         outs = ", ".join(self._out_names())
         return f"<Symbol {outs}>"
@@ -301,6 +310,18 @@ class Symbol:
         order = _topo(self._entries)
         var_shape = dict(shape_kwargs)
         var_dtype = {k: normalize_dtype(v) for k, v in dtype_kwargs.items()}
+        # Variable(shape=..., dtype=...) declarations participate in
+        # inference (reference: nnvm reads the node's __shape__ attr);
+        # explicit kwargs win over declared attrs
+        for node in order:
+            if not node.is_var:
+                continue
+            ushape = node.user_attrs.get("__shape__")
+            if ushape is not None and node.name not in var_shape:
+                var_shape[node.name] = tuple(ushape)
+            udt = node.user_attrs.get("__dtype__")
+            if udt is not None and node.name not in var_dtype:
+                var_dtype[node.name] = normalize_dtype(udt)
         known = {}   # (id(node), idx) -> jax.ShapeDtypeStruct
 
         for _ in range(len(order) + 2):   # fixed-point; graph is a DAG
@@ -480,7 +501,9 @@ def Variable(name, shape=None, dtype=None, init=None, lr_mult=None,
     if wd_mult is not None:
         node.user_attrs["__wd_mult__"] = wd_mult
     if init is not None:
-        node.user_attrs["__init__"] = str(init)
+        node.user_attrs["__init__"] = (init.to_attr_str()
+                                       if hasattr(init, "to_attr_str")
+                                       else str(init))
     return Symbol([(node, 0)])
 
 

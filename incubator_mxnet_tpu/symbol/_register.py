@@ -668,8 +668,36 @@ def _rnn_nout(attrs):
     return 3 if attrs.get("mode", "lstm") == "lstm" else 2
 
 
+def _rnn_hint(in_shapes, attrs):
+    """Fill parameters/state shapes from data (T,N,I) + attrs, so
+    simple_bind works with an auto-created packed parameter Variable."""
+    d = in_shapes[0]
+    if d is None:
+        return None
+    from ..ops._rnn import GATES
+    mode = attrs.get("mode", "lstm")
+    G = GATES[mode]
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    D = 2 if attrs.get("bidirectional", False) else 1
+    T, N, I = d
+    size = 0
+    for layer in range(L):
+        il = I if layer == 0 else D * H
+        size += D * (G * H * il + G * H * H)
+    size += L * D * 2 * G * H
+    fills = {}
+    if len(in_shapes) > 1 and in_shapes[1] is None:
+        fills[1] = (size,)
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        fills[2] = (L * D, N, H)
+    if len(in_shapes) > 3 and in_shapes[3] is None:
+        fills[3] = (L * D, N, H)
+    return fills
+
+
 register_op("RNN", _rnn_fn, ("data", "parameters", "state", "state_cell"),
-            n_out=_rnn_nout)
+            n_out=_rnn_nout, infer_hint=_rnn_hint)
 
 
 def InstanceNorm(data=None, gamma=None, beta=None, eps=1e-3, name=None):
@@ -719,3 +747,169 @@ def Custom(*args, op_type=None, name=None, **kwargs):
 
 
 setattr(_sym_mod, "Custom", Custom)
+
+
+# ---------------------------------------------------------------------------
+# slice / elemwise mirrors (reference op names used by classic scripts)
+# ---------------------------------------------------------------------------
+
+_builtin_slice = slice
+
+
+def _mx_slice(x, begin, end, step):
+    idx = []
+    for d in range(len(begin)):
+        b, e = begin[d], end[d]
+        s = (step[d] if step and d < len(step) else None) or 1
+        idx.append(_builtin_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+register_op("slice", lambda rt, a, x: _mx_slice(
+    x, a["begin"], a["end"], a.get("step")), ("data",))
+
+
+def slice(data=None, begin=None, end=None, step=None, name=None):  # noqa: A001
+    return _make_op("slice", [data],
+                    _attrs(begin=tuple(begin), end=tuple(end),
+                           step=tuple(step) if step else None), name)
+
+
+for _n, _jf in (("elemwise_add", jnp.add), ("elemwise_sub", jnp.subtract),
+                ("elemwise_mul", jnp.multiply), ("elemwise_div", jnp.divide)):
+    register_op(_n, (lambda f: lambda rt, a, x, y: f(x, y))(_jf),
+                ("lhs", "rhs"))
+    def _mk(op):
+        def builder(lhs=None, rhs=None, name=None):
+            return _make_op(op, [lhs, rhs], None, name)
+        builder.__name__ = op
+        return builder
+    setattr(_sym_mod, _n, _mk(_n))
+
+setattr(_sym_mod, "slice", slice)
+
+
+# ---------------------------------------------------------------------------
+# sym.contrib: box/SSD family + attention, symbol mirrors of nd.contrib
+# (reference: mx.sym.contrib.* — src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+
+from ..ops import box as _box  # noqa: E402
+
+
+def _prior_fn(rt, a, x):
+    return _box._multibox_prior_raw(x, a["sizes"], a["ratios"], a["steps"],
+                                    a["offsets"], a.get("clip", False),
+                                    a.get("layout", "NCHW"))
+
+
+register_op("_contrib_MultiBoxPrior", _prior_fn, ("data",))
+
+
+def _target_fn(rt, a, anc, lab, cp):
+    return _box._multibox_target_raw(
+        anc, lab, cp, a["overlap_threshold"], a["negative_mining_ratio"],
+        a["negative_mining_thresh"], a["ignore_label"],
+        a["minimum_negative_samples"])
+
+
+register_op("_contrib_MultiBoxTarget", _target_fn,
+            ("anchor", "label", "cls_pred"), n_out=3)
+
+
+def _detection_fn(rt, a, cp, lp, anc):
+    return _box._multibox_detection_raw(
+        cp, lp, anc, a["threshold"], a["clip"], a["nms_threshold"],
+        a["force_suppress"], a["nms_topk"])
+
+
+register_op("_contrib_MultiBoxDetection", _detection_fn,
+            ("cls_prob", "loc_pred", "anchor"))
+
+
+def _box_nms_fn(rt, a, d):
+    one = d.ndim == 2
+    db = d[None] if one else d
+    out = _box._box_nms(db, a["overlap_thresh"], a["valid_thresh"], a["topk"],
+                        a["coord_start"], a["score_index"], a["id_index"],
+                        a["force_suppress"], a["background_id"], a["in_format"])
+    return out[0] if one else out
+
+
+register_op("_contrib_box_nms", _box_nms_fn, ("data",))
+
+
+def _box_iou_fn(rt, a, x, y):
+    if a.get("format", "corner") == "center":
+        x, y = _box._center_to_corner(x), _box._center_to_corner(y)
+    return _box._iou_corner(x, y)
+
+
+register_op("_contrib_box_iou", _box_iou_fn, ("lhs", "rhs"))
+
+
+def _contrib_MultiBoxPrior(data=None, sizes=(1.0,), ratios=(1.0,),
+                           steps=(-1.0, -1.0), offsets=(0.5, 0.5),
+                           layout="NCHW", clip=False, name=None):
+    return _make_op("_contrib_MultiBoxPrior", [data],
+                    _attrs(sizes=tuple(sizes), ratios=tuple(ratios),
+                           steps=tuple(steps), offsets=tuple(offsets),
+                           layout=layout, clip=clip), name)
+
+
+def _contrib_MultiBoxTarget(anchor=None, label=None, cls_pred=None,
+                            overlap_threshold=0.5, ignore_label=-1,
+                            negative_mining_ratio=-1,
+                            negative_mining_thresh=0.5,
+                            minimum_negative_samples=0, name=None):
+    return _make_op("_contrib_MultiBoxTarget", [anchor, label, cls_pred],
+                    _attrs(overlap_threshold=overlap_threshold,
+                           ignore_label=ignore_label,
+                           negative_mining_ratio=negative_mining_ratio,
+                           negative_mining_thresh=negative_mining_thresh,
+                           minimum_negative_samples=minimum_negative_samples),
+                    name)
+
+
+def _contrib_MultiBoxDetection(cls_prob=None, loc_pred=None, anchor=None,
+                               threshold=0.01, clip=True, nms_threshold=0.5,
+                               force_suppress=False, nms_topk=-1, name=None):
+    return _make_op("_contrib_MultiBoxDetection", [cls_prob, loc_pred, anchor],
+                    _attrs(threshold=threshold, clip=clip,
+                           nms_threshold=nms_threshold,
+                           force_suppress=force_suppress, nms_topk=nms_topk),
+                    name)
+
+
+def _contrib_box_nms(data=None, overlap_thresh=0.5, valid_thresh=0.0,
+                     topk=-1, coord_start=2, score_index=1, id_index=-1,
+                     background_id=-1, force_suppress=False,
+                     in_format="corner", name=None):
+    return _make_op("_contrib_box_nms", [data],
+                    _attrs(overlap_thresh=overlap_thresh,
+                           valid_thresh=valid_thresh, topk=topk,
+                           coord_start=coord_start, score_index=score_index,
+                           id_index=id_index, background_id=background_id,
+                           force_suppress=force_suppress,
+                           in_format=in_format), name)
+
+
+def _contrib_box_iou(lhs=None, rhs=None, format="corner", name=None):  # noqa: A002
+    return _make_op("_contrib_box_iou", [lhs, rhs],
+                    _attrs(format=format), name)
+
+
+def _install_sym_contrib():
+    import sys
+    import types
+    contrib = types.ModuleType("incubator_mxnet_tpu.symbol.contrib")
+    contrib.MultiBoxPrior = _contrib_MultiBoxPrior
+    contrib.MultiBoxTarget = _contrib_MultiBoxTarget
+    contrib.MultiBoxDetection = _contrib_MultiBoxDetection
+    contrib.box_nms = _contrib_box_nms
+    contrib.box_iou = _contrib_box_iou
+    _sym_mod.contrib = contrib
+    sys.modules["incubator_mxnet_tpu.symbol.contrib"] = contrib
+
+
+_install_sym_contrib()

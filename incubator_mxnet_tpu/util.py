@@ -1,0 +1,69 @@
+"""Small runtime utilities (reference parity: python/mxnet/util.py).
+
+The reference's numpy-mode switches don't apply here — NDArray is
+numpy-semantic natively — so the mode queries are honest constants rather
+than global flags.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "getenv", "setenv", "use_np_shape", "is_np_shape",
+           "is_np_array", "np_shape", "wrap_ctx_to_device_func"]
+
+
+def makedirs(d):
+    """mkdir -p (reference util.makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def getenv(name):
+    """Read an environment variable (reference MXGetEnv path)."""
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    """Set an environment variable (reference MXSetEnv path)."""
+    os.environ[name] = value
+
+
+def is_np_shape():
+    """Zero-dim/zero-size shapes are always legal here (jax is numpy-
+    semantic), so numpy-shape mode is permanently on."""
+    return True
+
+
+def is_np_array():
+    """The nd namespace already follows numpy broadcasting/dtype rules;
+    there is no separate legacy-array mode to switch from."""
+    return True
+
+
+class np_shape:
+    """No-op context manager kept for reference-API compatibility
+    (`with mx.util.np_shape():`)."""
+
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def use_np_shape(func):
+    """Decorator form of :class:`np_shape` (reference util.use_np_shape)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape():
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def wrap_ctx_to_device_func(func):
+    """Reference 2.x helper that translated ctx= to device=; both spellings
+    already reach Context here, so this is identity."""
+    return func

@@ -1,0 +1,277 @@
+"""mx.rnn symbol-API cell tests (mirrors reference
+tests/python/unittest/test_rnn.py): cell unroll shapes/parity with the
+gluon cells, FusedRNNCell vs unfused parity, modifier cells, Module
+integration, plus the mx.contrib / namespace surface."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, rnn
+from incubator_mxnet_tpu import symbol as sym
+
+B, T, I, H = 4, 5, 6, 8
+
+
+def _bind_forward(out_syms, args):
+    group = sym.Group(out_syms) if isinstance(out_syms, list) else out_syms
+    ex = group.bind(args={k: np.asarray(v, np.float32)
+                          for k, v in args.items()}, grad_req="null")
+    return [o.asnumpy() for o in ex.forward()]
+
+
+def _rand(shape, rng):
+    return rng.randn(*shape).astype(np.float32) * 0.2
+
+
+# ---------------------------------------------------------------------------
+# cells: shapes + parity vs gluon
+# ---------------------------------------------------------------------------
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(H, prefix="rnn_")
+    x = sym.Variable("x")
+    outputs, states = cell.unroll(T, x, cell.begin_state(batch_size=B),
+                                  layout="NTC", merge_outputs=True)
+    rng = np.random.RandomState(0)
+    outs = _bind_forward(outputs, {
+        "x": _rand((B, T, I), rng),
+        "rnn_i2h_weight": _rand((H, I), rng), "rnn_i2h_bias": np.zeros(H),
+        "rnn_h2h_weight": _rand((H, H), rng), "rnn_h2h_bias": np.zeros(H)})
+    assert outs[0].shape == (B, T, H)
+    assert np.isfinite(outs[0]).all()
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru"])
+def test_cell_matches_gluon(mode):
+    """Symbol cell unroll == gluon cell stepping with identical weights."""
+    rng = np.random.RandomState(1)
+    G = {"lstm": 4, "gru": 3}[mode]
+    wi, bi = _rand((G * H, I), rng), _rand((G * H,), rng)
+    wh, bh = _rand((G * H, H), rng), _rand((G * H,), rng)
+    x = _rand((B, T, I), rng)
+
+    cell = (rnn.LSTMCell(H, prefix="l0_") if mode == "lstm"
+            else rnn.GRUCell(H, prefix="l0_"))
+    outputs, _ = cell.unroll(T, sym.Variable("x"),
+                             cell.begin_state(batch_size=B),
+                             layout="NTC", merge_outputs=True)
+    out = _bind_forward(outputs, {
+        "x": x, "l0_i2h_weight": wi, "l0_i2h_bias": bi,
+        "l0_h2h_weight": wh, "l0_h2h_bias": bh})[0]
+
+    gcell = (gluon.rnn.LSTMCell(H, input_size=I) if mode == "lstm"
+             else gluon.rnn.GRUCell(H, input_size=I))
+    gcell.initialize()
+    params = gcell.collect_params()
+    for k, v in {"i2h_weight": wi, "i2h_bias": bi,
+                 "h2h_weight": wh, "h2h_bias": bh}.items():
+        [p for n, p in params.items() if n.endswith(k)][0].set_data(
+            nd.array(v))
+    states = gcell.begin_state(batch_size=B)
+    gouts = []
+    for t in range(T):
+        o, states = gcell(nd.array(x[:, t]), states)
+        gouts.append(o.asnumpy())
+    np.testing.assert_allclose(out, np.stack(gouts, axis=1), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_fused_cell_matches_unfused():
+    """FusedRNNCell (RNN op / lax.scan) == its unfuse() stack, with the
+    packed parameter vector mapped onto the unfused weight names."""
+    rng = np.random.RandomState(2)
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_")
+    x = _rand((B, T, I), rng)
+    wi, wh = _rand((4 * H, I), rng), _rand((4 * H, H), rng)
+    bi, bh = _rand((4 * H,), rng), _rand((4 * H,), rng)
+    packed = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+    assert packed.size == fused.param_size(I)
+
+    outputs, _ = fused.unroll(T, sym.Variable("x"),
+                              fused.begin_state(batch_size=B),
+                              layout="NTC", merge_outputs=True)
+    fout = _bind_forward(outputs, {"x": x, "f_parameters": packed})[0]
+
+    unfused = fused.unfuse()
+    outputs2, _ = unfused.unroll(T, sym.Variable("x"),
+                                 unfused.begin_state(batch_size=B),
+                                 layout="NTC", merge_outputs=True)
+    uout = _bind_forward(outputs2, {
+        "x": x, "f_l0_i2h_weight": wi, "f_l0_i2h_bias": bi,
+        "f_l0_h2h_weight": wh, "f_l0_h2h_bias": bh})[0]
+    np.testing.assert_allclose(fout, uout, rtol=2e-5, atol=2e-5)
+
+
+def test_sequential_and_residual_cells():
+    rng = np.random.RandomState(3)
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.RNNCell(I, prefix="s0_"))   # same width for the residual
+    stack.add(rnn.ResidualCell(rnn.RNNCell(I, prefix="s1_")))
+    outputs, states = stack.unroll(T, sym.Variable("x"),
+                                   stack.begin_state(batch_size=B),
+                                   layout="NTC", merge_outputs=True)
+    args = {"x": _rand((B, T, I), rng)}
+    for p in ("s0_", "s1_"):
+        args.update({f"{p}i2h_weight": _rand((I, I), rng),
+                     f"{p}i2h_bias": np.zeros(I),
+                     f"{p}h2h_weight": _rand((I, I), rng),
+                     f"{p}h2h_bias": np.zeros(I)})
+    out = _bind_forward(outputs, args)[0]
+    assert out.shape == (B, T, I) and np.isfinite(out).all()
+
+
+def test_residual_cell_is_sum():
+    rng = np.random.RandomState(4)
+    res = rnn.ResidualCell(rnn.RNNCell(I, prefix="r_"))
+    base = rnn.RNNCell(I, prefix="r_")
+    x = sym.Variable("x")
+    weights = {"r_i2h_weight": _rand((I, I), rng), "r_i2h_bias": np.zeros(I),
+               "r_h2h_weight": _rand((I, I), rng), "r_h2h_bias": np.zeros(I)}
+    xval = _rand((B, T, I), rng)
+    out_res, _ = res.unroll(T, x, res.begin_state(batch_size=B),
+                            merge_outputs=True)
+    vres = _bind_forward(out_res, dict(weights, x=xval))[0]
+    out_base, _ = base.unroll(T, x, base.begin_state(batch_size=B),
+                              merge_outputs=True)
+    vbase = _bind_forward(out_base, dict(weights, x=xval))[0]
+    np.testing.assert_allclose(vres, vbase + xval, rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_cell_shapes():
+    rng = np.random.RandomState(5)
+    bi = rnn.BidirectionalCell(rnn.GRUCell(H, prefix="fw_"),
+                               rnn.GRUCell(H, prefix="bw_"))
+    outputs, states = bi.unroll(T, sym.Variable("x"),
+                                bi.begin_state(batch_size=B),
+                                layout="NTC", merge_outputs=True)
+    args = {"x": _rand((B, T, I), rng)}
+    for p in ("fw_", "bw_"):
+        args.update({f"{p}i2h_weight": _rand((3 * H, I), rng),
+                     f"{p}i2h_bias": np.zeros(3 * H),
+                     f"{p}h2h_weight": _rand((3 * H, H), rng),
+                     f"{p}h2h_bias": np.zeros(3 * H)})
+    out = _bind_forward(outputs, args)[0]
+    assert out.shape == (B, T, 2 * H)
+    assert len(states) == 2
+
+
+def test_lstm_forget_bias_honored_by_module():
+    """Variable(init=...) attr flows through Module.init_params: the i2h
+    bias forget block comes up at forget_bias, everything else 0."""
+    cell = rnn.LSTMCell(H, prefix="fb_", forget_bias=2.5)
+    outputs, _ = cell.unroll(3, sym.Variable("data"),
+                             cell.begin_state(batch_size=B),
+                             merge_outputs=True)
+    mod = mx.mod.Module(outputs, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (B, 3, I))])
+    mod.init_params(initializer=mx.init.Zero())
+    args, _ = mod.get_params()
+    bias = args["fb_i2h_bias"].asnumpy()
+    expect = np.zeros(4 * H, np.float32)
+    expect[H:2 * H] = 2.5
+    np.testing.assert_allclose(bias, expect)
+
+
+def test_dropout_cell_inference_identity():
+    cell = rnn.SequentialRNNCell()
+    cell.add(rnn.DropoutCell(0.5, prefix="do_"))
+    outputs, _ = cell.unroll(T, sym.Variable("x"), begin_state=[],
+                             merge_outputs=True)
+    rng = np.random.RandomState(6)
+    x = _rand((B, T, I), rng)
+    out = _bind_forward(outputs, {"x": x})[0]  # eval mode: identity
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# namespaces + sym.contrib parity
+# ---------------------------------------------------------------------------
+
+def test_namespace_aliases():
+    assert mx.lr_scheduler.FactorScheduler is \
+        mx.optimizer.lr_scheduler.FactorScheduler
+    assert mx.executor.Executor is mx.symbol.executor.Executor
+    assert mx.attribute.AttrScope is mx.AttrScope
+    assert mx.contrib.nd is mx.nd.contrib
+    assert mx.contrib.sym is mx.sym.contrib
+    assert mx.util.is_np_shape() and mx.util.is_np_array()
+    reg = mx.registry.get_register_func(object, "thing")
+    create = mx.registry.get_create_func(object, "thing")
+
+    class Thing:
+        pass
+    reg(Thing, "a_thing")
+    assert isinstance(create("a_thing"), Thing)
+
+
+def test_sym_contrib_multibox_matches_nd():
+    rng = np.random.RandomState(7)
+    feat = rng.randn(1, 8, 4, 6).astype(np.float32)
+    s = sym.Variable("feat")
+    prior_s = mx.sym.contrib.MultiBoxPrior(s, sizes=(0.4, 0.8),
+                                           ratios=(1.0, 2.0))
+    out = _bind_forward(prior_s, {"feat": feat})[0]
+    ref = mx.nd.contrib.MultiBoxPrior(nd.array(feat), sizes=(0.4, 0.8),
+                                      ratios=(1.0, 2.0)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    anchors = ref                                     # (1, A, 4)
+    A = anchors.shape[1]
+    cls_prob = np.abs(rng.randn(2, 3, A).astype(np.float32))
+    cls_prob /= cls_prob.sum(axis=1, keepdims=True)
+    loc_pred = rng.randn(2, A * 4).astype(np.float32) * 0.1
+    det_s = mx.sym.contrib.MultiBoxDetection(
+        sym.Variable("cp"), sym.Variable("lp"), sym.Variable("anc"))
+    det = _bind_forward(det_s, {"cp": cls_prob, "lp": loc_pred,
+                                "anc": anchors})[0]
+    dref = mx.nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors)).asnumpy()
+    np.testing.assert_allclose(det, dref, rtol=1e-5, atol=1e-6)
+
+
+def test_sym_contrib_box_nms_matches_nd():
+    rng = np.random.RandomState(8)
+    boxes = np.abs(rng.rand(10, 6)).astype(np.float32)
+    out = _bind_forward(mx.sym.contrib.box_nms(sym.Variable("b"),
+                                               overlap_thresh=0.5),
+                        {"b": boxes})[0]
+    ref = mx.nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_sym_slice_and_elemwise():
+    a = sym.Variable("a")
+    out = sym.slice(a, begin=(1, 0), end=(3, 2))
+    v = _bind_forward(out, {"a": np.arange(12).reshape(4, 3)})[0]
+    np.testing.assert_array_equal(v, np.arange(12).reshape(4, 3)[1:3, 0:2])
+    s = sym.elemwise_add(a, a)
+    v2 = _bind_forward(s, {"a": np.ones((2, 2))})[0]
+    np.testing.assert_allclose(v2, 2 * np.ones((2, 2)))
+
+
+def test_multibox_prior_clip():
+    feat = np.zeros((1, 4, 2, 2), np.float32)
+    unclipped = mx.nd.contrib.MultiBoxPrior(nd.array(feat),
+                                            sizes=(1.4,)).asnumpy()
+    clipped = mx.nd.contrib.MultiBoxPrior(nd.array(feat), sizes=(1.4,),
+                                          clip=True).asnumpy()
+    assert unclipped.min() < 0 and clipped.min() >= 0 and clipped.max() <= 1
+
+
+def test_variable_shape_and_init_attrs_flow_to_module():
+    """Variable(shape=..., init=<instance>) participates in shape inference
+    and Module.init_params recreates the initializer with its params."""
+    x = sym.Variable("data")
+    w = sym.Variable("w", shape=(I, 4), init=mx.init.Constant(5.0))
+    out = sym.dot(x, w)
+    mod = mx.mod.Module(out, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (B, I))], label_shapes=None)
+    mod.init_params()
+    args, _ = mod.get_params()
+    np.testing.assert_allclose(args["w"].asnumpy(), 5.0)
+
+
+def test_registry_shares_builtin_registries():
+    create = mx.registry.get_create_func(mx.optimizer.Optimizer, "optimizer")
+    o = create("sgd", learning_rate=0.5)
+    assert isinstance(o, mx.optimizer.SGD) and o.learning_rate == 0.5
