@@ -25,14 +25,25 @@ class Initializer:
     def to_attr_str(self):
         """Serialize for the Variable __init__ attr (json name+params form
         that Module.init_params re-creates; reference dumps initializers
-        the same way for InitDesc dispatch)."""
+        the same way for InitDesc dispatch). Values are coerced where
+        possible (numpy scalars, tuples); only individually unserializable
+        values are dropped."""
         import json
-        params = {k: v for k, v in vars(self).items()
-                  if not k.startswith("_")}
-        try:
-            json.dumps(params)
-        except TypeError:
-            params = {}
+        params = {}
+        for k, v in vars(self).items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (np.floating, np.integer)):
+                v = v.item()
+            elif isinstance(v, tuple):
+                v = list(v)
+            elif isinstance(v, np.ndarray):
+                v = v.tolist()
+            try:
+                json.dumps(v)
+            except TypeError:
+                continue
+            params[k] = v
         return json.dumps({"name": type(self).__name__.lower(),
                            "params": params})
 

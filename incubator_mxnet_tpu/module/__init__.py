@@ -344,16 +344,19 @@ class Module(BaseModule):
 
 
 
+_attr_initializer_create = None
+
+
 def _init_from_attr(attr):
-    """Variable __init__ attr -> initializer: a registered name
-    ('xavier') or the json form '{"name": ..., "params": {...}}'
-    that Initializer.to_attr_str emits."""
-    s = str(attr)
-    if s.startswith("{"):
-        import json
-        spec = json.loads(s)
-        return init_mod.create(spec["name"], **spec.get("params", {}))
-    return init_mod.create(s)
+    """Variable __init__ attr -> initializer, via the shared
+    mx.registry create (handles registered names and the json form
+    Initializer.to_attr_str emits)."""
+    global _attr_initializer_create
+    if _attr_initializer_create is None:
+        from .. import registry as _registry
+        _attr_initializer_create = _registry.get_create_func(
+            init_mod.Initializer, "initializer")
+    return _attr_initializer_create(str(attr))
 
 
 def _is_special(name):

@@ -20,6 +20,21 @@ from jax import lax
 GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
 
+def packed_param_size(mode, num_layers, bidirectional, input_size, hidden):
+    """Length of the flat packed parameter vector (reference rnn-inl.h
+    layout: all i2h/h2h weights in (layer, dir) order, then all biases).
+    Single source of truth for FusedRNNCell.param_size and the RNN op's
+    shape-inference hint."""
+    G = GATES[mode]
+    D = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        il = input_size if layer == 0 else D * hidden
+        size += D * (G * hidden * il + G * hidden * hidden)
+    size += num_layers * D * 2 * G * hidden
+    return size
+
+
 def rnn_cell_step(mode, x, states, wi, wh, bi, bh):
     """One timestep. states: tuple of arrays (N, H). Returns (out, states)."""
     if mode in ("rnn_relu", "rnn_tanh"):

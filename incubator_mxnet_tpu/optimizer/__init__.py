@@ -466,3 +466,118 @@ class DCASGD(Optimizer):
         comp = g + self.lamda * g * g * (w - prev_w)
         mom = self.momentum * mom - lr * comp
         return w + mom, (mom, w)
+
+
+@register("adamax")
+class Adamax(Optimizer):
+    """Adam with an infinity-norm second moment (parity:
+    mx.optimizer.Adamax / AdaMax paper §7.1)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight_raw):
+        return (jnp.zeros(weight_raw.shape, jnp.float32),
+                jnp.zeros(weight_raw.shape, jnp.float32))  # (m, u)
+
+    def _update(self, w, g, state, lr, wd, t):
+        m, u = state
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        lr_t = lr / (1 - self.beta1 ** tf)
+        return w - lr_t * m / (u + self.epsilon), (m, u)
+
+
+@register("nadam")
+class Nadam(Optimizer):
+    """Adam with Nesterov momentum and the warming momentum schedule
+    (parity: mx.optimizer.Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight_raw):
+        return (jnp.zeros(weight_raw.shape, jnp.float32),   # m
+                jnp.zeros(weight_raw.shape, jnp.float32),   # v
+                jnp.ones((), jnp.float32))                  # m_schedule
+
+    def _update(self, w, g, state, lr, wd, t):
+        m, v, m_schedule = state
+        g = g + wd * w
+        tf = t.astype(jnp.float32)
+        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (tf * self.schedule_decay))
+        mom_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((tf + 1)
+                                                    * self.schedule_decay))
+        m_schedule = m_schedule * mom_t
+        m_schedule_next = m_schedule * mom_t1
+        g_prime = g / (1.0 - m_schedule)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        m_prime = m / (1.0 - m_schedule_next)
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        v_prime = v / (1.0 - self.beta2 ** tf)
+        m_bar = (1.0 - mom_t) * g_prime + mom_t1 * m_prime
+        return (w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon),
+                (m, v, m_schedule))
+
+
+@register("ftml")
+class FTML(Optimizer):
+    """Follow the Moving Leader (parity: mx.optimizer.FTML /
+    src/operator/optimizer_op ftml_update)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight_raw):
+        return (jnp.zeros(weight_raw.shape, jnp.float32),   # d
+                jnp.zeros(weight_raw.shape, jnp.float32),   # v
+                jnp.zeros(weight_raw.shape, jnp.float32))   # z
+
+    def _update(self, w, g, state, lr, wd, t):
+        d, v, z = state
+        g = g + wd * w
+        tf = t.astype(jnp.float32)
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** tf) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** tf)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d_t, (d_t, v, z)
+
+
+@register("lars")
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling: per-tensor trust ratio
+    eta*||w||/(||g|| + wd*||w||) scales the SGD-momentum step (parity:
+    mx.contrib LARS optimizer; the large-batch companion of LAMB)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight_raw):
+        return (jnp.zeros(weight_raw.shape, jnp.float32),)
+
+    def _update(self, w, g, state, lr, wd, t):
+        (mom,) = state
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0)
+        g = g + wd * w
+        mom = self.momentum * mom + trust * lr * g
+        return w - mom, (mom,)

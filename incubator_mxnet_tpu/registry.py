@@ -16,23 +16,33 @@ __all__ = ["get_register_func", "get_alias_func", "get_create_func"]
 _KINDS: dict[str, _Registry] = {}
 
 
+def _builtin_registry(base_class, nickname):
+    """The subsystem registry for a known kind — only when base_class is
+    that subsystem's own base, so an unrelated class with a colliding
+    nickname gets its own registry."""
+    if nickname == "optimizer":
+        from . import optimizer as _m
+        if base_class is _m.Optimizer:
+            return _m.registry
+    elif nickname == "initializer":
+        from . import initializer as _m
+        if base_class is _m.Initializer:
+            return _m.registry
+    elif nickname == "metric":
+        from . import metric as _m
+        if base_class is _m.EvalMetric:
+            return _m.registry
+    return None
+
+
 def _registry_for(base_class, nickname):
-    reg = _KINDS.get(nickname)
+    key = (base_class, nickname)
+    reg = _KINDS.get(key)
     if reg is None:
         # known kinds share state with their subsystem's registry, like the
         # reference where mx.registry factories back the built-in ones
-        if nickname == "optimizer":
-            from . import optimizer as _m
-            reg = _m.registry
-        elif nickname == "initializer":
-            from . import initializer as _m
-            reg = _m.registry
-        elif nickname == "metric":
-            from . import metric as _m
-            reg = _m.registry
-        else:
-            reg = _Registry(nickname)
-        _KINDS[nickname] = reg
+        reg = _builtin_registry(base_class, nickname) or _Registry(nickname)
+        _KINDS[key] = reg
     return reg
 
 
@@ -81,6 +91,10 @@ def get_create_func(base_class, nickname):
         if args and isinstance(args[0], base_class):
             assert not kwargs and len(args) == 1
             return args[0]
+        if nickname not in kwargs:
+            raise ValueError(
+                f"create_{nickname} needs a name: pass a registered name, "
+                f"a json spec string, an instance, or {nickname}=<name>")
         return reg.create(kwargs.pop(nickname), **kwargs)
 
     create.__name__ = f"create_{nickname}"

@@ -265,8 +265,6 @@ class FusedRNNCell(BaseRNNCell):
     `RNN` op (one lax.scan on device; reference: cuDNN path of
     src/operator/rnn.cc). Only `unroll` is supported, like the reference."""
 
-    _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
-
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
                  prefix=None, params=None):
@@ -283,7 +281,8 @@ class FusedRNNCell(BaseRNNCell):
 
     @property
     def _num_gates(self):
-        return self._GATES[self._mode]
+        from ..ops._rnn import GATES
+        return GATES[self._mode]
 
     def state_info(self, batch_size=0):
         b = self._num_layers * (2 if self._bidirectional else 1)

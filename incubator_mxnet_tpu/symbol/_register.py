@@ -674,18 +674,13 @@ def _rnn_hint(in_shapes, attrs):
     d = in_shapes[0]
     if d is None:
         return None
-    from ..ops._rnn import GATES
+    from ..ops._rnn import packed_param_size
     mode = attrs.get("mode", "lstm")
-    G = GATES[mode]
     H = int(attrs["state_size"])
     L = int(attrs.get("num_layers", 1))
     D = 2 if attrs.get("bidirectional", False) else 1
     T, N, I = d
-    size = 0
-    for layer in range(L):
-        il = I if layer == 0 else D * H
-        size += D * (G * H * il + G * H * H)
-    size += L * D * 2 * G * H
+    size = packed_param_size(mode, L, D == 2, I, H)
     fills = {}
     if len(in_shapes) > 1 and in_shapes[1] is None:
         fills[1] = (size,)
@@ -810,7 +805,7 @@ def _target_fn(rt, a, anc, lab, cp):
     return _box._multibox_target_raw(
         anc, lab, cp, a["overlap_threshold"], a["negative_mining_ratio"],
         a["negative_mining_thresh"], a["ignore_label"],
-        a["minimum_negative_samples"])
+        a["minimum_negative_samples"], jnp.asarray(a["variances"]))
 
 
 register_op("_contrib_MultiBoxTarget", _target_fn,
@@ -820,7 +815,7 @@ register_op("_contrib_MultiBoxTarget", _target_fn,
 def _detection_fn(rt, a, cp, lp, anc):
     return _box._multibox_detection_raw(
         cp, lp, anc, a["threshold"], a["clip"], a["nms_threshold"],
-        a["force_suppress"], a["nms_topk"])
+        a["force_suppress"], a["nms_topk"], jnp.asarray(a["variances"]))
 
 
 register_op("_contrib_MultiBoxDetection", _detection_fn,
@@ -849,8 +844,10 @@ register_op("_contrib_box_iou", _box_iou_fn, ("lhs", "rhs"))
 
 
 def _contrib_MultiBoxPrior(data=None, sizes=(1.0,), ratios=(1.0,),
-                           steps=(-1.0, -1.0), offsets=(0.5, 0.5),
-                           layout="NCHW", clip=False, name=None):
+                           clip=False, steps=(-1.0, -1.0),
+                           offsets=(0.5, 0.5), layout="NCHW", name=None):
+    """Argument order matches the reference op (clip before steps), same
+    as nd.contrib.MultiBoxPrior."""
     return _make_op("_contrib_MultiBoxPrior", [data],
                     _attrs(sizes=tuple(sizes), ratios=tuple(ratios),
                            steps=tuple(steps), offsets=tuple(offsets),
@@ -861,23 +858,28 @@ def _contrib_MultiBoxTarget(anchor=None, label=None, cls_pred=None,
                             overlap_threshold=0.5, ignore_label=-1,
                             negative_mining_ratio=-1,
                             negative_mining_thresh=0.5,
-                            minimum_negative_samples=0, name=None):
+                            minimum_negative_samples=0,
+                            variances=(0.1, 0.1, 0.2, 0.2), name=None):
     return _make_op("_contrib_MultiBoxTarget", [anchor, label, cls_pred],
                     _attrs(overlap_threshold=overlap_threshold,
                            ignore_label=ignore_label,
                            negative_mining_ratio=negative_mining_ratio,
                            negative_mining_thresh=negative_mining_thresh,
-                           minimum_negative_samples=minimum_negative_samples),
+                           minimum_negative_samples=minimum_negative_samples,
+                           variances=tuple(variances)),
                     name)
 
 
 def _contrib_MultiBoxDetection(cls_prob=None, loc_pred=None, anchor=None,
                                threshold=0.01, clip=True, nms_threshold=0.5,
-                               force_suppress=False, nms_topk=-1, name=None):
+                               force_suppress=False,
+                               variances=(0.1, 0.1, 0.2, 0.2),
+                               nms_topk=-1, name=None):
     return _make_op("_contrib_MultiBoxDetection", [cls_prob, loc_pred, anchor],
                     _attrs(threshold=threshold, clip=clip,
                            nms_threshold=nms_threshold,
-                           force_suppress=force_suppress, nms_topk=nms_topk),
+                           force_suppress=force_suppress, nms_topk=nms_topk,
+                           variances=tuple(variances)),
                     name)
 
 
@@ -913,3 +915,61 @@ def _install_sym_contrib():
 
 
 _install_sym_contrib()
+
+
+# ---------------------------------------------------------------------------
+# nd-mirror long tail: the symbol surface reuses the nd implementations
+# verbatim (op fns call the nd function on NDArray-wrapped tracers inside
+# the executor's jit trace — the same machinery hybridized Gluon uses), so
+# sym.<op> and nd.<op> can never diverge. (reference: every nd op has a
+# sym mirror generated from the same C++ op registration.)
+# ---------------------------------------------------------------------------
+
+from ..ndarray import NDArray as _NDW  # noqa: E402
+from .. import ndarray as _nd_mod  # noqa: E402
+
+
+def _reg_nd_mirror(opname, arg_names, n_out=None):
+    def op_fn(rt, a, *raws, _op=opname):
+        nd_fn = getattr(_nd_mod, _op)
+        out = nd_fn(*[_NDW(r) for r in raws], **a)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    register_op(opname, op_fn, arg_names, n_out=n_out)
+
+    n_in = len(arg_names)
+
+    def builder(*args, name=None, _op=opname, _n=n_in, **kwargs):
+        if len(args) > _n:
+            raise TypeError(f"{_op} takes at most {_n} symbol inputs")
+        return _make_op(_op, list(args), _attrs(**kwargs), name)
+
+    builder.__name__ = opname
+    setattr(_sym_mod, opname, builder)
+    return builder
+
+
+for _n in ["ceil", "floor", "trunc", "fix", "rint", "round", "cbrt", "rcbrt",
+           "reciprocal", "gammaln", "erfinv", "expm1", "log1p", "log2",
+           "log10", "sinh", "cosh", "arcsin", "arccos", "arctan", "arcsinh",
+           "arccosh", "arctanh", "softsign", "isnan", "isinf", "logical_not",
+           "gamma", "shape_array", "size_array"]:
+    _reg_nd_mirror(_n, ("data",))
+
+for _n in ["hypot", "arctan2", "logical_and", "logical_or", "logical_xor"]:
+    _reg_nd_mirror(_n, ("lhs", "rhs"))
+
+for _n in ["tile", "repeat", "swapaxes", "reverse", "flip", "diag", "cast",
+           "one_hot", "nansum", "argmin", "norm", "sort", "argsort",
+           "depth_to_space", "space_to_depth", "hard_sigmoid", "pad",
+           "L2Normalization", "SequenceMask"]:
+    _reg_nd_mirror(_n, ("data",))
+
+for _n in ["take", "pick", "gather_nd", "batch_take"]:
+    _reg_nd_mirror(_n, ("data", "indices"))
+
+_reg_nd_mirror("where", ("condition", "x", "y"))
+_reg_nd_mirror("topk", ("data",),
+               n_out=lambda a: 2 if a.get("ret_typ") == "both" else 1)

@@ -142,3 +142,72 @@ def test_optimizer_with_scheduler_in_trainer():
         (w.data() * 1.0).sum().backward()
     tr.step(1)
     assert np.isfinite(w.data().asnumpy()).all()
+
+
+def test_adamax():
+    o = opt.create("adamax", learning_rate=0.002)
+    w = np.array([1.0]); m = np.zeros(1); u = np.zeros(1)
+    ref = w.copy()
+    for t in range(1, 4):
+        g = np.array([2.0])
+        m = 0.9 * m + 0.1 * g
+        u = np.maximum(0.999 * u, np.abs(g))
+        ref = ref - (0.002 / (1 - 0.9 ** t)) * m / (u + 1e-8)
+    got = run_steps(o, [1.0], [[2.0]] * 3)
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_nadam():
+    o = opt.create("nadam", learning_rate=0.001)
+    b1, b2, sd, eps = 0.9, 0.999, 0.004, 1e-8
+    w = np.array([1.0]); m = np.zeros(1); v = np.zeros(1); msch = 1.0
+    ref = w.copy()
+    for t in range(1, 5):
+        g = np.array([0.7])
+        mt = b1 * (1 - 0.5 * 0.96 ** (t * sd))
+        mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * sd))
+        msch = msch * mt
+        msch_next = msch * mt1
+        gp = g / (1 - msch)
+        m = b1 * m + (1 - b1) * g
+        mp = m / (1 - msch_next)
+        v = b2 * v + (1 - b2) * g * g
+        vp = v / (1 - b2 ** t)
+        mbar = (1 - mt) * gp + mt1 * mp
+        ref = ref - 0.001 * mbar / (np.sqrt(vp) + eps)
+    got = run_steps(o, [1.0], [[0.7]] * 4)
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_ftml():
+    o = opt.create("ftml", learning_rate=0.0025)
+    b1, b2, eps, lr = 0.6, 0.999, 1e-8, 0.0025
+    w = np.array([1.0]); d = np.zeros(1); v = np.zeros(1); z = np.zeros(1)
+    ref = w.copy()
+    for t in range(1, 4):
+        g = np.array([1.5])
+        v = b2 * v + (1 - b2) * g * g
+        d_t = (1 - b1 ** t) / lr * (np.sqrt(v / (1 - b2 ** t)) + eps)
+        sigma = d_t - b1 * d
+        z = b1 * z + (1 - b1) * g - sigma * ref
+        ref = -z / d_t
+        d = d_t
+    got = run_steps(o, [1.0], [[1.5]] * 3)
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_lars_trust_ratio():
+    o = opt.create("lars", learning_rate=0.1, momentum=0.0, eta=0.001,
+                   wd=0.01)
+    w0 = np.array([3.0, 4.0])            # ||w|| = 5
+    g0 = np.array([0.6, 0.8])            # ||g|| = 1
+    trust = 0.001 * 5.0 / (1.0 + 0.01 * 5.0 + 1e-9)
+    ref = w0 - trust * 0.1 * (g0 + 0.01 * w0)
+    got = run_steps(o, w0, [g0])
+    assert_close(got, ref, rtol=1e-5)
+
+
+def test_lars_zero_grad_trust_is_one():
+    o = opt.create("lars", learning_rate=0.1, momentum=0.0)
+    got = run_steps(o, [2.0], [[0.0]])
+    assert_close(got, [2.0])

@@ -275,3 +275,32 @@ def test_registry_shares_builtin_registries():
     create = mx.registry.get_create_func(mx.optimizer.Optimizer, "optimizer")
     o = create("sgd", learning_rate=0.5)
     assert isinstance(o, mx.optimizer.SGD) and o.learning_rate == 0.5
+
+
+@pytest.mark.parametrize("op,args,kwargs", [
+    ("ceil", 1, {}), ("floor", 1, {}), ("rint", 1, {}),
+    ("gamma", 1, {}), ("log1p", 1, {}), ("arctanh", 1, {}),
+    ("softsign", 1, {}), ("hypot", 2, {}), ("arctan2", 2, {}),
+    ("tile", 1, {"reps": (2, 1)}), ("repeat", 1, {"repeats": 2, "axis": 1}),
+    ("swapaxes", 1, {"a1": 0, "a2": 1}), ("diag", 1, {"k": 0}),
+    ("cast", 1, {"dtype": "float16"}),
+    ("one_hot", 1, {"depth": 5}),
+    ("nansum", 1, {"axis": 1}), ("argmin", 1, {"axis": 1}),
+    ("norm", 1, {"axis": 1}), ("sort", 1, {"axis": -1, "is_ascend": False}),
+    ("argsort", 1, {"axis": -1}),
+    ("topk", 1, {"k": 2, "ret_typ": "value"}),
+])
+def test_sym_nd_mirror_parity(op, args, kwargs):
+    """sym.<op> executes the nd implementation: outputs must be identical."""
+    rng = np.random.RandomState(11)
+    if op == "one_hot":
+        vals = [rng.randint(0, 5, (3, 4)).astype(np.float32)]
+    else:
+        vals = [np.abs(rng.randn(3, 4)).astype(np.float32) * 0.8 + 0.1
+                for _ in range(args)]
+    syms = [sym.Variable(f"in{i}") for i in range(args)]
+    out_sym = getattr(sym, op)(*syms, **kwargs)
+    got = _bind_forward(out_sym, {f"in{i}": v for i, v in enumerate(vals)})[0]
+    want = getattr(nd, op)(*[nd.array(v) for v in vals], **kwargs)
+    want = want[0] if isinstance(want, (list, tuple)) else want
+    np.testing.assert_allclose(got, want.asnumpy(), rtol=1e-6, atol=1e-6)
