@@ -1,0 +1,97 @@
+"""Classic symbol-API RNN training: mx.rnn cells + BucketingModule +
+BucketSentenceIter (the reference's example/rnn/bucketing workflow,
+rebuilt TPU-first: each bucket length compiles once to its own XLA
+executable; weights are shared across buckets via shared_module).
+
+Toy task: next-token prediction on a synthetic integer language with
+variable-length sentences.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, rnn
+from incubator_mxnet_tpu import symbol as sym
+
+
+def make_sentences(n, vocab, rng):
+    """Deterministic grammar: token_{t+1} = (token_t * 3 + 1) % vocab,
+    lengths 4..12 — learnable by a small LSTM."""
+    out = []
+    for _ in range(n):
+        ln = rng.randint(4, 13)
+        s = [rng.randint(1, vocab)]
+        for _ in range(ln - 1):
+            s.append((s[-1] * 3 + 1) % (vocab - 1) + 1)  # stays in [1, V-1]
+        out.append(s)
+    return out
+
+
+def sym_gen_factory(vocab, embed, hidden, layers, batch_size):
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data=data, input_dim=vocab, output_dim=embed,
+                            name="embed")
+        stack = rnn.SequentialRNNCell()
+        for i in range(layers):
+            stack.add(rnn.LSTMCell(hidden, prefix=f"lstm_l{i}_"))
+        outputs, _ = stack.unroll(seq_len, emb,
+                                  stack.begin_state(batch_size=batch_size),
+                                  layout="NTC", merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_flat = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(pred, label_flat, use_ignore=True,
+                                ignore_label=0, name="softmax")
+        return out, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-sentences", type=int, default=2000)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--embed", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    sentences = make_sentences(args.num_sentences, args.vocab, rng)
+    buckets = [6, 9, 12]
+    train = io.BucketSentenceIter(sentences, args.batch_size,
+                                  buckets=buckets, invalid_label=0,
+                                  label_name="softmax_label")
+
+    mod = mx.mod.BucketingModule(
+        sym_gen_factory(args.vocab, args.embed, args.hidden, args.layers,
+                        args.batch_size),
+        default_bucket_key=train.default_bucket_key)
+    metric = mx.metric.Perplexity(ignore_label=0)
+    mod.fit(train, num_epoch=args.epochs, eval_metric=metric,
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier())
+
+    # report final train perplexity
+    metric.reset()
+    train.reset()
+    for batch in train:
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    name, ppl = metric.get()
+    print(f"final {name}={ppl:.3f}")
+    # the deterministic grammar is fully predictable: perplexity must
+    # approach 1; anything < 2 proves the model learned the transition
+    assert ppl < 2.0, f"perplexity too high: {ppl}"
+
+
+if __name__ == "__main__":
+    main()

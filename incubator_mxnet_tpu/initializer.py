@@ -29,16 +29,21 @@ class Initializer:
         possible (numpy scalars, tuples); only individually unserializable
         values are dropped."""
         import json
+
+        def coerce(v):
+            if isinstance(v, (np.floating, np.integer, np.bool_)):
+                return v.item()
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, (tuple, list)):
+                return [coerce(e) for e in v]
+            return v
+
         params = {}
         for k, v in vars(self).items():
             if k.startswith("_"):
                 continue
-            if isinstance(v, (np.floating, np.integer)):
-                v = v.item()
-            elif isinstance(v, tuple):
-                v = list(v)
-            elif isinstance(v, np.ndarray):
-                v = v.tolist()
+            v = coerce(v)
             try:
                 json.dumps(v)
             except TypeError:

@@ -13,7 +13,7 @@ from .base import _Registry
 
 __all__ = ["get_register_func", "get_alias_func", "get_create_func"]
 
-_KINDS: dict[str, _Registry] = {}
+_KINDS: dict[tuple[type, str], _Registry] = {}
 
 
 def _builtin_registry(base_class, nickname):

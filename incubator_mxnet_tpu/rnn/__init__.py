@@ -294,16 +294,12 @@ class FusedRNNCell(BaseRNNCell):
         return info
 
     def param_size(self, input_size):
-        """Length of the packed parameter vector (rnn-inl.h layout:
-        weights for every (layer, direction), then biases)."""
-        D = 2 if self._bidirectional else 1
-        G, H = self._num_gates, self._num_hidden
-        size = 0
-        for layer in range(self._num_layers):
-            il = input_size if layer == 0 else D * H
-            size += D * (G * H * il + G * H * H)   # i2h + h2h weights
-        size += self._num_layers * D * 2 * G * H   # i2h + h2h biases
-        return size
+        """Length of the packed parameter vector (rnn-inl.h layout —
+        shared helper with the RNN op's shape-inference hint)."""
+        from ..ops._rnn import packed_param_size
+        return packed_param_size(self._mode, self._num_layers,
+                                 self._bidirectional, input_size,
+                                 self._num_hidden)
 
     def __call__(self, inputs, states):
         raise NotImplementedError(

@@ -805,7 +805,8 @@ def _target_fn(rt, a, anc, lab, cp):
     return _box._multibox_target_raw(
         anc, lab, cp, a["overlap_threshold"], a["negative_mining_ratio"],
         a["negative_mining_thresh"], a["ignore_label"],
-        a["minimum_negative_samples"], jnp.asarray(a["variances"]))
+        a["minimum_negative_samples"],
+        jnp.asarray(a.get("variances", (0.1, 0.1, 0.2, 0.2))))
 
 
 register_op("_contrib_MultiBoxTarget", _target_fn,
@@ -815,7 +816,8 @@ register_op("_contrib_MultiBoxTarget", _target_fn,
 def _detection_fn(rt, a, cp, lp, anc):
     return _box._multibox_detection_raw(
         cp, lp, anc, a["threshold"], a["clip"], a["nms_threshold"],
-        a["force_suppress"], a["nms_topk"], jnp.asarray(a["variances"]))
+        a["force_suppress"], a["nms_topk"],
+        jnp.asarray(a.get("variances", (0.1, 0.1, 0.2, 0.2))))
 
 
 register_op("_contrib_MultiBoxDetection", _detection_fn,
@@ -939,12 +941,28 @@ def _reg_nd_mirror(opname, arg_names, n_out=None):
 
     register_op(opname, op_fn, arg_names, n_out=n_out)
 
-    n_in = len(arg_names)
-
-    def builder(*args, name=None, _op=opname, _n=n_in, **kwargs):
-        if len(args) > _n:
-            raise TypeError(f"{_op} takes at most {_n} symbol inputs")
-        return _make_op(_op, list(args), _attrs(**kwargs), name)
+    def builder(*args, name=None, _op=opname, _names=arg_names, **kwargs):
+        ins = list(args)
+        if len(ins) > len(_names):
+            raise TypeError(f"{_op} takes at most {len(_names)} "
+                            f"symbol inputs")
+        # inputs may come as keywords (sym.ceil(data=x)) like every
+        # hand-written builder; route them into the input list in order
+        for i, an in enumerate(_names):
+            if an in kwargs:
+                if i < len(ins):
+                    raise TypeError(
+                        f"{_op}: got multiple values for input {an!r}")
+                if len(ins) != i:
+                    raise TypeError(
+                        f"{_op}: input {an!r} given by keyword but earlier "
+                        f"inputs are missing")
+                ins.append(kwargs.pop(an))
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                raise TypeError(f"{_op}: unexpected Symbol keyword {k!r} "
+                                f"(inputs are {_names})")
+        return _make_op(_op, ins, _attrs(**kwargs), name)
 
     builder.__name__ = opname
     setattr(_sym_mod, opname, builder)

@@ -54,3 +54,10 @@ def test_example_bert_ring():
 def test_example_ssd():
     out = _run("train_ssd_toy.py", "--epochs", "1")
     assert "detect()" in out
+
+
+@pytest.mark.slow
+def test_example_rnn_bucketing():
+    out = _run("train_rnn_bucketing.py", "--num-sentences", "800",
+               "--epochs", "3")
+    assert "perplexity=" in out

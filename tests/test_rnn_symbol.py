@@ -304,3 +304,16 @@ def test_sym_nd_mirror_parity(op, args, kwargs):
     want = getattr(nd, op)(*[nd.array(v) for v in vals], **kwargs)
     want = want[0] if isinstance(want, (list, tuple)) else want
     np.testing.assert_allclose(got, want.asnumpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_sym_mirror_keyword_inputs():
+    """Mirror builders accept keyword Symbol inputs like hand-written ones."""
+    x = sym.Variable("x")
+    out = sym.ceil(data=x)
+    assert out.list_arguments() == ["x"]
+    v = _bind_forward(out, {"x": np.array([[1.2, 2.7]], np.float32)})[0]
+    np.testing.assert_allclose(v, [[2.0, 3.0]])
+    out2 = sym.take(sym.Variable("a"), indices=sym.Variable("i"), axis=0)
+    assert out2.list_arguments() == ["a", "i"]
+    with pytest.raises(TypeError):
+        sym.ceil(bogus=x)
