@@ -20,8 +20,9 @@ from incubator_mxnet_tpu import symbol as sym
 
 
 def make_sentences(n, vocab, rng):
-    """Deterministic grammar: token_{t+1} = (token_t * 3 + 1) % vocab,
-    lengths 4..12 — learnable by a small LSTM."""
+    """Deterministic grammar: token_{t+1} = (token_t*3 + 1) % (vocab-1) + 1
+    (tokens stay in [1, vocab-1]; 0 is the pad/ignore label), lengths
+    4..12 — learnable by a small LSTM."""
     out = []
     for _ in range(n):
         ln = rng.randint(4, 13)

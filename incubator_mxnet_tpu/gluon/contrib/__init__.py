@@ -1,3 +1,4 @@
 """gluon.contrib (parity: python/mxnet/gluon/contrib/) — the extras the
 reference ships outside the core layer set."""
 from . import nn  # noqa: F401
+from . import rnn  # noqa: F401

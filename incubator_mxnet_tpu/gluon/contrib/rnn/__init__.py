@@ -1,0 +1,304 @@
+"""gluon.contrib.rnn (parity: python/mxnet/gluon/contrib/rnn/{rnn_cell,
+conv_rnn_cell}.py): VariationalDropoutCell, LSTMPCell, and the
+convolutional RNN/LSTM/GRU cell family.
+
+TPU-first notes: conv cells run their i2h/h2h convolutions through the
+same XLA conv path as gluon.nn.Conv* (MXU-tiled); under hybridize the
+whole unrolled recurrence fuses into one XLA computation. Variational
+dropout samples its masks once per sequence (per `reset`), so the mask is
+a loop constant XLA hoists out of the unrolled graph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .... import autograd
+from ....ndarray import _apply
+from ....ndarray import random as ndrandom
+from ....ops import _raw
+from ...rnn import RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (locked) dropout around a cell (parity:
+    gluon.contrib.rnn.VariationalDropoutCell): ONE mask per sequence for
+    inputs/states/outputs, reused at every timestep (Gal & Ghahramani),
+    unlike DropoutCell's fresh mask per step."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.reset()
+        return self.base_cell.begin_state(batch_size, func, **kwargs)
+
+    @staticmethod
+    def _mask(rate, like):
+        key = ndrandom._key()
+        return _apply(
+            lambda a: jax.random.bernoulli(key, 1.0 - rate, a.shape)
+            .astype(a.dtype) / (1.0 - rate),
+            [like], name="vdrop_mask")
+
+    def forward(self, inputs, states):
+        if autograd.is_training():
+            if self.drop_inputs:
+                if self._input_mask is None:
+                    self._input_mask = self._mask(self.drop_inputs, inputs)
+                inputs = inputs * self._input_mask
+            if self.drop_states:
+                if self._state_mask is None:
+                    self._state_mask = self._mask(self.drop_states, states[0])
+                states = [states[0] * self._state_mask] + list(states[1:])
+        out, new_states = self.base_cell(inputs, states)
+        if autograd.is_training() and self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, new_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state, layout,
+                              merge_outputs, valid_length=valid_length)
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projected hidden state (parity:
+    gluon.contrib.rnn.LSTMPCell / LSTMP of Sak et al.): the recurrent
+    state is r = h @ W_proj, shrinking the h2h matmul from HxH to HxP."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        raws = [inputs] + list(states)
+
+        def f(x, r, c, wi, wh, wr, bi, bh):
+            pre = x @ wi.T + bi + r @ wh.T + bh
+            i, fg, g, o = jnp.split(pre, 4, axis=-1)
+            c2 = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            r2 = h2 @ wr.T
+            return r2, r2, c2
+
+        outs = _apply(f, raws + [self.i2h_weight.data(),
+                                 self.h2h_weight.data(),
+                                 self.h2r_weight.data(),
+                                 self.i2h_bias.data(),
+                                 self.h2h_bias.data()],
+                      n_out=3, name="lstmp_cell")
+        return outs[0], [outs[1], outs[2]]
+
+
+def _same_pad(kernel, dilate):
+    for k in kernel:
+        if k % 2 == 0:
+            raise ValueError("h2h_kernel must be odd to preserve the "
+                             f"state's spatial shape, got {kernel}")
+    return tuple(d * (k - 1) // 2 for k, d in zip(kernel, dilate))
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    """Shared machinery for the conv cell family (parity:
+    gluon.contrib.rnn._BaseConvRNNCell). Channel-first layouts
+    (NCW / NCHW / NCDHW); input_shape = (C, *spatial) is required, like
+    the reference, so weights and state shapes are static."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 gates, conv_layout, activation="tanh",
+                 i2h_pad=None, i2h_dilate=None, h2h_dilate=None,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        dims = len(conv_layout) - 2
+        def _tup(v, default):
+            if v is None:
+                v = default
+            return (v,) * dims if isinstance(v, int) else tuple(v)
+        self._layout = conv_layout
+        self._input_shape = tuple(input_shape)
+        self._channels = hidden_channels
+        self._gates = gates
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, None)
+        self._h2h_kernel = _tup(h2h_kernel, None)
+        self._i2h_pad = _tup(i2h_pad, 0)
+        self._i2h_dilate = _tup(i2h_dilate, 1)
+        self._h2h_dilate = _tup(h2h_dilate, 1)
+        self._h2h_pad = _same_pad(self._h2h_kernel, self._h2h_dilate)
+        c_in = self._input_shape[0]
+        spatial_in = self._input_shape[1:]
+        self._state_spatial = tuple(
+            (s + 2 * p - d * (k - 1) - 1) + 1
+            for s, p, k, d in zip(spatial_in, self._i2h_pad,
+                                  self._i2h_kernel, self._i2h_dilate))
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(gates * hidden_channels, c_in)
+            + self._i2h_kernel)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(gates * hidden_channels, hidden_channels)
+            + self._h2h_kernel)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(gates * hidden_channels,), init="zeros")
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(gates * hidden_channels,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._channels) + self._state_spatial
+        n = 2 if self._gates == 4 else 1   # lstm carries (h, c)
+        return [{"shape": shape, "__layout__": self._layout}] * n
+
+    def _pre(self, x, h, wi, wh, bi, bh):
+        pi = _raw.conv(x, wi, bi, kernel=self._i2h_kernel,
+                       pad=self._i2h_pad, dilate=self._i2h_dilate,
+                       layout=self._layout)
+        ph = _raw.conv(h, wh, bh, kernel=self._h2h_kernel,
+                       pad=self._h2h_pad, dilate=self._h2h_dilate,
+                       layout=self._layout)
+        return pi, ph
+
+    def _act(self, x):
+        return jax.nn.relu(x) if self._activation == "relu" else jnp.tanh(x)
+
+    def _weights(self):
+        return [self.i2h_weight.data(), self.h2h_weight.data(),
+                self.i2h_bias.data(), self.h2h_bias.data()]
+
+
+class _ConvRNNCell(_ConvRNNCellBase):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_layout, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 1, conv_layout, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        def f(x, h, wi, wh, bi, bh):
+            pi, ph = self._pre(x, h, wi, wh, bi, bh)
+            out = self._act(pi + ph)
+            return out, out
+        outs = _apply(f, [inputs, states[0]] + self._weights(), n_out=2,
+                      name="conv_rnn_cell")
+        return outs[0], [outs[1]]
+
+
+class _ConvLSTMCell(_ConvRNNCellBase):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_layout, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 4, conv_layout, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        def f(x, h, c, wi, wh, bi, bh):
+            pi, ph = self._pre(x, h, wi, wh, bi, bh)
+            pre = pi + ph
+            i, fg, g, o = jnp.split(pre, 4, axis=1)
+            c2 = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * self._act(g)
+            h2 = jax.nn.sigmoid(o) * self._act(c2)
+            return h2, h2, c2
+        outs = _apply(f, [inputs] + list(states) + self._weights(), n_out=3,
+                      name="conv_lstm_cell")
+        return outs[0], [outs[1], outs[2]]
+
+
+class _ConvGRUCell(_ConvRNNCellBase):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 conv_layout, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, 3, conv_layout, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        def f(x, h, wi, wh, bi, bh):
+            pi, ph = self._pre(x, h, wi, wh, bi, bh)
+            ir, iz, inn = jnp.split(pi, 3, axis=1)
+            hr, hz, hn = jnp.split(ph, 3, axis=1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = self._act(inn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+        outs = _apply(f, [inputs, states[0]] + self._weights(), n_out=2,
+                      name="conv_gru_cell")
+        return outs[0], [outs[1]]
+
+
+def _conv_cell_class(kind, dims, layout):
+    base = {"RNN": _ConvRNNCell, "LSTM": _ConvLSTMCell,
+            "GRU": _ConvGRUCell}[kind]
+
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                     h2h_kernel=3, conv_layout=layout, **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, conv_layout, **kwargs)
+
+    Cell.__name__ = f"Conv{dims}D{kind}Cell"
+    Cell.__qualname__ = Cell.__name__
+    Cell.__doc__ = (f"{dims}D convolutional {kind} cell (parity: "
+                    f"gluon.contrib.rnn.Conv{dims}D{kind}Cell); "
+                    f"layout {layout}.")
+    return Cell
+
+
+Conv1DRNNCell = _conv_cell_class("RNN", 1, "NCW")
+Conv2DRNNCell = _conv_cell_class("RNN", 2, "NCHW")
+Conv3DRNNCell = _conv_cell_class("RNN", 3, "NCDHW")
+Conv1DLSTMCell = _conv_cell_class("LSTM", 1, "NCW")
+Conv2DLSTMCell = _conv_cell_class("LSTM", 2, "NCHW")
+Conv3DLSTMCell = _conv_cell_class("LSTM", 3, "NCDHW")
+Conv1DGRUCell = _conv_cell_class("GRU", 1, "NCW")
+Conv2DGRUCell = _conv_cell_class("GRU", 2, "NCHW")
+Conv3DGRUCell = _conv_cell_class("GRU", 3, "NCDHW")
