@@ -45,7 +45,7 @@ class _PhaseTimeout(Exception):
     pass
 
 
-def _arm_hard_watchdog(seconds):
+def _arm_hard_watchdog(seconds, what="bench"):
     """SIGALRM can't interrupt a hang INSIDE a blocking C call (Python only
     runs signal handlers between bytecodes), and backend-init hangs live in
     C. A daemon thread with os._exit is the hard deadline: it emits the
@@ -59,8 +59,8 @@ def _arm_hard_watchdog(seconds):
             "value": 0.0,
             "unit": "images/sec",
             "vs_baseline": 0.0,
-            "error": f"hard watchdog: bench exceeded {seconds}s "
-                     "(backend or compile hang)",
+            "error": f"hard watchdog: {what} exceeded {seconds}s (hang "
+                     "inside a C call; SIGALRM deadlines could not fire)",
         }), flush=True)
         os._exit(3)
 
@@ -101,7 +101,7 @@ def _log(msg):
           flush=True)
 
 
-def acquire_backend(attempts=4, first_delay=3.0,
+def acquire_backend(attempts=6, first_delay=3.0,
                     per_attempt_timeout=180):
     """Backend init through the axon relay is occasionally UNAVAILABLE or
     simply unresponsive (transient tunnel/contention); retry with backoff —
@@ -114,9 +114,15 @@ def acquire_backend(attempts=4, first_delay=3.0,
             with _phase_deadline(per_attempt_timeout, "backend init"):
                 _log(f"backend attempt {i + 1}/{attempts}")
                 devs = jax.devices()
-                # force a real device computation, not just discovery
+                # force a real device computation with a HOST FETCH:
+                # through the axon relay block_until_ready() returns at
+                # enqueue, so only a value fetch proves the chip answers
+                # (a wedged tunnel would otherwise pass this probe and
+                # then burn the whole compile watchdog)
                 import jax.numpy as jnp
-                jnp.zeros((2, 2)).block_until_ready()
+                probe = float(jnp.ones((8, 8)).sum())
+                if probe != 64.0:
+                    raise RuntimeError(f"device probe returned {probe}")
                 _log(f"backend ready: {devs[0]}")
                 return devs
         except Exception as e:  # noqa: BLE001
@@ -180,7 +186,20 @@ def main():
 
     watchdog = _arm_hard_watchdog(
         int(os.environ.get("BENCH_HARD_TIMEOUT", "3300")))
-    acquire_backend()
+    # a wedged relay hangs INSIDE the first device call (C code — the
+    # SIGALRM per-attempt deadline never fires), so a shorter thread-based
+    # watchdog covers init specifically; cancelled once the chip answers.
+    # Default rides just above acquire_backend's worst legitimate span
+    # (attempts * per-attempt timeout + backoff), so it only fires when
+    # the retry loop itself is frozen in C.
+    _init_attempts, _init_per = 6, 180
+    _init_default = _init_attempts * _init_per + 200
+    init_watchdog = _arm_hard_watchdog(
+        int(os.environ.get("BENCH_INIT_TIMEOUT", str(_init_default))),
+        "backend init")
+    acquire_backend(attempts=_init_attempts,
+                    per_attempt_timeout=_init_per)
+    init_watchdog.cancel()
     np.random.seed(0)
     mx.random.seed(0)
 
