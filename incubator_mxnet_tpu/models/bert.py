@@ -12,8 +12,10 @@ TPU-first design decisions:
   XLA softmax path.
 - Everything is a HybridBlock: `hybridize()` compiles the whole encoder into
   one XLA computation; FusedTrainStep fuses fwd+bwd+AdamW into one program.
-- Long sequences: wrap the encoder with parallel.ring_attention (sequence
-  parallelism over a mesh axis) — see parallel/ring_attention.py.
+- Long sequences: two exact sequence-parallel cores via
+  ring=(mesh, axis[, scheme]): scheme "ring" (KV rotation,
+  parallel/ring_attention.py, O(L/n) memory) or "ulysses" (all-to-all
+  head sharding, parallel/ulysses.py, needs num_heads % n == 0).
 """
 from __future__ import annotations
 
@@ -48,7 +50,21 @@ class MultiHeadAttentionCell(HybridBlock):
         self._units = units
         self._num_heads = num_heads
         self._dropout = dropout
-        self._ring = ring    # (mesh, axis): sequence-parallel attention core
+        # (mesh, axis) or (mesh, axis, "ring"|"ulysses"):
+        # sequence-parallel attention core scheme
+        self._ring = ring
+        if ring is not None:
+            scheme = ring[2] if len(ring) > 2 else "ring"
+            if scheme not in ("ring", "ulysses"):
+                raise ValueError(f"unknown sequence-parallel scheme "
+                                 f"{scheme!r}; choose 'ring' or 'ulysses'")
+            if scheme == "ulysses":
+                n = ring[0].shape[ring[1]]
+                if num_heads % n:
+                    raise ValueError(
+                        f"ulysses shards heads: num_heads={num_heads} must "
+                        f"divide by mesh axis {ring[1]}={n} (use 'ring' "
+                        f"otherwise)")
         if ring is not None and dropout > 0.0:
             import warnings
             warnings.warn(
@@ -76,10 +92,15 @@ class MultiHeadAttentionCell(HybridBlock):
         return self.proj(out)
 
     def _ring_core(self, q, k, v):
-        """Long-context core: sequence dim sharded over the mesh 'sp' axis,
-        KV blocks rotate over ICI (parallel/ring_attention.py)."""
-        from ..parallel import ring_attention
-        mesh, axis = self._ring
+        """Long-context core: sequence dim sharded over the mesh 'sp' axis.
+        scheme "ring" rotates KV blocks over ICI
+        (parallel/ring_attention.py); "ulysses" trades the sequence shard
+        for a head shard with two all-to-alls (parallel/ulysses.py)."""
+        from ..parallel import ring_attention, ulysses_attention
+        mesh, axis = self._ring[0], self._ring[1]
+        scheme = self._ring[2] if len(self._ring) > 2 else "ring"
+        core = {"ring": ring_attention,
+                "ulysses": ulysses_attention}[scheme]
         heads = self._num_heads
 
         def f(qr, kr, vr):
@@ -89,9 +110,9 @@ class MultiHeadAttentionCell(HybridBlock):
             def split(t):
                 return t.reshape(b, L, heads, hd).transpose(0, 2, 1, 3)
 
-            o = ring_attention(split(qr), split(kr), split(vr), mesh, axis)
+            o = core(split(qr), split(kr), split(vr), mesh, axis)
             return o.transpose(0, 2, 1, 3).reshape(b, L, d)
-        return _apply(f, [q, k, v], name="ring_self_attention")
+        return _apply(f, [q, k, v], name=scheme + "_self_attention")
 
 
 class PositionwiseFFN(HybridBlock):

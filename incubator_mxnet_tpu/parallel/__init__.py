@@ -10,19 +10,22 @@ all-gather / reduce-scatter) over ICI. Axes convention:
     dp  data parallel        (batch dim)
     tp  tensor parallel      (hidden/heads dims, Megatron-style)
     pp  pipeline parallel    (layer stages, lax.scan + ppermute)
-    sp  sequence parallel    (sequence dim, ring attention)
+    sp  sequence parallel    (sequence dim: ring attention or
+                             Ulysses all-to-all — both exact)
     ep  expert parallel      (MoE experts)
 """
 from .mesh import make_mesh, data_parallel_spec
 from .trainer_step import FusedTrainStep
 from .ring_attention import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention
 from .pipeline import pipeline_apply, spmd_pipeline
 from .moe import moe_gate, moe_ffn, MoEFFN
 from .tensor_parallel import (column_parallel, row_parallel,
                               annotate_bert_tp, annotate_ffn_tp)
 
 __all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep",
-           "ring_attention", "ring_self_attention", "pipeline_apply",
+           "ring_attention", "ring_self_attention",
+           "ulysses_attention", "ulysses_self_attention", "pipeline_apply",
            "spmd_pipeline", "moe_gate", "moe_ffn", "MoEFFN",
            "column_parallel", "row_parallel", "annotate_bert_tp",
            "annotate_ffn_tp"]

@@ -96,11 +96,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *, causal=False,
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def ring_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
-                        causal=False, batch_axis=None):
-    """(B, L, D) self-attention block with ring-parallel core: qkv/out
-    projections run on the local sequence shard (no collective), only the
-    attention core rotates KV."""
+def _self_attention_block(core, x, wqkv, wo, num_heads, mesh, axis, *,
+                          causal=False, batch_axis=None):
+    """Shared (B, L, D) self-attention choreography: local qkv GEMM, head
+    split, a sequence-parallel attention `core` (ring or Ulysses), head
+    merge, local output GEMM. One implementation for both schemes."""
     b, L, d = x.shape
     hd = d // num_heads
     qkv = x @ wqkv                                  # (B, L, 3D) local GEMM
@@ -109,7 +109,17 @@ def ring_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
     def heads(t):
         return t.reshape(b, L, num_heads, hd).transpose(0, 2, 1, 3)
 
-    out = ring_attention(heads(q), heads(k), heads(v), mesh, axis,
-                         causal=causal, batch_axis=batch_axis)
+    out = core(heads(q), heads(k), heads(v), mesh, axis,
+               causal=causal, batch_axis=batch_axis)
     out = out.transpose(0, 2, 1, 3).reshape(b, L, d)
     return out @ wo
+
+
+def ring_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
+                        causal=False, batch_axis=None):
+    """(B, L, D) self-attention block with ring-parallel core: qkv/out
+    projections run on the local sequence shard (no collective), only the
+    attention core rotates KV."""
+    return _self_attention_block(ring_attention, x, wqkv, wo, num_heads,
+                                 mesh, axis, causal=causal,
+                                 batch_axis=batch_axis)

@@ -1,0 +1,104 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second context-parallel scheme next to ring attention (reference
+parity target: DeepSpeed-Ulysses / megatron context parallelism — the
+BytePS-era reference scales sequence length with model parallel tricks;
+this is the TPU-native form): activations arrive sharded over the
+sequence axis; one all-to-all re-shards them over the HEAD axis so every
+device runs ordinary dense attention on full-length sequences for H/n
+heads; a second all-to-all restores sequence sharding.
+
+Trade-off vs ring attention: Ulysses moves the whole hidden state twice
+over ICI but runs the attention core unsharded (best when H >= n and
+kernels like flash attention want full L); ring keeps data resident and
+rotates KV (best at extreme L where even one full-L activation per device
+is too big). Both are exact.
+
+Implementation: `jax.shard_map` + `lax.all_to_all` (tiled over ICI by
+XLA); differentiable end-to-end (all_to_all is its own transpose under
+AD).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_self_attention"]
+
+
+def _attn_core(q, k, v, scale, causal):
+    """Dense softmax attention on (B, h_loc, L, D) with f32 accumulation."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        L, Lk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Lk)[None, :] <= jnp.arange(L)[:, None]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o.astype(q.dtype)
+
+
+def _ulysses_body(q, k, v, *, axis_name, scale, causal):
+    """Per-device body. Shards come in as (B, H, L/n, D); the first
+    all-to-all trades the sequence shard dim for a head shard:
+    (B, H/n, L, D). Attention runs dense, then the inverse all-to-all
+    restores (B, H, L/n, D)."""
+    def seq_to_head(t):
+        # split_axis=1 (heads), concat_axis=2 (sequence): each device ends
+        # with all L for H/n heads
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    o = _attn_core(qh, kh, vh, scale, causal)
+    return head_to_seq(o)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
+                      causal=False, scale=None,
+                      batch_axis: str | None = None):
+    """All-to-all sequence-parallel attention on (B, H, L, D) arrays.
+
+    L sharded over mesh axis `axis` on input AND output; internally heads
+    are sharded instead so the core is ordinary dense attention. Requires
+    H % n == 0 and L % n == 0. Exact: equals single-device softmax
+    attention up to f32 accumulation order; same signature as
+    `ring_attention` so callers can switch schemes with one name.
+    """
+    n = mesh.shape[axis]
+    h, L = q.shape[1], q.shape[2]
+    if h % n:
+        raise ValueError(f"num_heads {h} not divisible by {axis}={n} "
+                         f"(Ulysses shards heads; use ring_attention)")
+    if L % n or k.shape[2] % n:
+        raise ValueError(f"sequence length {L}/{k.shape[2]} not divisible "
+                         f"by {axis}={n}")
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    spec = P(batch_axis, None, axis, None)
+    body = functools.partial(_ulysses_body, axis_name=axis, scale=scale,
+                             causal=causal)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ulysses_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
+                           causal=False, batch_axis=None):
+    """(B, L, D) self-attention block with the Ulysses core: projections
+    run on the local sequence shard, two all-to-alls bracket the dense
+    attention (mirror of `ring_self_attention`)."""
+    from .ring_attention import _self_attention_block
+    return _self_attention_block(ulysses_attention, x, wqkv, wo, num_heads,
+                                 mesh, axis, causal=causal,
+                                 batch_axis=batch_axis)
