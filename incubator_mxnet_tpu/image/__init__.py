@@ -55,14 +55,31 @@ def _wrap(arr, like):
 
 def imdecode(buf, flag=1, to_rgb=True):
     """Decode an encoded (JPEG/PNG/...) buffer to an HWC uint8 NDArray
-    (reference mx.image.imdecode; flag=0 -> grayscale HW1)."""
-    from PIL import Image
+    (reference mx.image.imdecode; flag=0 -> grayscale HW1).
 
+    JPEG streams take the native libjpeg path (runtime.decode_jpeg,
+    GIL-free — the rebuild of the reference's opencv decode in
+    src/io/iter_image_recordio_2.cc); anything else, or a native-path
+    failure, decodes via PIL."""
     if isinstance(buf, NDArray):
         buf = buf.asnumpy().tobytes()
-    img = Image.open(_io.BytesIO(bytes(buf)))
-    img = img.convert("L" if flag == 0 else "RGB")
-    arr = np.asarray(img, dtype=np.uint8)
+    buf = bytes(buf)
+    arr = None
+    if buf[:2] == b"\xff\xd8":          # JPEG magic
+        from .. import runtime as _runtime
+        arr = _runtime.decode_jpeg(buf, channels=3)
+    if arr is None:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB")
+        arr = np.asarray(img, dtype=np.uint8)
+    if flag == 0:
+        # PIL's exact ITU-R 601 integer luma ((19595R+38470G+7471B+2^15)
+        # >> 16), applied to the RGB decode on BOTH paths so grayscale
+        # output is identical whether or not the native decoder built
+        a32 = arr.astype(np.uint32)
+        arr = ((19595 * a32[..., 0] + 38470 * a32[..., 1]
+                + 7471 * a32[..., 2] + 32768) >> 16).astype(np.uint8)
     if not to_rgb and flag != 0:
         arr = arr[..., ::-1]  # reference BGR default when to_rgb=False
     if arr.ndim == 2:

@@ -29,12 +29,33 @@ _build_failed = False
 _build_lock = threading.Lock()
 
 
+def _build_so(src_name, so_path, extra_flags=()):
+    """First-use g++ build of a native component: compiles to a pid-unique
+    temp file and os.replace()s it into place (atomic on POSIX), so
+    concurrent importers (pytest-xdist, DataLoader workers) never observe
+    a partially written .so. Returns the loaded CDLL or None."""
+    if not os.path.exists(so_path):
+        src = os.path.join(_DIR, "src", src_name)
+        tmp = f"{so_path}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                            "-o", tmp, src, *extra_flags],
+                           check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None
+
+
 def _build_and_load():
-    """First-use g++ build of the native runtime. Thread/process safe:
-    compiles to a pid-unique temp file and os.replace()s it into place
-    (atomic on POSIX), guarded by a double-checked lock, so concurrent
-    importers (pytest-xdist, DataLoader workers) never observe a partially
-    written .so."""
+    """Native engine load, guarded by a double-checked lock."""
     global _lib, _build_failed
     if _lib is not None:
         return _lib
@@ -45,24 +66,8 @@ def _build_and_load():
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO):
-            src = os.path.join(_DIR, "src", "runtime.cc")
-            tmp = f"{_SO}.tmp.{os.getpid()}"
-            try:
-                subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC",
-                                "-pthread", "-shared", "-o", tmp, src],
-                               check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _SO)
-            except Exception:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = _build_so("runtime.cc", _SO, ("-pthread",))
+        if lib is None:
             _build_failed = True
             return None
         return _register_and_set(lib)
@@ -465,3 +470,83 @@ class TokenQueue:
             except Exception:
                 pass
             self._h = None
+
+
+# ---------------------------------------------------------------------------
+# native JPEG decode (src/imgdec.cc, its own .so linked against libjpeg):
+# GIL-free decompression for the record-IO pipeline — the rebuild of the
+# reference's opencv decode in src/io/iter_image_recordio_2.cc. Missing
+# toolchain/libjpeg only disables this path; callers fall back to PIL.
+# ---------------------------------------------------------------------------
+
+_IMG_SO = os.path.join(_DIR, "libmxtpu_imgdec.so")
+_img_lib = None
+_img_build_failed = False
+_img_lock = threading.Lock()
+
+
+def _imgdec_lib():
+    global _img_lib, _img_build_failed
+    if _img_lib is not None:
+        return _img_lib
+    if _img_build_failed:
+        return None
+    with _img_lock:
+        if _img_lib is not None or _img_build_failed:
+            return _img_lib
+        lib = _build_so("imgdec.cc", _IMG_SO, ("-ljpeg",))
+        if lib is None:
+            _img_build_failed = True
+            return None
+        lib.mxtpu_jpeg_info.restype = ctypes.c_int
+        lib.mxtpu_jpeg_info.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.mxtpu_jpeg_decode.restype = ctypes.c_int
+        lib.mxtpu_jpeg_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
+        _img_lib = lib
+        return lib
+
+
+def jpeg_decode_available():
+    """True when the native libjpeg decoder built and loaded."""
+    return _imgdec_lib() is not None
+
+
+# PIL's decompression-bomb threshold: the native path enforces the same
+# cap so a crafted header can't trigger a multi-GB allocation
+_MAX_IMAGE_PIXELS = 178956970
+
+
+def decode_jpeg(data, channels=3):
+    """Decode JPEG bytes to an HWC uint8 numpy array via the native
+    decoder (channels: 3=RGB, 1=grayscale via libjpeg's Y channel).
+    Returns None when the native path is unavailable, the stream is
+    corrupt/truncated, or the claimed size exceeds the decompression-bomb
+    cap — callers fall back to PIL."""
+    import numpy as _np
+    lib = _imgdec_lib()
+    if lib is None:
+        return None
+    data = bytes(data)
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.mxtpu_jpeg_info(data, len(data), ctypes.byref(w),
+                           ctypes.byref(h), ctypes.byref(c)) != 0:
+        return None
+    if w.value * h.value > _MAX_IMAGE_PIXELS:
+        return None
+    out = _np.empty((h.value, w.value, channels), _np.uint8)
+    rc = lib.mxtpu_jpeg_decode(
+        data, len(data), out.ctypes.data_as(ctypes.c_void_p),
+        out.nbytes, channels)
+    if rc != 0:
+        return None
+    return out
+
+
+__all__ += ["decode_jpeg", "jpeg_decode_available"]

@@ -1,0 +1,110 @@
+// Native JPEG decode for the IO pipeline (the rebuild's analogue of the
+// reference's opencv decode inside src/io/iter_image_recordio_2.cc):
+// GIL-free libjpeg decompression callable from the prefetch engine's
+// worker threads, so record decode scales across cores instead of
+// serializing on the interpreter.
+//
+// Built as its own shared object (libmxtpu_imgdec.so, linked -ljpeg) so a
+// missing libjpeg only disables this fast path — the Python caller falls
+// back to PIL.
+//
+// API (ctypes):
+//   mxtpu_jpeg_info(buf, len, &w, &h, &c)        -> 0 ok / -1 bad stream
+//   mxtpu_jpeg_decode(buf, len, out, out_len, channels) -> 0 ok / -1
+//     channels: 3 = RGB interleaved, 1 = grayscale. out must hold
+//     w*h*channels bytes (from mxtpu_jpeg_info).
+
+#include <csetjmp>
+#include <cstddef>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+// libjpeg's default error handler calls exit(); trap into longjmp instead
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(mgr->jump, 1);
+}
+
+void silent_output(j_common_ptr) {}  // no stderr spam on partial streams
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_jpeg_info(const unsigned char* buf, size_t len, int* w, int* h,
+                    int* c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *w = static_cast<int>(cinfo.image_width);
+  *h = static_cast<int>(cinfo.image_height);
+  *c = cinfo.num_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+int mxtpu_jpeg_decode(const unsigned char* buf, size_t len,
+                      unsigned char* out, size_t out_len, int channels) {
+  if (channels != 1 && channels != 3) return -1;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = (channels == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+  const size_t stride =
+      static_cast<size_t>(cinfo.output_width) * cinfo.output_components;
+  const size_t need = stride * cinfo.output_height;
+  if (cinfo.output_components != channels || need > out_len) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  // libjpeg treats premature EOF as a WARNING (it injects a fake EOI and
+  // fills with gray) — surface it as failure so corrupt records don't
+  // silently train on garbage (the PIL fallback raises for the same bytes)
+  const long warnings = cinfo.err->num_warnings;
+  jpeg_destroy_decompress(&cinfo);
+  return warnings == 0 ? 0 : -1;
+}
+
+}  // extern "C"

@@ -272,3 +272,39 @@ def test_nd_module_level_surface():
     b = mx.nd.zeros((2, 2))
     a.copyto(b)
     assert np.allclose(b.asnumpy(), a.asnumpy())
+
+
+def test_native_jpeg_decoder_matches_pil():
+    """runtime.decode_jpeg (libjpeg, GIL-free) decodes bit-identically to
+    PIL and fails gracefully on junk (falls back to PIL in imdecode)."""
+    from incubator_mxnet_tpu import runtime
+    import io as _io
+    from PIL import Image
+    if not runtime.jpeg_decode_available():
+        pytest.skip("native jpeg decoder unavailable (no g++/libjpeg)")
+    rng = np.random.RandomState(9)
+    img = rng.randint(0, 255, (32, 24, 3)).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=90)
+    data = buf.getvalue()
+    nat = runtime.decode_jpeg(data)
+    pil = np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+    np.testing.assert_array_equal(nat, pil)
+    gray = runtime.decode_jpeg(data, channels=1)
+    assert gray.shape == (32, 24, 1)
+    assert runtime.decode_jpeg(data[:40]) is None      # cut inside header
+    # cut inside scan data: libjpeg pads with a fake EOI + warning; the
+    # decoder must surface that as failure, not silent garbage
+    assert runtime.decode_jpeg(data[:len(data) // 2]) is None
+    # imdecode grayscale is identical to PIL's convert('L') luma on both
+    # native and fallback paths
+    pil_gray = np.asarray(Image.open(_io.BytesIO(data)).convert("L"))
+    np.testing.assert_array_equal(
+        image.imdecode(data, flag=0).asnumpy()[..., 0], pil_gray)
+    # imdecode routes JPEG through the native path and PNG through PIL
+    d = image.imdecode(data)
+    np.testing.assert_array_equal(d.asnumpy(), pil)
+    png = _io.BytesIO()
+    Image.fromarray(img).save(png, format="PNG")
+    np.testing.assert_array_equal(image.imdecode(png.getvalue()).asnumpy(),
+                                  img)
