@@ -25,7 +25,8 @@ __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "MaxPool3D", "AvgPool1D", "AvgPool2D", "AvgPool3D",
            "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
            "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
-           "Lambda", "HybridLambda", "Identity", "Concatenate"]
+           "Lambda", "HybridLambda", "Identity", "Concatenate",
+           "ReflectionPad2D"]
 
 
 def _pair(x, n):
@@ -529,3 +530,25 @@ GlobalMaxPool3D = _mkpool("GlobalMaxPool3D", "max", 3, True)
 GlobalAvgPool1D = _mkpool("GlobalAvgPool1D", "avg", 1, True)
 GlobalAvgPool2D = _mkpool("GlobalAvgPool2D", "avg", 2, True)
 GlobalAvgPool3D = _mkpool("GlobalAvgPool3D", "avg", 3, True)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input (parity:
+    gluon.nn.ReflectionPad2D / src/operator/pad.cc mode='reflect').
+    padding: int or 4-tuple (left, right, top, bottom)."""
+
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        if len(padding) != 4:
+            raise ValueError("padding must be an int or a 4-tuple "
+                             "(left, right, top, bottom)")
+        self._padding = tuple(int(p) for p in padding)
+
+    def forward(self, x):
+        l, r, t, b = self._padding
+        return _apply(
+            lambda a: jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)),
+                              mode="reflect"),
+            [x], name="reflection_pad2d")

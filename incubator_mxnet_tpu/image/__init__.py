@@ -798,8 +798,13 @@ class ImageDetIter:
                 self._samples.append((("file", path), self._parse_label(lab)))
         else:
             raise ValueError("need path_imgrec or imglist")
-        self._max_objs = max_objs or max(
-            (len(l) for _, l in self._samples), default=1)
+        widest = max((len(l) for _, l in self._samples), default=1)
+        if max_objs is not None and widest > max_objs:
+            raise ValueError(
+                f"max_objs={max_objs} but a record has {widest} objects; "
+                f"raise max_objs/label_pad_width (the reference errors on "
+                f"insufficient label_pad_width rather than dropping boxes)")
+        self._max_objs = max_objs or widest
         if aug_list is None:
             aug_list = CreateDetAugmenter(self.data_shape, **aug_kwargs)
         self.auglist = aug_list

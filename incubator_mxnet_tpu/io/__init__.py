@@ -15,7 +15,8 @@ from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "BucketSentenceIter", "LibSVMIter",
-           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter",
+           "ImageDetRecordIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
 DataDesc.__new__.__defaults__ = (np.float32, "NCHW")
@@ -635,3 +636,19 @@ class LibSVMIter(DataIter):
         return DataBatch([csr], [label], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+def ImageDetRecordIter(path_imgrec=None, batch_size=1, data_shape=(3, 300, 300),
+                       shuffle=False, label_pad_width=None, **kwargs):
+    """Detection record iterator (parity: mx.io.ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc): .rec of images with object-list
+    labels -> batches of (data, (B, max_objs, 5) [cls x0 y0 x1 y1] labels,
+    -1 padded) — the io-namespace spelling of image.ImageDetIter."""
+    from ..image import ImageDetIter
+    max_objs = None
+    if label_pad_width is not None:
+        # reference counts label_pad_width in floats: header(2) + objs*5
+        max_objs = max(1, (int(label_pad_width) - 2) // 5)
+    return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                        path_imgrec=path_imgrec, shuffle=shuffle,
+                        max_objs=max_objs, **kwargs)

@@ -1,7 +1,7 @@
 """Evaluation metrics (parity: python/mxnet/metric.py)."""
 from __future__ import annotations
 
-import numpy as np
+import numpy as _numpy
 
 from .base import _Registry
 from .ndarray import NDArray
@@ -20,7 +20,7 @@ def create(name, *args, **kwargs):
 
 
 def _np(x):
-    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else _numpy.asarray(x)
 
 
 class EvalMetric:
@@ -67,8 +67,8 @@ class Accuracy(EvalMetric):
             label = _np(label)
             if pred.ndim > label.ndim:
                 pred = pred.argmax(axis=self.axis)
-            pred = pred.astype(np.int64).ravel()
-            label = label.astype(np.int64).ravel()
+            pred = pred.astype(_numpy.int64).ravel()
+            label = label.astype(_numpy.int64).ravel()
             self.sum_metric += (pred == label).sum()
             self.num_inst += len(label)
 
@@ -83,8 +83,8 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
             pred = _np(pred)
-            label = _np(label).astype(np.int64).ravel()
-            topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+            label = _np(label).astype(_numpy.int64).ravel()
+            topk = _numpy.argsort(-pred, axis=-1)[:, :self.top_k]
             self.sum_metric += sum(l in t for l, t in zip(label, topk))
             self.num_inst += len(label)
 
@@ -115,8 +115,8 @@ class F1(EvalMetric):
             pred = _np(pred)
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
-            label = _np(label).astype(np.int64).ravel()
-            pred = pred.astype(np.int64).ravel()
+            label = _np(label).astype(_numpy.int64).ravel()
+            pred = pred.astype(_numpy.int64).ravel()
             tp = ((pred == 1) & (label == 1)).sum()
             fp = ((pred == 1) & (label == 0)).sum()
             fn = ((pred == 0) & (label == 1)).sum()
@@ -131,7 +131,7 @@ class F1(EvalMetric):
             return self.name, float("nan")
         if self.average == "micro":
             return self.name, self._f1(self.tp, self.fp, self.fn)
-        return self.name, float(np.mean(self._batch_f1))
+        return self.name, float(_numpy.mean(self._batch_f1))
 
 
 @register("mcc")
@@ -149,8 +149,8 @@ class MCC(EvalMetric):
             pred = _np(pred)
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
-            label = _np(label).astype(np.int64).ravel()
-            pred = pred.astype(np.int64).ravel()
+            label = _np(label).astype(_numpy.int64).ravel()
+            pred = pred.astype(_numpy.int64).ravel()
             self.tp += ((pred == 1) & (label == 1)).sum()
             self.fp += ((pred == 1) & (label == 0)).sum()
             self.fn += ((pred == 0) & (label == 1)).sum()
@@ -159,7 +159,7 @@ class MCC(EvalMetric):
 
     def get(self):
         num = self.tp * self.tn - self.fp * self.fn
-        den = np.sqrt(float((self.tp + self.fp) * (self.tp + self.fn) *
+        den = _numpy.sqrt(float((self.tp + self.fp) * (self.tp + self.fn) *
                             (self.tn + self.fp) * (self.tn + self.fn)))
         return self.name, num / den if den else 0.0
 
@@ -172,7 +172,7 @@ class MAE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
             label, pred = _np(label), _np(pred)
-            self.sum_metric += np.abs(label.reshape(pred.shape) - pred).mean()
+            self.sum_metric += _numpy.abs(label.reshape(pred.shape) - pred).mean()
             self.num_inst += 1
 
 
@@ -184,7 +184,7 @@ class MSE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
             label, pred = _np(label), _np(pred)
-            self.sum_metric += np.square(label.reshape(pred.shape) - pred).mean()
+            self.sum_metric += _numpy.square(label.reshape(pred.shape) - pred).mean()
             self.num_inst += 1
 
 
@@ -195,7 +195,7 @@ class RMSE(MSE):
 
     def get(self):
         name, v = super().get()
-        return name, float(np.sqrt(v))
+        return name, float(_numpy.sqrt(v))
 
 
 @register("ce")
@@ -207,10 +207,10 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
-            label = _np(label).astype(np.int64).ravel()
+            label = _np(label).astype(_numpy.int64).ravel()
             pred = _np(pred)
-            prob = pred[np.arange(len(label)), label]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            prob = pred[_numpy.arange(len(label)), label]
+            self.sum_metric += (-_numpy.log(prob + self.eps)).sum()
             self.num_inst += len(label)
 
 
@@ -228,18 +228,18 @@ class Perplexity(CrossEntropy):
 
     def update(self, labels, preds):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
-            label = _np(label).astype(np.int64).ravel()
+            label = _np(label).astype(_numpy.int64).ravel()
             pred = _np(pred).reshape(len(label), -1)
             mask = (label != self.ignore_label) if self.ignore_label is not None \
-                else np.ones_like(label, bool)
-            prob = pred[np.arange(len(label)), label]
-            self.sum_metric += (-np.log(prob[mask] + 1e-12)).sum()
+                else _numpy.ones_like(label, bool)
+            prob = pred[_numpy.arange(len(label)), label]
+            self.sum_metric += (-_numpy.log(prob[mask] + 1e-12)).sum()
             self.num_inst += mask.sum()
 
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+        return self.name, float(_numpy.exp(self.sum_metric / self.num_inst))
 
 
 @register("pearsonr")
@@ -262,9 +262,9 @@ class PearsonCorrelation(EvalMetric):
     def get(self):
         if not self._labels:
             return self.name, float("nan")
-        l = np.concatenate(self._labels)
-        p = np.concatenate(self._preds)
-        return self.name, float(np.corrcoef(l, p)[0, 1])
+        l = _numpy.concatenate(self._labels)
+        p = _numpy.concatenate(self._preds)
+        return self.name, float(_numpy.corrcoef(l, p)[0, 1])
 
 
 @register("loss")
@@ -308,3 +308,46 @@ class CompositeEvalMetric(EvalMetric):
 
 
 register("composite")(CompositeEvalMetric)
+
+
+class CustomMetric(EvalMetric):
+    """Wrap feval(label, pred) -> float as a metric (reference
+    metric.CustomMetric; `mx.metric.np(f)` builds one from a numpy fn)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+        name = name or getattr(feval, "__name__", "custom")
+        # reference wraps only anonymous callables ('<lambda>')
+        if "<" in name:
+            name = f"custom({name})"
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        if not self._allow_extra_outputs and len(labels) != len(preds):
+            raise ValueError(
+                f"labels/preds count mismatch {len(labels)} vs {len(preds)}"
+                " (pass allow_extra_outputs=True to permit)")
+        for l, p in zip(labels, preds):
+            val = self._feval(_np(l), _np(p))
+            if isinstance(val, tuple):
+                s, n = val
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += val
+                self.num_inst += 1
+
+
+register("custom")(CustomMetric)
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Decorator: numpy feval -> CustomMetric factory (reference metric.np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = name or getattr(numpy_feval, "__name__", "custom")
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -661,6 +661,24 @@ def nansum(x, axis=None, keepdims=False):
     return _unary(lambda a: jnp.nansum(a, axis=_norm_axis(axis), keepdims=keepdims), x, "nansum")
 
 
+def nanprod(x, axis=None, keepdims=False):
+    return _unary(lambda a: jnp.nanprod(a, axis=_norm_axis(axis), keepdims=keepdims), x, "nanprod")
+
+
+def degrees(x):
+    return _unary(jnp.degrees, x, "degrees")
+
+
+def radians(x):
+    return _unary(jnp.radians, x, "radians")
+
+
+def argmax_channel(x):
+    """Parity: mx.nd.argmax_channel — argmax over axis 1, float output."""
+    return _unary(lambda a: jnp.argmax(a, axis=1).astype(jnp.float32), x,
+                  "argmax_channel")
+
+
 def mean(x, axis=None, keepdims=False):
     return _unary(lambda a: jnp.mean(a, axis=_norm_axis(axis), keepdims=keepdims), x, "mean")
 

@@ -507,6 +507,11 @@ def _imgdec_lib():
         lib.mxtpu_jpeg_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int]
+        lib.mxtpu_jpeg_decode_once.restype = ctypes.c_int
+        lib.mxtpu_jpeg_decode_once.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
         _img_lib = lib
         return lib
 
@@ -521,32 +526,42 @@ def jpeg_decode_available():
 _MAX_IMAGE_PIXELS = 178956970
 
 
+_scratch = threading.local()
+
+
 def decode_jpeg(data, channels=3):
     """Decode JPEG bytes to an HWC uint8 numpy array via the native
     decoder (channels: 3=RGB, 1=grayscale via libjpeg's Y channel).
     Returns None when the native path is unavailable, the stream is
-    corrupt/truncated, or the claimed size exceeds the decompression-bomb
-    cap — callers fall back to PIL."""
+    corrupt/truncated, or the size exceeds the decompression-bomb cap —
+    callers fall back to PIL.
+
+    Hot path does ONE native call (single header parse) into a growable
+    per-thread scratch buffer; the pixels are then copied out into an
+    exact-size array (one memcpy, still far cheaper than a reparse)."""
     import numpy as _np
     lib = _imgdec_lib()
     if lib is None:
         return None
     data = bytes(data)
+    buf = getattr(_scratch, "buf", None)
+    if buf is None:
+        buf = _scratch.buf = _np.empty(1 << 20, _np.uint8)  # 1 MiB start
     w = ctypes.c_int()
     h = ctypes.c_int()
-    c = ctypes.c_int()
-    if lib.mxtpu_jpeg_info(data, len(data), ctypes.byref(w),
-                           ctypes.byref(h), ctypes.byref(c)) != 0:
+    for _ in range(2):
+        rc = lib.mxtpu_jpeg_decode_once(
+            data, len(data), buf.ctypes.data_as(ctypes.c_void_p),
+            buf.nbytes, channels, ctypes.byref(w), ctypes.byref(h))
+        if rc == 0:
+            break
+        if rc < 0 or w.value * h.value > _MAX_IMAGE_PIXELS:
+            return None
+        buf = _scratch.buf = _np.empty(rc, _np.uint8)   # grow + retry
+    else:
         return None
-    if w.value * h.value > _MAX_IMAGE_PIXELS:
-        return None
-    out = _np.empty((h.value, w.value, channels), _np.uint8)
-    rc = lib.mxtpu_jpeg_decode(
-        data, len(data), out.ctypes.data_as(ctypes.c_void_p),
-        out.nbytes, channels)
-    if rc != 0:
-        return None
-    return out
+    n = w.value * h.value * channels
+    return buf[:n].reshape(h.value, w.value, channels).copy()
 
 
 __all__ += ["decode_jpeg", "jpeg_decode_available"]

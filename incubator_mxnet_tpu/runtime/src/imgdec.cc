@@ -107,4 +107,56 @@ int mxtpu_jpeg_decode(const unsigned char* buf, size_t len,
   return warnings == 0 ? 0 : -1;
 }
 
+int mxtpu_jpeg_decode_once(const unsigned char* buf, size_t len,
+                           unsigned char* out, size_t out_len, int channels,
+                           int* w, int* h) {
+  // Single-pass decode for the hot record-IO path: ONE header parse.
+  // Returns 0 on success (dims in *w/*h), -1 on a bad/truncated stream,
+  // or the REQUIRED byte count (> 0) when out_len is too small — the
+  // caller grows its scratch buffer and retries (rare).
+  if (channels != 1 && channels != 3) return -1;
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = (channels == 3) ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_calc_output_dimensions(&cinfo);
+  const size_t stride =
+      static_cast<size_t>(cinfo.output_width) * channels;
+  const size_t need = stride * cinfo.output_height;
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  if (need > out_len) {
+    jpeg_destroy_decompress(&cinfo);
+    if (need > static_cast<size_t>(1) << 31) return -1;  // bomb guard
+    return static_cast<int>(need);
+  }
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != channels) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  const long warnings = cinfo.err->num_warnings;
+  jpeg_destroy_decompress(&cinfo);
+  return warnings == 0 ? 0 : -1;
+}
+
 }  // extern "C"

@@ -160,3 +160,42 @@ def test_ravel_unravel_roundtrip():
     np.testing.assert_array_equal(flat.asnumpy(), [2, 4, 11])
     back = mx.nd.unravel_index(flat, shape=(3, 4))
     np.testing.assert_array_equal(back.asnumpy(), pts)
+
+
+def test_degrees_radians_nanprod_argmax_channel():
+    x = nd.array(np.array([np.pi, np.pi / 2], np.float32))
+    np.testing.assert_allclose(mx.nd.degrees(x).asnumpy(), [180.0, 90.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.nd.radians(mx.nd.degrees(x)).asnumpy(), x.asnumpy(), rtol=1e-6)
+    y = nd.array(np.array([[2.0, np.nan], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(mx.nd.nanprod(y).asnumpy(), 24.0)
+    np.testing.assert_allclose(mx.nd.nanprod(y, axis=1).asnumpy(), [2.0, 12.0])
+    z = nd.array(np.array([[[1.0, 9.0], [5.0, 2.0]]], np.float32))  # (1,2,2)
+    np.testing.assert_array_equal(mx.nd.argmax_channel(z).asnumpy(),
+                                  [[1.0, 0.0]])
+
+
+def test_custom_metric_and_np_wrapper():
+    def mse(label, pred):
+        return float(((label - pred) ** 2).mean())
+
+    m = mx.metric.CustomMetric(mse)
+    m.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.0]))
+    name, val = m.get()
+    assert "mse" in name and abs(val - 0.125) < 1e-6
+    m2 = mx.metric.np(mse)
+    m2.update(nd.array([0.0]), nd.array([2.0]))
+    assert abs(m2.get()[1] - 4.0) < 1e-6
+    m3 = mx.metric.create("custom", feval=mse)
+    assert isinstance(m3, mx.metric.CustomMetric)
+
+
+def test_reflection_pad2d():
+    from incubator_mxnet_tpu import gluon
+    pad = gluon.nn.ReflectionPad2D(padding=(1, 1, 2, 0))
+    x = nd.array(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    out = pad(x)
+    ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (2, 0), (1, 1)),
+                 mode="reflect")
+    np.testing.assert_array_equal(out.asnumpy(), ref)
