@@ -423,6 +423,13 @@ class Symbol:
 def _jsonable(d):
     out = {}
     for k, v in d.items():
+        if callable(v):
+            # control-flow subgraph runners (foreach/while_loop/cond)
+            raise NotImplementedError(
+                "graphs containing control-flow ops (sym.contrib.foreach/"
+                "while_loop/cond) cannot be serialized to json yet; "
+                "export the surrounding graph without the loop, or use "
+                "the nd.contrib imperative control flow")
         if isinstance(v, tuple):
             v = list(v)
         out[k] = v

@@ -991,3 +991,253 @@ for _n in ["take", "pick", "gather_nd", "batch_take"]:
 _reg_nd_mirror("where", ("condition", "x", "y"))
 _reg_nd_mirror("topk", ("data",),
                n_out=lambda a: 2 if a.get("ret_typ") == "both" else 1)
+
+
+# ---------------------------------------------------------------------------
+# sym.contrib control flow: foreach / while_loop / cond
+# (reference: mx.sym.contrib control-flow ops, src/operator/control_flow.cc)
+#
+# TPU-first: the Python body builds a SUB-GRAPH once (placeholder Variables
+# stand in for the loop slice/states); execution lowers to lax.scan (with a
+# liveness mask for while_loop) / lax.cond inside the executor's single
+# jitted program, so the loop never unrolls and never leaves the device.
+# Outer-graph symbols the body closes over (weights) become extra node
+# inputs automatically. The loop body runs with its own per-step RNG key
+# threaded through the scan carry (independent dropout masks per step);
+# aux-state updates (BatchNorm moving stats) inside a control-flow body are
+# dropped, as in inference mode.
+# Control-flow graphs are runtime-only: tojson raises (subgraph
+# serialization is not implemented), matching the honest-limitation rule.
+# ---------------------------------------------------------------------------
+
+from . import Variable as _Variable  # noqa: E402
+from . import _Runtime as _SubRuntime  # noqa: E402
+from . import _auto_name as _sym_auto_name  # noqa: E402
+from . import _topo as _sym_topo  # noqa: E402
+from .executor import _graph_runner  # noqa: E402
+
+
+def _as_sym_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _trace_subgraph(build, placeholders):
+    """Call user code on placeholder symbols -> (flat output entries,
+    captured outer symbols). `build` returns a list of Symbols."""
+    outs = build()
+    entries = []
+    for s in outs:
+        entries.extend(s._entries)
+    ph_ids = {id(p._entries[0][0]) for p in placeholders}
+    captured = []
+    seen = set()
+    for node in _sym_topo(entries):
+        if node.is_var and id(node) not in ph_ids and id(node) not in seen:
+            seen.add(id(node))
+            captured.append(node)
+    arg_nodes = [p._entries[0][0] for p in placeholders] + captured
+    runner = _graph_runner(entries, arg_nodes, [])
+    return entries, captured, runner
+
+
+def _foreach_fn(rt, a, *rest):
+    nd_in, ns, nc = a["n_data"], a["n_states"], a["n_captured"]
+    n_out = a["n_out"]
+    data = rest[:nd_in]
+    states0 = rest[nd_in:nd_in + ns]
+    captured = rest[nd_in + ns:nd_in + ns + nc]
+    runner = a["__subgraph__"]
+
+    def step(carry, xs):
+        states, key = carry
+        key, sub = jax.random.split(key)
+        sub_rt = _SubRuntime(rt.is_train, sub)
+        outs, _ = runner(sub_rt, list(xs) + list(states) + list(captured),
+                         [])
+        return (tuple(outs[n_out:]), key), tuple(outs[:n_out])
+
+    (final_states, _), outs = jax.lax.scan(
+        step, (tuple(states0), rt.next_key()), tuple(data))
+    return tuple(outs) + tuple(final_states)
+
+
+register_op("_foreach", _foreach_fn, (),
+            n_out=lambda a: a["n_out"] + a["n_states"])
+
+
+def _contrib_foreach(body, data, init_states, name=None):
+    """out, states = sym.contrib.foreach(body, data, init_states):
+    body(slice, states) -> (outs, new_states); scans over the data's
+    leading axis (reference mx.sym.contrib.foreach). `data` may be one
+    Symbol or a list scanned in lockstep; single (non-list) init_states
+    round-trips as a single state, like the nd.contrib counterpart."""
+    name = name or _sym_auto_name("foreach")
+    single_state = not isinstance(init_states, (list, tuple))
+    single_data = not isinstance(data, (list, tuple))
+    data_list = _as_sym_list(data)
+    init_states = _as_sym_list(init_states)
+    slice_phs = [_Variable(f"__{name}_slice{i}__")
+                 for i in range(len(data_list))]
+    state_phs = [_Variable(f"__{name}_state{i}__")
+                 for i in range(len(init_states))]
+    result = {}
+
+    def build():
+        x_arg = slice_phs[0] if single_data else list(slice_phs)
+        s_arg = state_phs[0] if single_state else list(state_phs)
+        outs, new_states = body(x_arg, s_arg)
+        outs = _as_sym_list(outs)
+        new_states = _as_sym_list(new_states)
+        if len(new_states) != len(init_states):
+            raise ValueError(
+                f"foreach body returned {len(new_states)} states, expected "
+                f"{len(init_states)}")
+        result["n_out"] = len(outs)
+        return outs + new_states
+
+    entries, captured, runner = _trace_subgraph(
+        build, slice_phs + state_phs)
+    cap_syms = [Symbol([(n, 0)]) for n in captured]
+    node_out = _make_op(
+        "_foreach", data_list + init_states + cap_syms,
+        {"n_data": len(data_list), "n_states": len(init_states),
+         "n_captured": len(captured),
+         "n_out": result["n_out"], "__subgraph__": runner}, name)
+    n_out = result["n_out"]
+    outs = [node_out[i] for i in range(n_out)]
+    states = [node_out[i] for i in range(n_out, n_out + len(init_states))]
+    return (outs[0] if n_out == 1 else outs,
+            states[0] if single_state else states)
+
+
+def _while_loop_fn(rt, a, *rest):
+    ns, nc_c, nc_b = a["n_loop_vars"], a["n_cond_captured"], a["n_captured"]
+    max_iter = a["max_iterations"]
+    loop0 = rest[:ns]
+    cond_cap = rest[ns:ns + nc_c]
+    body_cap = rest[ns + nc_c:ns + nc_c + nc_b]
+    cond_runner = a["__cond_subgraph__"]
+    body_runner = a["__subgraph__"]
+    n_out = a["n_out"]
+
+    def cond_val(sub_rt, lv):
+        (c,), _ = cond_runner(sub_rt, list(lv) + list(cond_cap), [])
+        return c.astype(jnp.bool_).reshape(())
+
+    def step(carry, _):
+        lv, key = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        alive = cond_val(_SubRuntime(rt.is_train, k1), lv)
+        outs, _ = body_runner(_SubRuntime(rt.is_train, k2),
+                              list(lv) + list(body_cap), [])
+        step_outs = outs[:n_out]
+        new_lv = outs[n_out:]
+        lv = tuple(jnp.where(alive, n, o) for n, o in zip(new_lv, lv))
+        step_outs = tuple(jnp.where(alive, s, jnp.zeros_like(s))
+                          for s in step_outs)
+        return (lv, key), step_outs
+
+    (final_lv, _), outs = jax.lax.scan(
+        step, (tuple(loop0), rt.next_key()), None, length=max_iter)
+    return tuple(outs) + tuple(final_lv)
+
+
+register_op("_while_loop", _while_loop_fn, (),
+            n_out=lambda a: a["n_out"] + a["n_loop_vars"])
+
+
+def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
+    """outputs, final_loop_vars = sym.contrib.while_loop(cond, func,
+    loop_vars, max_iterations): runs func while cond is true; per-step
+    outputs are stacked over a fixed max_iterations axis (iterations past
+    termination are zero) — the static-shape contract XLA needs, same as
+    the reference's padded outputs."""
+    name = name or _sym_auto_name("while_loop")
+    single_var = not isinstance(loop_vars, (list, tuple))
+    loop_vars = _as_sym_list(loop_vars)
+    phs = [_Variable(f"__{name}_var{i}__") for i in range(len(loop_vars))]
+    result = {}
+
+    def build_cond():
+        return [cond(phs[0] if single_var else list(phs))]
+
+    c_entries, c_captured, c_runner = _trace_subgraph(build_cond, phs)
+
+    def build_body():
+        outs, new_vars = func(phs[0] if single_var else list(phs))
+        outs = _as_sym_list(outs)
+        new_vars = _as_sym_list(new_vars)
+        if len(new_vars) != len(loop_vars):
+            raise ValueError(
+                f"while_loop body returned {len(new_vars)} loop vars, "
+                f"expected {len(loop_vars)}")
+        result["n_out"] = len(outs)
+        return outs + new_vars
+
+    b_entries, b_captured, b_runner = _trace_subgraph(build_body, phs)
+    cap_syms = ([Symbol([(n, 0)]) for n in c_captured]
+                + [Symbol([(n, 0)]) for n in b_captured])
+    node_out = _make_op(
+        "_while_loop", loop_vars + cap_syms,
+        {"n_loop_vars": len(loop_vars), "n_cond_captured": len(c_captured),
+         "n_captured": len(b_captured), "n_out": result["n_out"],
+         "max_iterations": int(max_iterations),
+         "__cond_subgraph__": c_runner, "__subgraph__": b_runner}, name)
+    n_out = result["n_out"]
+    outs = [node_out[i] for i in range(n_out)]
+    final = [node_out[i] for i in range(n_out, n_out + len(loop_vars))]
+    return (outs[0] if n_out == 1 else outs,
+            final[0] if single_var else final)
+
+
+def _cond_fn(rt, a, pred, *rest):
+    nt, ne = a["n_then_captured"], a["n_else_captured"]
+    then_cap = rest[:nt]
+    else_cap = rest[nt:nt + ne]
+    then_runner = a["__subgraph__"]
+    else_runner = a["__else_subgraph__"]
+
+    def then_branch(_):
+        outs, _ = then_runner(rt, list(then_cap), [])
+        return tuple(outs)
+
+    def else_branch(_):
+        outs, _ = else_runner(rt, list(else_cap), [])
+        return tuple(outs)
+
+    return jax.lax.cond(pred.astype(jnp.bool_).reshape(()),
+                        then_branch, else_branch, None)
+
+
+register_op("_cond", _cond_fn, ("pred",), n_out=lambda a: a["n_out"])
+
+
+def _contrib_cond(pred, then_func, else_func, name=None):
+    """sym.contrib.cond(pred, then_func, else_func): lowers to lax.cond —
+    both branches compiled, one executed on device. Branch outputs must
+    match in count/shape (XLA static-shape contract, like the
+    reference)."""
+    name = name or _sym_auto_name("cond")
+    t_entries, t_captured, t_runner = _trace_subgraph(
+        lambda: _as_sym_list(then_func()), [])
+    e_entries, e_captured, e_runner = _trace_subgraph(
+        lambda: _as_sym_list(else_func()), [])
+    n_out = len(t_entries)
+    if n_out != len(e_entries):
+        raise ValueError(f"cond branches return {n_out} vs "
+                         f"{len(e_entries)} outputs; they must match")
+    cap_syms = ([Symbol([(n, 0)]) for n in t_captured]
+                + [Symbol([(n, 0)]) for n in e_captured])
+    node_out = _make_op(
+        "_cond", [pred] + cap_syms,
+        {"n_then_captured": len(t_captured),
+         "n_else_captured": len(e_captured), "n_out": n_out,
+         "__subgraph__": t_runner, "__else_subgraph__": e_runner}, name)
+    return node_out if n_out > 1 else node_out[0]
+
+
+_sym_mod.contrib.foreach = _contrib_foreach
+_sym_mod.contrib.while_loop = _contrib_while_loop
+_sym_mod.contrib.cond = _contrib_cond

@@ -1,0 +1,152 @@
+"""Symbolic control flow (sym.contrib.foreach/while_loop/cond — parity:
+reference tests/python/unittest/test_contrib_control_flow.py). Lowered to
+lax.scan / lax.cond inside the executor's jitted program."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import symbol as sym
+
+
+def test_foreach_cumsum_with_captured_weight():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    init = sym.Variable("s0")
+
+    def body(x, states):
+        s = states[0] + x * w
+        return s, [s]
+
+    outs, states = sym.contrib.foreach(body, data, [init])
+    ex = sym.Group([outs, states[0]]).bind(
+        args={"data": np.arange(6, dtype=np.float32).reshape(3, 2),
+              "w": np.array([1.0, 2.0], np.float32),
+              "s0": np.zeros(2, np.float32)}, grad_req="null")
+    res, final = (o.asnumpy() for o in ex.forward())
+    ref = np.cumsum(np.arange(6).reshape(3, 2) * [1.0, 2.0], axis=0)
+    np.testing.assert_allclose(res, ref)
+    np.testing.assert_allclose(final, ref[-1])
+
+
+def test_foreach_backward_through_scan():
+    """Gradient w.r.t. a captured weight flows through the scan."""
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+
+    def body(x, states):
+        s = states[0] + x * w
+        return s, [s]
+
+    outs, _ = sym.contrib.foreach(body, data, [sym.Variable("s0")])
+    loss = sym.sum(outs)
+    ex = loss.bind(args={"data": np.ones((4, 3), np.float32),
+                         "w": np.full(3, 2.0, np.float32),
+                         "s0": np.zeros(3, np.float32)},
+                   args_grad={"w": np.zeros(3, np.float32)},
+                   grad_req={"w": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+    # d/dw sum_t cumsum(x*w): each x_t*w appears in (T-t) partial sums;
+    # with x=1, grad per element = sum_{t=1..T} t = 10
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), [10.0] * 3)
+
+
+def test_while_loop_doubling():
+    def cond(lv):
+        return sym.broadcast_lesser(lv[0], sym.ones(shape=(1,)) * 100)
+
+    def func(lv):
+        nv = lv[0] * 2
+        return nv, [nv]
+
+    outs, final = sym.contrib.while_loop(cond, func, [sym.Variable("x0")],
+                                         max_iterations=10)
+    ex = sym.Group([outs, final[0]]).bind(
+        args={"x0": np.array([3.0], np.float32)}, grad_req="null")
+    o, f = (t.asnumpy() for t in ex.forward())
+    np.testing.assert_allclose(f, [192.0])            # 3 * 2^6
+    np.testing.assert_allclose(o.ravel()[:6], [6, 12, 24, 48, 96, 192])
+    assert (o.ravel()[6:] == 0).all()                 # padded past stop
+
+
+def test_cond_branches():
+    p = sym.Variable("p")
+    a = sym.Variable("a")
+    out = sym.contrib.cond(p, lambda: a * 2, lambda: a - 1)
+    for pv, want in ((1.0, [10.0]), (0.0, [4.0])):
+        ex = out.bind(args={"p": np.array(pv, np.float32),
+                            "a": np.array([5.0], np.float32)},
+                      grad_req="null")
+        np.testing.assert_allclose(ex.forward()[0].asnumpy(), want)
+
+
+def test_control_flow_tojson_raises():
+    data = sym.Variable("data")
+
+    def body(x, states):
+        return x, [states[0]]
+
+    outs, _ = sym.contrib.foreach(body, data, [sym.Variable("s")])
+    with pytest.raises(NotImplementedError):
+        outs.tojson()
+
+
+def test_foreach_multiple_outputs_and_states():
+    data = sym.Variable("data")
+
+    def body(x, states):
+        s1 = states[0] + x
+        s2 = states[1] * 2
+        return [x * 2, x + 1], [s1, s2]
+
+    outs, states = sym.contrib.foreach(
+        body, data, [sym.Variable("a0"), sym.Variable("b0")])
+    ex = sym.Group(outs + states).bind(
+        args={"data": np.arange(4, dtype=np.float32).reshape(2, 2),
+              "a0": np.zeros(2, np.float32),
+              "b0": np.ones(2, np.float32)}, grad_req="null")
+    o1, o2, s1, s2 = (t.asnumpy() for t in ex.forward())
+    np.testing.assert_allclose(o1, np.arange(4).reshape(2, 2) * 2)
+    np.testing.assert_allclose(o2, np.arange(4).reshape(2, 2) + 1)
+    np.testing.assert_allclose(s1, [2.0, 4.0])
+    np.testing.assert_allclose(s2, [4.0, 4.0])
+
+
+def test_foreach_single_state_and_multi_data():
+    """Reference calling styles: single (non-list) state round-trips as a
+    single Symbol; multiple data sequences scan in lockstep."""
+    data = sym.Variable("data")
+
+    def body(x, s):                       # s is a Symbol, not a list
+        ns = s + x
+        return ns, ns
+
+    out, final = sym.contrib.foreach(body, data, sym.Variable("s0"))
+    assert isinstance(final, sym.Symbol)
+    ex = final.bind(args={"data": np.ones((4, 2), np.float32),
+                          "s0": np.zeros(2, np.float32)}, grad_req="null")
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [4.0, 4.0])
+
+    a, b = sym.Variable("a"), sym.Variable("b")
+
+    def body2(xs, s):
+        return xs[0] + xs[1], s
+
+    outs2, _ = sym.contrib.foreach(body2, [a, b], sym.Variable("z"))
+    ex2 = outs2.bind(args={"a": np.ones((3, 2), np.float32),
+                           "b": np.full((3, 2), 2.0, np.float32),
+                           "z": np.zeros(2, np.float32)}, grad_req="null")
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(),
+                               np.full((3, 2), 3.0))
+
+
+def test_control_flow_auto_names_unique():
+    data = sym.Variable("d")
+
+    def body(x, s):
+        return x, s
+
+    o1, _ = sym.contrib.foreach(body, data, sym.Variable("s1"))
+    o2, _ = sym.contrib.foreach(body, data, sym.Variable("s2"))
+    names = sym.Group([o1, o2]).list_outputs()
+    assert names[0] != names[1]
