@@ -36,8 +36,12 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load_json", "load"]
 # graph model
 # ---------------------------------------------------------------------------
 
+_NODE_SEQ = [0]
+
+
 class _Node:
-    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "user_attrs")
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "user_attrs",
+                 "_seq")
 
     def __init__(self, op, name, attrs=None, inputs=(), is_aux=False):
         self.op = op                    # None for variables
@@ -46,6 +50,10 @@ class _Node:
         self.inputs = list(inputs)      # list of (node, out_index)
         self.is_aux = is_aux            # variable holds auxiliary state
         self.user_attrs = {}            # __attrs__ from user (lr_mult etc.)
+        # creation order: lets control-flow subgraph tracing tell outer
+        # (pre-existing) nodes from ones the loop body just built
+        _NODE_SEQ[0] += 1
+        self._seq = _NODE_SEQ[0]
 
     @property
     def is_var(self):

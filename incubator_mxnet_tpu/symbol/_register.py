@@ -1011,6 +1011,7 @@ _reg_nd_mirror("topk", ("data",),
 # ---------------------------------------------------------------------------
 
 from . import Variable as _Variable  # noqa: E402
+from . import _OPS as _SYM_OPS  # noqa: E402
 from . import _Runtime as _SubRuntime  # noqa: E402
 from . import _auto_name as _sym_auto_name  # noqa: E402
 from . import _topo as _sym_topo  # noqa: E402
@@ -1025,20 +1026,65 @@ def _as_sym_list(x):
 
 def _trace_subgraph(build, placeholders):
     """Call user code on placeholder symbols -> (flat output entries,
-    captured outer symbols). `build` returns a list of Symbols."""
+    captured outer entries, runner).
+
+    Capture is by node CREATION ORDER: every node that existed before
+    `build` ran (weights, but also computed outer symbols like a Dropout
+    output the body closes over) becomes a lifted input — evaluated ONCE
+    in the outer graph and fed into the loop, exactly like the
+    reference's subgraph inputs. Only nodes the body itself builds run
+    per iteration."""
+    from . import _NODE_SEQ
+    mark = _NODE_SEQ[0]
     outs = build()
     entries = []
     for s in outs:
         entries.extend(s._entries)
     ph_ids = {id(p._entries[0][0]) for p in placeholders}
-    captured = []
-    seen = set()
-    for node in _sym_topo(entries):
-        if node.is_var and id(node) not in ph_ids and id(node) not in seen:
-            seen.add(id(node))
-            captured.append(node)
-    arg_nodes = [p._entries[0][0] for p in placeholders] + captured
-    runner = _graph_runner(entries, arg_nodes, [])
+
+    # traverse the body graph, cutting off at outer nodes; record which
+    # (outer_node, out_idx) entries the body actually consumes
+    captured = []            # ordered (node, idx)
+    cap_seen = set()
+    inner_seen = set()
+    inner_order = []
+
+    def visit(node, idx):
+        if id(node) in ph_ids:
+            return
+        if node._seq <= mark:                      # outer: lift this entry
+            if (id(node), idx) not in cap_seen:
+                cap_seen.add((id(node), idx))
+                captured.append((node, idx))
+            return
+        if id(node) in inner_seen:
+            return
+        inner_seen.add(id(node))
+        for n, i in node.inputs:
+            visit(n, i)
+        inner_order.append(node)
+
+    for n, i in entries:
+        visit(n, i)
+
+    ph_ids_list = [id(p._entries[0][0]) for p in placeholders]
+    cap_keys = [(id(n), i) for n, i in captured]
+
+    def runner(rt, arg_raws, _aux_unused):
+        env = {}
+        for nid, raw in zip(ph_ids_list, arg_raws[:len(ph_ids_list)]):
+            env[(nid, 0)] = raw
+        for key, raw in zip(cap_keys, arg_raws[len(ph_ids_list):]):
+            env[key] = raw
+        for node in inner_order:
+            od = _SYM_OPS[node.op]
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            res = od.fn(rt, node.attrs, *ins)
+            res = res if isinstance(res, tuple) else (res,)
+            for i, r in enumerate(res):
+                env[(id(node), i)] = r
+        return tuple(env[(id(n), i)] for n, i in entries), ()
+
     return entries, captured, runner
 
 
@@ -1099,7 +1145,7 @@ def _contrib_foreach(body, data, init_states, name=None):
 
     entries, captured, runner = _trace_subgraph(
         build, slice_phs + state_phs)
-    cap_syms = [Symbol([(n, 0)]) for n in captured]
+    cap_syms = [Symbol([(n, i)]) for n, i in captured]
     node_out = _make_op(
         "_foreach", data_list + init_states + cap_syms,
         {"n_data": len(data_list), "n_states": len(init_states),
@@ -1130,13 +1176,23 @@ def _while_loop_fn(rt, a, *rest):
         lv, key = carry
         key, k1, k2 = jax.random.split(key, 3)
         alive = cond_val(_SubRuntime(rt.is_train, k1), lv)
-        outs, _ = body_runner(_SubRuntime(rt.is_train, k2),
-                              list(lv) + list(body_cap), [])
-        step_outs = outs[:n_out]
-        new_lv = outs[n_out:]
-        lv = tuple(jnp.where(alive, n, o) for n, o in zip(new_lv, lv))
-        step_outs = tuple(jnp.where(alive, s, jnp.zeros_like(s))
-                          for s in step_outs)
+
+        def run_body(args):
+            lv_, k_ = args
+            outs, _ = body_runner(_SubRuntime(rt.is_train, k_),
+                                  list(lv_) + list(body_cap), [])
+            return tuple(outs[n_out:]), tuple(outs[:n_out])
+
+        def skip_body(args):
+            # dead iteration: the body NEVER executes (lax.cond takes one
+            # branch), so out-of-domain math past termination can't
+            # poison values or gradients with NaNs
+            lv_, _ = args
+            shapes = jax.eval_shape(run_body, args)
+            return lv_, tuple(jnp.zeros(s.shape, s.dtype)
+                              for s in shapes[1])
+
+        lv, step_outs = jax.lax.cond(alive, run_body, skip_body, (lv, k2))
         return (lv, key), step_outs
 
     (final_lv, _), outs = jax.lax.scan(
@@ -1177,8 +1233,8 @@ def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
         return outs + new_vars
 
     b_entries, b_captured, b_runner = _trace_subgraph(build_body, phs)
-    cap_syms = ([Symbol([(n, 0)]) for n in c_captured]
-                + [Symbol([(n, 0)]) for n in b_captured])
+    cap_syms = ([Symbol([(n, i)]) for n, i in c_captured]
+                + [Symbol([(n, i)]) for n, i in b_captured])
     node_out = _make_op(
         "_while_loop", loop_vars + cap_syms,
         {"n_loop_vars": len(loop_vars), "n_cond_captured": len(c_captured),
@@ -1228,8 +1284,8 @@ def _contrib_cond(pred, then_func, else_func, name=None):
     if n_out != len(e_entries):
         raise ValueError(f"cond branches return {n_out} vs "
                          f"{len(e_entries)} outputs; they must match")
-    cap_syms = ([Symbol([(n, 0)]) for n in t_captured]
-                + [Symbol([(n, 0)]) for n in e_captured])
+    cap_syms = ([Symbol([(n, i)]) for n, i in t_captured]
+                + [Symbol([(n, i)]) for n, i in e_captured])
     node_out = _make_op(
         "_cond", [pred] + cap_syms,
         {"n_then_captured": len(t_captured),

@@ -150,3 +150,47 @@ def test_control_flow_auto_names_unique():
     o2, _ = sym.contrib.foreach(body, data, sym.Variable("s2"))
     names = sym.Group([o1, o2]).list_outputs()
     assert names[0] != names[1]
+
+
+def test_foreach_closed_over_symbol_evaluated_once():
+    """A computed outer symbol the body closes over (here a Dropout
+    output) is lifted as a loop input: ONE realization, consumed by every
+    step — reference subgraph-input semantics."""
+    w = sym.Variable("w")
+    outer = sym.Dropout(w, p=0.5)
+    data = sym.Variable("data")
+
+    def body(x, states):
+        return x * outer, states
+
+    outs, _ = sym.contrib.foreach(body, data, [sym.Variable("z")])
+    ex = outs.bind(args={"w": np.ones(8, np.float32),
+                         "data": np.ones((4, 8), np.float32),
+                         "z": np.zeros(8, np.float32)}, grad_req="null")
+    r = ex.forward(is_train=True)[0].asnumpy()
+    for t in range(1, 4):
+        np.testing.assert_array_equal(r[t], r[0])
+
+
+def test_while_loop_dead_iterations_cannot_nan_gradients():
+    """Past termination the body must not execute: sqrt leaves its domain
+    at the stopping value, yet value and gradient stay finite (lax.cond
+    guards the body instead of masking its outputs)."""
+    x0 = sym.Variable("x0")
+
+    def cond(lv):
+        return sym.broadcast_lesser(lv[0], sym.ones(shape=(1,)) * 10)
+
+    def func(lv):
+        nv = sym.sqrt(sym.ones(shape=(1,)) * 10 - lv[0]) + lv[0] + 3
+        return nv, [nv]
+
+    _, final = sym.contrib.while_loop(cond, func, [x0], max_iterations=8)
+    loss = sym.sum(final[0])
+    ex = loss.bind(args={"x0": np.array([5.0], np.float32)},
+                   args_grad={"x0": np.zeros(1, np.float32)},
+                   grad_req={"x0": "write"})
+    v = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    g = ex.grad_dict["x0"].asnumpy()
+    assert np.isfinite(v).all() and np.isfinite(g).all()
