@@ -89,3 +89,47 @@ _SNAKE_RE = re.compile(r"(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
 
 def camel_to_snake(name: str) -> str:
     return _SNAKE_RE.sub("_", name).lower()
+
+
+def make_loop_caller(f, n_vars, single):
+    """Resolve the control-flow calling convention for a user cond/func
+    ONCE (reference python/mxnet/ndarray/contrib.py calls f(*loop_vars);
+    this repo's historical convention passes the list as one argument).
+    Returns caller(vars_list) -> f's result.
+
+    - single (loop_vars was not a list): f receives the bare variable.
+    - 1-element list: f receives the list (historical behavior kept —
+      upstream f(*loop_vars) is indistinguishable by signature here).
+    - multi-var: the signature decides. A function that can accept ONE
+      positional argument (e.g. `def f(vs)`, `def f(vs, debug=False)`)
+      keeps the historical list convention; only a function that needs
+      all n (e.g. `def f(a, b)`) is called unpacked, reference style.
+      Ambiguous shapes resolve toward the list convention so existing
+      callers never change behavior.
+    """
+    import inspect
+    if single:
+        return lambda vs: f(vs[0])
+    if n_vars == 1:
+        return lambda vs: f(list(vs))
+    try:
+        sig = inspect.signature(f)
+        pos = [p for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                             p.VAR_POSITIONAL)]
+        if len(pos) == 1 and pos[0].kind == pos[0].VAR_POSITIONAL:
+            unpacked = True      # pure *args: reference style
+        else:
+            try:
+                sig.bind(None)
+                unpacked = False  # accepts a single positional: list style
+            except TypeError:
+                sig.bind(*([None] * n_vars))  # must bind unpacked else raise
+                unpacked = True
+    except TypeError:
+        unpacked = False
+    except ValueError:          # builtins/C callables: assume reference style
+        unpacked = True
+    if unpacked:
+        return lambda vs: f(*vs)
+    return lambda vs: f(list(vs))

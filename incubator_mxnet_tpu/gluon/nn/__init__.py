@@ -535,14 +535,24 @@ GlobalAvgPool3D = _mkpool("GlobalAvgPool3D", "avg", 3, True)
 class ReflectionPad2D(HybridBlock):
     """Reflection padding on H/W of NCHW input (parity:
     gluon.nn.ReflectionPad2D / src/operator/pad.cc mode='reflect').
-    padding: int or 4-tuple (left, right, top, bottom)."""
+    padding: int, the reference's 8-tuple NCHW pad_width
+    (0, 0, 0, 0, top, bottom, left, right), or — as an extension — a
+    4-tuple (left, right, top, bottom)."""
 
     def __init__(self, padding=0, prefix=None, params=None):
         super().__init__(prefix, params)
         if isinstance(padding, int):
             padding = (padding,) * 4
+        elif len(padding) == 8:
+            if any(int(p) != 0 for p in padding[:4]):
+                raise ValueError(
+                    "8-tuple pad_width must not pad N/C axes: leading four "
+                    "entries must be 0, got " + repr(padding))
+            t, b, l, r = (int(p) for p in padding[4:])
+            padding = (l, r, t, b)
         if len(padding) != 4:
-            raise ValueError("padding must be an int or a 4-tuple "
+            raise ValueError("padding must be an int, an NCHW 8-tuple "
+                             "pad_width, or a 4-tuple "
                              "(left, right, top, bottom)")
         self._padding = tuple(int(p) for p in padding)
 

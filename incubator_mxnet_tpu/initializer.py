@@ -37,6 +37,8 @@ class Initializer:
                 return v.tolist()
             if isinstance(v, (tuple, list)):
                 return [coerce(e) for e in v]
+            if isinstance(v, Initializer):   # nested (e.g. FusedRNN inner)
+                return json.loads(v.to_attr_str())
             return v
 
         params = {}
@@ -185,6 +187,58 @@ class LSTMBias(Initializer):
         b = jnp.zeros(shape, dtype)
         n = shape[0] // 4
         return b.at[n:2 * n].set(self.forget_bias)
+
+
+@register("fusedrnn")
+class FusedRNN(Initializer):
+    """Parity: mx.init.FusedRNN (python/mxnet/initializer.py) — initialize
+    a FusedRNNCell's flat packed parameter vector with `init`, then set the
+    LSTM forget-gate biases (gate order i, f, g, o) so fused and unfused
+    cells start from the same effective math: i2h forget bias =
+    forget_bias, h2h forget bias = 0 (the cell step sums bi + bh). Bias
+    offsets need only the vector length: the bias block is the fixed-size
+    tail of the rnn-inl.h packing, independent of the input size."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        elif isinstance(init, dict):    # nested to_attr_str round-trip form
+            init = create(init["name"], **init.get("params", {}))
+        # init=None means DEFERRED: Module.init_params fills it in via
+        # with_inner() with the user's initializer, so attaching a default
+        # FusedRNN attr never overrides an explicit init.Xavier() etc.
+        self.init = init
+        self.num_hidden = int(num_hidden)
+        self.num_layers = int(num_layers)
+        self.mode = mode
+        self.bidirectional = bool(bidirectional)
+        self.forget_bias = float(forget_bias)
+
+    def with_inner(self, inner):
+        """Copy with the deferred inner initializer filled in."""
+        import copy
+        c = copy.copy(self)
+        c.init = inner
+        return c
+
+    def _init(self, key, shape, dtype):
+        from .ops._rnn import GATES
+        inner = self.init if self.init is not None else Uniform(0.07)
+        arr = inner(key, shape, dtype)
+        if self.mode != "lstm":
+            return arr
+        G, H = GATES[self.mode], self.num_hidden
+        L = self.num_layers
+        D = 2 if self.bidirectional else 1
+        bias_size = L * D * 2 * G * H
+        weights_total = shape[0] - bias_size
+        for k in range(L * D):
+            bi_off = weights_total + k * 2 * G * H
+            bh_off = bi_off + G * H
+            arr = arr.at[bi_off + H:bi_off + 2 * H].set(self.forget_bias)
+            arr = arr.at[bh_off + H:bh_off + 2 * H].set(0.0)
+        return arr
 
 
 @register()

@@ -242,6 +242,17 @@ class Module(BaseModule):
                     # Variable(init=...) wins over name rules, like the
                     # reference's InitDesc attr dispatch
                     ini = _init_from_attr(attr_init)
+                    if (isinstance(ini, init_mod.FusedRNN)
+                            and ini.init is None):
+                        # deferred inner: the user's initializer fills the
+                        # packed vector; FusedRNN only stamps the
+                        # forget-gate biases on top
+                        inner = initializer
+                        if isinstance(inner, init_mod.Mixed):
+                            # no-pattern-match raises, same as any other
+                            # parameter under Mixed
+                            inner = inner.init_for(name)
+                        ini = ini.with_inner(inner)
                 elif isinstance(ini, init_mod.Mixed):
                     ini = ini.init_for(name)
                 elif _is_special(name):

@@ -260,6 +260,9 @@ def _as_nd_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+from ..base import make_loop_caller as _make_loop_caller  # noqa: E402
+
+
 def foreach(body, data, init_states):
     """Parity: mx.nd.contrib.foreach (src/operator/control_flow.cc).
     body(data_slice, states) -> (outputs, new_states); iterates over axis 0
@@ -350,6 +353,11 @@ def while_loop(cond, func, loop_vars, max_iterations):
     most max_iterations steps. Outputs are stacked padded to
     max_iterations (reference shape semantics).
 
+    Calling convention: with multiple loop vars both the reference style
+    `def func(a, b)` (called func(*loop_vars)) and this repo's list style
+    `def func(vs)` are supported — the signature decides
+    (base.make_loop_caller).
+
     Eager Python loop while recording (tape/closure gradients exact);
     otherwise a cond-gated lax.scan of static length — XLA-compilable AND
     reverse-mode differentiable (a raw while_loop is not). NOTE (matches
@@ -360,17 +368,19 @@ def while_loop(cond, func, loop_vars, max_iterations):
     lv = _as_nd_list(loop_vars)
     single = not isinstance(loop_vars, (list, tuple))
     n_lv = len(lv)
+    call_cond = _make_loop_caller(cond, n_lv, single)
+    call_func = _make_loop_caller(func, n_lv, single)
 
     if autograd.is_recording():
         cur = loop_vars
         outs_acc = None
         n_steps = 0
         while n_steps < max_iterations:
-            pred = cond(cur)
+            pred = call_cond([cur] if single else _as_nd_list(cur))
             if not bool(np.asarray(pred._data if isinstance(pred, NDArray)
                                    else pred)):
                 break
-            outs, cur = func(cur)
+            outs, cur = call_func([cur] if single else _as_nd_list(cur))
             outs = _as_nd_list(outs)
             if outs_acc is None:
                 outs_acc = [[] for _ in outs]
@@ -390,11 +400,11 @@ def while_loop(cond, func, loop_vars, max_iterations):
         def step(carry, _):
             vars_raw, active = carry
             v_nd = [NDArray(r) for r in vars_raw]
-            pred = cond(v_nd[0] if single else v_nd)
+            pred = call_cond(v_nd)
             pred_raw = pred._data if isinstance(pred, NDArray) else pred
             go = jnp.logical_and(
                 active, jnp.asarray(pred_raw).astype(bool).reshape(()))
-            outs, new_vars = func(v_nd[0] if single else v_nd)
+            outs, new_vars = call_func(v_nd)
             outs = _as_nd_list(outs)
             new_vars = _as_nd_list(new_vars)
             meta["n_out"] = len(outs)
@@ -412,7 +422,7 @@ def while_loop(cond, func, loop_vars, max_iterations):
 
     def _one_step(raws):
         v_nd = [NDArray(r) for r in raws]
-        outs, new_vars = func(v_nd[0] if single else v_nd)
+        outs, new_vars = call_func(v_nd)
         meta["n_out"] = len(_as_nd_list(outs))
         return tuple(o._data for o in _as_nd_list(new_vars))
 

@@ -267,7 +267,7 @@ class FusedRNNCell(BaseRNNCell):
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
-                 prefix=None, params=None):
+                 forget_bias=1.0, prefix=None, params=None):
         if prefix is None:
             prefix = f"{mode}_"
         super().__init__(prefix, params)
@@ -277,7 +277,15 @@ class FusedRNNCell(BaseRNNCell):
         self._bidirectional = bidirectional
         self._dropout = dropout
         self._get_next_state = get_next_state
-        self._param = self.params.get("parameters")
+        self._forget_bias = forget_bias
+        # forget_bias rides the packed-parameter initializer (reference
+        # init.FusedRNN), so fused init matches unfuse()'s LSTMCells
+        from .. import initializer as _init
+        self._param = self.params.get(
+            "parameters",
+            init=_init.FusedRNN(None, num_hidden, num_layers, mode,
+                                bidirectional, forget_bias)
+            if mode == "lstm" else None)
 
     @property
     def _num_gates(self):
@@ -342,7 +350,8 @@ class FusedRNNCell(BaseRNNCell):
         get_cell = {
             "rnn_relu": lambda p: RNNCell(self._num_hidden, "relu", p),
             "rnn_tanh": lambda p: RNNCell(self._num_hidden, "tanh", p),
-            "lstm": lambda p: LSTMCell(self._num_hidden, p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, p,
+                                       forget_bias=self._forget_bias),
             "gru": lambda p: GRUCell(self._num_hidden, p),
         }[self._mode]
         for i in range(self._num_layers):

@@ -1014,14 +1014,15 @@ from . import Variable as _Variable  # noqa: E402
 from . import _OPS as _SYM_OPS  # noqa: E402
 from . import _Runtime as _SubRuntime  # noqa: E402
 from . import _auto_name as _sym_auto_name  # noqa: E402
-from . import _topo as _sym_topo  # noqa: E402
-from .executor import _graph_runner  # noqa: E402
 
 
 def _as_sym_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+from ..base import make_loop_caller as _make_loop_caller  # noqa: E402
 
 
 def _trace_subgraph(build, placeholders):
@@ -1052,7 +1053,12 @@ def _trace_subgraph(build, placeholders):
     def visit(node, idx):
         if id(node) in ph_ids:
             return
-        if node._seq <= mark:                      # outer: lift this entry
+        # Outer nodes are lifted; so are Variables DECLARED inside the
+        # body (seq > mark but is_var) — the reference lifts body-declared
+        # variables as subgraph inputs too, so `sym.Variable('w')` inside
+        # a foreach body binds like any other weight instead of crashing
+        # the runner (it has no op to execute per-iteration).
+        if node._seq <= mark or node.is_var:
             if (id(node), idx) not in cap_seen:
                 cap_seen.add((id(node), idx))
                 captured.append((node, idx))
@@ -1123,6 +1129,8 @@ def _contrib_foreach(body, data, init_states, name=None):
     single_state = not isinstance(init_states, (list, tuple))
     single_data = not isinstance(data, (list, tuple))
     data_list = _as_sym_list(data)
+    if not data_list:
+        raise ValueError("foreach requires non-empty `data`")
     init_states = _as_sym_list(init_states)
     slice_phs = [_Variable(f"__{name}_slice{i}__")
                  for i in range(len(data_list))]
@@ -1209,20 +1217,27 @@ def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
     loop_vars, max_iterations): runs func while cond is true; per-step
     outputs are stacked over a fixed max_iterations axis (iterations past
     termination are zero) — the static-shape contract XLA needs, same as
-    the reference's padded outputs."""
+    the reference's padded outputs.
+
+    Calling convention: with multiple loop vars, cond/func written against
+    upstream MXNet (`def func(a, b)`, called as func(*loop_vars)) AND this
+    repo's list convention (`def func(vs)`) are both supported — the
+    signature decides (see base.make_loop_caller)."""
     name = name or _sym_auto_name("while_loop")
     single_var = not isinstance(loop_vars, (list, tuple))
     loop_vars = _as_sym_list(loop_vars)
     phs = [_Variable(f"__{name}_var{i}__") for i in range(len(loop_vars))]
+    call_cond = _make_loop_caller(cond, len(loop_vars), single_var)
+    call_func = _make_loop_caller(func, len(loop_vars), single_var)
     result = {}
 
     def build_cond():
-        return [cond(phs[0] if single_var else list(phs))]
+        return [call_cond(phs)]
 
     c_entries, c_captured, c_runner = _trace_subgraph(build_cond, phs)
 
     def build_body():
-        outs, new_vars = func(phs[0] if single_var else list(phs))
+        outs, new_vars = call_func(phs)
         outs = _as_sym_list(outs)
         new_vars = _as_sym_list(new_vars)
         if len(new_vars) != len(loop_vars):

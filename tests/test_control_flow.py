@@ -96,3 +96,36 @@ def test_cond_grad():
         loss = y.sum()
     loss.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_while_loop_reference_calling_convention_nd():
+    """Upstream convention: cond/func take the loop vars unpacked."""
+    i0 = nd.array(np.array([0.0], np.float32))
+    a0 = nd.array(np.array([0.0], np.float32))
+
+    def cond_fn(i, acc):
+        return i < 4.0
+
+    def body(i, acc):
+        return i * 10.0, [i + 1.0, acc + i]
+
+    outs, final = mx.nd.contrib.while_loop(cond_fn, body, [i0, a0],
+                                           max_iterations=8)
+    np.testing.assert_allclose(final[0].asnumpy(), [4.0])
+    np.testing.assert_allclose(final[1].asnumpy(), [6.0])
+    o = outs[0].asnumpy().ravel()
+    np.testing.assert_allclose(o[:4], [0.0, 10.0, 20.0, 30.0])
+    np.testing.assert_allclose(o[4:], 0.0)
+
+
+def test_make_loop_caller_convention_matrix():
+    """Convention resolution: list-style funcs (even with extra defaulted
+    params) keep the list; only funcs that NEED all vars unpack."""
+    from incubator_mxnet_tpu.base import make_loop_caller
+    assert make_loop_caller(lambda a, b: (a, b), 2, False)([1, 2]) == (1, 2)
+    assert make_loop_caller(lambda vs: vs, 2, False)([1, 2]) == [1, 2]
+    assert make_loop_caller(
+        lambda vs, debug=False: vs, 2, False)([1, 2]) == [1, 2]
+    assert make_loop_caller(lambda *vs: vs, 2, False)([1, 2]) == (1, 2)
+    assert make_loop_caller(lambda v: v, 1, True)([7]) == 7
+    assert make_loop_caller(lambda vs: vs, 1, False)([7]) == [7]

@@ -199,3 +199,17 @@ def test_reflection_pad2d():
     ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (2, 0), (1, 1)),
                  mode="reflect")
     np.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+def test_reflection_pad2d_reference_8tuple():
+    """The reference's NCHW pad_width form (0,0,0,0,t,b,l,r) maps onto the
+    same padding as the 4-tuple extension."""
+    import pytest
+    from incubator_mxnet_tpu import gluon
+    pad8 = gluon.nn.ReflectionPad2D(padding=(0, 0, 0, 0, 2, 0, 1, 1))
+    x = nd.array(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (2, 0), (1, 1)),
+                 mode="reflect")
+    np.testing.assert_array_equal(pad8(x).asnumpy(), ref)
+    with pytest.raises(ValueError):
+        gluon.nn.ReflectionPad2D(padding=(1, 0, 0, 0, 2, 0, 1, 1))

@@ -317,3 +317,62 @@ def test_sym_mirror_keyword_inputs():
     assert out2.list_arguments() == ["a", "i"]
     with pytest.raises(TypeError):
         sym.ceil(bogus=x)
+
+
+def test_fused_rnn_forget_bias_init():
+    """forget_bias threads into the packed-parameter initializer
+    (reference init.FusedRNN) and into unfuse()'s LSTMCells."""
+    from incubator_mxnet_tpu import initializer as init
+    import jax
+
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="fb_",
+                             forget_bias=2.0)
+    size = fused.param_size(I)
+    fi = init.FusedRNN(init.Zero(), H, 1, "lstm", False, 2.0)
+    packed = np.asarray(fi(jax.random.PRNGKey(0), (size,), "float32"))
+    # layout: wi, wh, then bi, bh; forget gate is slice [H:2H] of each
+    bi = packed[size - 8 * H: size - 4 * H]
+    bh = packed[size - 4 * H:]
+    np.testing.assert_allclose(bi[H:2 * H], 2.0)
+    np.testing.assert_allclose(bh[H:2 * H], 0.0)
+    np.testing.assert_allclose(bi[:H], 0.0)
+
+    cell = fused.unfuse()._cells[0]
+    assert isinstance(cell, rnn.LSTMCell)
+
+
+def test_fused_rnn_init_defers_to_user_initializer():
+    """The auto-attached FusedRNN attr must NOT override the initializer
+    the user passes to init_params: weights come from the user init, only
+    the forget-gate biases are stamped on top."""
+    from incubator_mxnet_tpu import initializer as init
+    import incubator_mxnet_tpu.module as mod
+
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="fb_",
+                             forget_bias=2.0)
+    outs, _ = fused.unroll(T, sym.Variable("x"), layout="NTC",
+                           merge_outputs=True)
+    m = mod.Module(outs, data_names=["x"], label_names=None)
+    m.bind(data_shapes=[("x", (B, T, I))])
+    m.init_params(initializer=init.Zero())
+    packed = m.get_params()[0]["fb_parameters"].asnumpy()
+    sz = packed.size
+    bi = packed[sz - 8 * H: sz - 4 * H]
+    np.testing.assert_allclose(bi[H:2 * H], 2.0)      # forget bias stamped
+    np.testing.assert_allclose(packed[:sz - 8 * H], 0.0)  # Zero honored
+
+
+def test_fused_rnn_init_attr_roundtrip_keeps_inner():
+    """An explicit inner initializer survives the Variable-attr JSON
+    round trip (to_attr_str serializes nested initializers)."""
+    from incubator_mxnet_tpu import initializer as init
+    from incubator_mxnet_tpu.module import _init_from_attr
+    import jax
+
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="rt_")
+    size = fused.param_size(I)
+    fi = init.FusedRNN(init.One(), H, 1, "lstm", False, 3.0)
+    fi2 = _init_from_attr(fi.to_attr_str())
+    a = np.asarray(fi2(jax.random.PRNGKey(0), (size,), "float32"))
+    np.testing.assert_allclose(a[:size - 8 * H], 1.0)
+    np.testing.assert_allclose(a[size - 8 * H + H:size - 8 * H + 2 * H], 3.0)

@@ -194,3 +194,50 @@ def test_while_loop_dead_iterations_cannot_nan_gradients():
     ex.backward()
     g = ex.grad_dict["x0"].asnumpy()
     assert np.isfinite(v).all() and np.isfinite(g).all()
+
+
+def test_foreach_variable_declared_inside_body():
+    """A sym.Variable created INSIDE the body is lifted as a subgraph
+    input (reference lifts body-declared variables too), not executed as
+    an op per iteration."""
+    data = sym.Variable("data")
+    init = sym.Variable("s0")
+
+    def body(x, states):
+        w = sym.Variable("w_inner")          # declared inside the body
+        s = states[0] + x * w
+        return s, [s]
+
+    outs, states = sym.contrib.foreach(body, data, [init])
+    ex = sym.Group([outs, states[0]]).bind(
+        args={"data": np.arange(6, dtype=np.float32).reshape(3, 2),
+              "w_inner": np.array([1.0, 2.0], np.float32),
+              "s0": np.zeros(2, np.float32)}, grad_req="null")
+    res, final = (o.asnumpy() for o in ex.forward())
+    ref = np.cumsum(np.arange(6).reshape(3, 2) * [1.0, 2.0], axis=0)
+    np.testing.assert_allclose(res, ref)
+    np.testing.assert_allclose(final, ref[-1])
+
+
+def test_while_loop_reference_calling_convention():
+    """cond/func written upstream-style — def f(a, b), called as
+    f(*loop_vars) — work alongside this repo's list convention."""
+    i0 = sym.Variable("i0")
+    acc0 = sym.Variable("acc0")
+
+    def cond(i, acc):
+        return sym.broadcast_lesser(i, sym.ones(shape=(1,)) * 4)
+
+    def func(i, acc):
+        return i * 10.0, [i + 1.0, acc + i]
+
+    outs, final = sym.contrib.while_loop(cond, func, [i0, acc0],
+                                         max_iterations=6)
+    ex = sym.Group([outs] + final).bind(
+        args={"i0": np.zeros(1, np.float32),
+              "acc0": np.zeros(1, np.float32)}, grad_req="null")
+    o, fi, facc = (t.asnumpy() for t in ex.forward())
+    np.testing.assert_allclose(fi, [4.0])
+    np.testing.assert_allclose(facc, [6.0])     # 0+1+2+3
+    np.testing.assert_allclose(o.ravel()[:4], [0.0, 10.0, 20.0, 30.0])
+    assert (o.ravel()[4:] == 0).all()
