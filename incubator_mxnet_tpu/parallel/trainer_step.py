@@ -62,6 +62,7 @@ class FusedTrainStep:
         self.remat = remat
         self.shard_optimizer_states = shard_optimizer_states and mesh is not None
         self._jitted = None
+        self._jitted_k = None
         self._num_update = 0
         self.params = None      # resolved at first call (after deferred init)
         self._states = None
@@ -127,7 +128,9 @@ class FusedTrainStep:
                 new_states.append(ns)
             return loss, new_train, aux_new, new_states
 
+        self._step_fn = step_fn
         kwargs = {}
+        self._sharding_info = None
         if self.mesh is not None:
             batch_sharding = NamedSharding(self.mesh, P(self.data_axis))
             repl = NamedSharding(self.mesh, P())
@@ -175,9 +178,46 @@ class FusedTrainStep:
                                       repl, repl, repl,
                                       batch_sharding, batch_sharding)
             kwargs["out_shardings"] = (repl, train_sh, aux_sh, state_sh)
+            self._sharding_info = (train_sh, aux_sh, state_sh, repl,
+                                   batch_sharding)
         if self.donate:
             kwargs["donate_argnums"] = (0, 1, 2)
         self._jitted = jax.jit(step_fn, **kwargs)
+
+    def _build_k(self):
+        """Wrap the same step_fn in a lax.scan over a leading micro-step
+        axis: k fwd+bwd+collective+update iterations inside ONE XLA
+        program. Through a remote dispatch relay (or any host-limited
+        launch path) this amortizes per-step latency by k — the chip runs
+        micro-steps back-to-back instead of idling between dispatches."""
+        step_fn = self._step_fn
+
+        def scan_fn(train_raws, aux_raws, states, key, lr, wd, t0, rescale,
+                    xs, ys):
+            def one(carry, xy):
+                tr, ax, st, k, t = carry
+                k, sub = jax.random.split(k)
+                loss, ntr, nax, nst = step_fn(
+                    tr, ax, st, sub, lr, wd, t, rescale, xy[0], xy[1])
+                return (ntr, nax, nst, k, t + 1), loss
+
+            (tr, ax, st, _, _), losses = jax.lax.scan(
+                one, (train_raws, aux_raws, states, key, t0), (xs, ys))
+            return losses, tr, ax, st
+
+        kwargs = {}
+        self._stacked_sharding = None
+        if self._sharding_info is not None:
+            train_sh, aux_sh, state_sh, repl, batch_sh = self._sharding_info
+            stacked = NamedSharding(
+                self.mesh, P(None, *batch_sh.spec))  # k axis unsharded
+            self._stacked_sharding = stacked   # single source for run_k
+            kwargs["in_shardings"] = (train_sh, aux_sh, state_sh, repl, repl,
+                                      repl, repl, repl, stacked, stacked)
+            kwargs["out_shardings"] = (repl, train_sh, aux_sh, state_sh)
+        if self.donate:
+            kwargs["donate_argnums"] = (0, 1, 2)
+        self._jitted_k = jax.jit(scan_fn, **kwargs)
 
     # -- execution --------------------------------------------------------
     def __call__(self, x, y):
@@ -209,3 +249,55 @@ class FusedTrainStep:
             self.params[i]._data._data = new_aux[j]
         self._states = new_states
         return NDArray(loss)
+
+    def run_k(self, xs, ys):
+        """Run k optimizer micro-steps as ONE compiled XLA program (a
+        lax.scan over the leading axis) — k× fewer host dispatches, so a
+        slow launch path (e.g. a remote device relay) no longer bounds
+        step time. xs/ys: stacked (k, batch, ...) arrays, or lists of k
+        per-step batches. lr/wd are sampled once for the whole chunk, so
+        schedulers advance in k-step granularity. Returns the k per-step
+        losses as an NDArray of shape (k,).
+
+        Reference contrast: the reference's engine pipelines k steps by
+        async dependency tracking; here the compiler gets all k steps in
+        one program, which also lets XLA overlap grad collectives of step
+        t with compute of step t+1."""
+        def to_stacked(seq):
+            if isinstance(seq, (list, tuple)):
+                # stay on device: no host round-trip for NDArray batches
+                return jnp.stack([b._data if isinstance(b, NDArray)
+                                  else jnp.asarray(b) for b in seq])
+            return seq._data if isinstance(seq, NDArray) else jnp.asarray(seq)
+
+        xs, ys = to_stacked(xs), to_stacked(ys)
+        k = int(xs.shape[0])
+        if self._jitted is None:
+            self._resolve(NDArray(xs[0]), NDArray(ys[0]))
+        if self._jitted_k is None:
+            self._build_k()
+        # lr/wd sampled ONCE at the start-of-chunk step count (matches the
+        # first step a sequential loop would take; schedulers advance in
+        # k-step granularity)
+        self.optimizer.num_update = self._num_update + 1
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        t0 = jnp.int32(self._num_update + 1)
+        key = ndrandom._key()
+        if self._stacked_sharding is not None:
+            xs = jax.device_put(xs, self._stacked_sharding)
+            ys = jax.device_put(ys, self._stacked_sharding)
+        train_raws = [self.params[i].data()._data for i in self.train_idx]
+        aux_raws = [self.params[i].data()._data for i in self.aux_idx]
+        rescale = jnp.float32(self.optimizer.rescale_grad)
+        losses, new_train, new_aux, new_states = self._jitted_k(
+            train_raws, aux_raws, self._states, key, lr, wd, t0, rescale,
+            xs, ys)
+        self._num_update += k
+        self.optimizer.num_update = self._num_update
+        for j, i in enumerate(self.train_idx):
+            self.params[i]._data._data = new_train[j]
+        for j, i in enumerate(self.aux_idx):
+            self.params[i]._data._data = new_aux[j]
+        self._states = new_states
+        return NDArray(losses)
