@@ -428,20 +428,100 @@ class Symbol:
             f.write(self.tojson())
 
 
+# Control-flow nodes carry their traced body twice: a runner CLOSURE (the
+# executable, under a _RUNNER key) and a serializable SPEC (nested graph
+# json, under the paired _SPEC key) — mirroring the reference, which stores
+# subgraphs as attributes inside the symbol JSON
+# (src/operator/subgraph_op_common.cc). tojson emits the spec and drops the
+# closure; load_json rebuilds the closure from the spec with
+# _runner_from_spec, the same interpreter used at trace time.
+_RUNNER_TO_SPEC = {"__subgraph__": "__subgraph_spec__",
+                   "__cond_subgraph__": "__cond_subgraph_spec__",
+                   "__else_subgraph__": "__else_subgraph_spec__"}
+_SPEC_KEYS = frozenset(_RUNNER_TO_SPEC.values())
+
+
 def _jsonable(d):
     out = {}
     for k, v in d.items():
         if callable(v):
-            # control-flow subgraph runners (foreach/while_loop/cond)
+            if _RUNNER_TO_SPEC.get(k) in d:
+                continue                      # serialized via its spec
             raise NotImplementedError(
-                "graphs containing control-flow ops (sym.contrib.foreach/"
-                "while_loop/cond) cannot be serialized to json yet; "
-                "export the surrounding graph without the loop, or use "
-                "the nd.contrib imperative control flow")
-        if isinstance(v, tuple):
+                "graph attribute {!r} is a callable with no serializable "
+                "subgraph spec; this graph cannot be saved to json".format(k))
+        if k in _SPEC_KEYS:
+            v = _spec_jsonable(v)
+        elif isinstance(v, tuple):
             v = list(v)
         out[k] = v
     return out
+
+
+def _spec_jsonable(spec):
+    return {"nodes": [{"op": n["op"], "name": n["name"],
+                       "attrs": _jsonable(n["attrs"]),
+                       "inputs": n["inputs"]} for n in spec["nodes"]],
+            "heads": spec["heads"],
+            "n_ph": spec["n_ph"], "n_cap": spec["n_cap"]}
+
+
+def _runner_from_spec(spec):
+    """Interpreter over a subgraph spec (local-index node list): executes
+    the inner nodes with the registered op implementations. Used both for
+    freshly traced control-flow bodies and for bodies rebuilt from JSON,
+    so a save/load round trip runs the identical code path."""
+    nodes = spec["nodes"]
+    n_in = spec["n_ph"] + spec["n_cap"]
+    heads = [tuple(h) for h in spec["heads"]]
+
+    def runner(rt, arg_raws, _aux_unused):
+        env = {}
+        for i in range(n_in):
+            env[(i, 0)] = arg_raws[i]
+        for li in range(n_in, len(nodes)):
+            nd_ = nodes[li]
+            od = _OPS[nd_["op"]]
+            ins = [env[(i, j)] for i, j in nd_["inputs"]]
+            res = od.fn(rt, nd_["attrs"], *ins)
+            res = res if isinstance(res, tuple) else (res,)
+            for j, r in enumerate(res):
+                env[(li, j)] = r
+        return tuple(env[h] for h in heads), ()
+
+    return runner
+
+
+def _attrs_from_json(d):
+    """Node attrs, JSON form -> executable form: lists back to tuples,
+    control-flow runners rebuilt from their specs. Single decode rule for
+    top-level graphs (load_json) and nested subgraph specs (_load_spec)."""
+    attrs = {k: tuple(v) if isinstance(v, list) else v
+             for k, v in d.items()}
+    _rebuild_runners(attrs)
+    return attrs
+
+
+def _load_spec(spec):
+    """JSON form of a subgraph spec -> executable form."""
+    nodes = [{"op": nd_["op"], "name": nd_["name"],
+              "attrs": _attrs_from_json(nd_.get("attrs", {})),
+              "inputs": [tuple(i) for i in nd_["inputs"]]}
+             for nd_ in spec["nodes"]]
+    return {"nodes": nodes, "heads": spec["heads"],
+            "n_ph": spec["n_ph"], "n_cap": spec["n_cap"]}
+
+
+def _rebuild_runners(attrs):
+    """Rebuild runner closures for any subgraph specs present in attrs
+    (recursing through nested control flow)."""
+    for rk, sk in _RUNNER_TO_SPEC.items():
+        if sk in attrs and rk not in attrs:
+            spec = attrs[sk]
+            if isinstance(spec, dict) and "nodes" in spec:
+                loaded = _load_spec(spec)
+                attrs[sk] = loaded
+                attrs[rk] = _runner_from_spec(loaded)
 
 
 def load_json(json_str):
@@ -458,8 +538,7 @@ def load_json(json_str):
     nodes = []
     for nd_ in data["nodes"]:
         op = None if nd_["op"] == "null" else nd_["op"]
-        attrs = {k: tuple(v) if isinstance(v, list) else v
-                 for k, v in nd_.get("attrs", {}).items()}
+        attrs = _attrs_from_json(nd_.get("attrs", {}))
         node = _Node(op, nd_["name"], attrs,
                      [(nodes[i], j) for i, j in nd_.get("inputs", [])],
                      is_aux=nd_.get("is_aux", False))

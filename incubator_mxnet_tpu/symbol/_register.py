@@ -1006,8 +1006,10 @@ _reg_nd_mirror("topk", ("data",),
 # threaded through the scan carry (independent dropout masks per step);
 # aux-state updates (BatchNorm moving stats) inside a control-flow body are
 # dropped, as in inference mode.
-# Control-flow graphs are runtime-only: tojson raises (subgraph
-# serialization is not implemented), matching the honest-limitation rule.
+# Control-flow graphs SERIALIZE: each body is traced into a local-index
+# spec nested inside the node attrs (reference: nnvm stores subgraphs as
+# attributes in the symbol JSON, src/operator/subgraph_op_common.cc), and
+# load_json rebuilds the runner from the spec via the same interpreter.
 # ---------------------------------------------------------------------------
 
 from . import Variable as _Variable  # noqa: E402
@@ -1027,15 +1029,20 @@ from ..base import make_loop_caller as _make_loop_caller  # noqa: E402
 
 def _trace_subgraph(build, placeholders):
     """Call user code on placeholder symbols -> (flat output entries,
-    captured outer entries, runner).
+    captured outer entries, runner, spec).
 
     Capture is by node CREATION ORDER: every node that existed before
     `build` ran (weights, but also computed outer symbols like a Dropout
     output the body closes over) becomes a lifted input — evaluated ONCE
     in the outer graph and fed into the loop, exactly like the
     reference's subgraph inputs. Only nodes the body itself builds run
-    per iteration."""
-    from . import _NODE_SEQ
+    per iteration.
+
+    `spec` is the serializable local-index form of the body (nodes,
+    heads, input arity) — nested into the symbol JSON by tojson, and the
+    thing the shared _runner_from_spec interpreter executes, so traced
+    and json-loaded graphs run identical code."""
+    from . import _NODE_SEQ, _runner_from_spec
     mark = _NODE_SEQ[0]
     outs = build()
     entries = []
@@ -1073,25 +1080,37 @@ def _trace_subgraph(build, placeholders):
     for n, i in entries:
         visit(n, i)
 
-    ph_ids_list = [id(p._entries[0][0]) for p in placeholders]
-    cap_keys = [(id(n), i) for n, i in captured]
+    # serializable local-index spec: [placeholders..., captures..., inner...]
+    n_ph, n_cap = len(placeholders), len(captured)
+    local = {}               # id(node) -> local index (ph + inner nodes)
+    cap_local = {}           # (id(node), out_idx) -> local index
+    nodes_spec = []
+    for i, p in enumerate(placeholders):
+        n = p._entries[0][0]
+        local[id(n)] = i
+        nodes_spec.append({"op": "null", "name": n.name, "attrs": {},
+                           "inputs": []})
+    for ci, (cn, cj) in enumerate(captured):
+        cap_local[(id(cn), cj)] = n_ph + ci
+        nodes_spec.append({"op": "null", "name": f"__cap{ci}__",
+                           "attrs": {}, "inputs": []})
 
-    def runner(rt, arg_raws, _aux_unused):
-        env = {}
-        for nid, raw in zip(ph_ids_list, arg_raws[:len(ph_ids_list)]):
-            env[(nid, 0)] = raw
-        for key, raw in zip(cap_keys, arg_raws[len(ph_ids_list):]):
-            env[key] = raw
-        for node in inner_order:
-            od = _SYM_OPS[node.op]
-            ins = [env[(id(n), i)] for n, i in node.inputs]
-            res = od.fn(rt, node.attrs, *ins)
-            res = res if isinstance(res, tuple) else (res,)
-            for i, r in enumerate(res):
-                env[(id(node), i)] = r
-        return tuple(env[(id(n), i)] for n, i in entries), ()
+    def local_entry(n, j):
+        if id(n) in ph_ids:
+            return [local[id(n)], 0]
+        if (id(n), j) in cap_local:
+            return [cap_local[(id(n), j)], 0]
+        return [local[id(n)], j]
 
-    return entries, captured, runner
+    for node in inner_order:
+        local[id(node)] = len(nodes_spec)
+        nodes_spec.append({
+            "op": node.op, "name": node.name, "attrs": node.attrs,
+            "inputs": [local_entry(n, j) for n, j in node.inputs]})
+    spec = {"nodes": nodes_spec,
+            "heads": [local_entry(n, j) for n, j in entries],
+            "n_ph": n_ph, "n_cap": n_cap}
+    return entries, captured, _runner_from_spec(spec), spec
 
 
 def _foreach_fn(rt, a, *rest):
@@ -1151,14 +1170,15 @@ def _contrib_foreach(body, data, init_states, name=None):
         result["n_out"] = len(outs)
         return outs + new_states
 
-    entries, captured, runner = _trace_subgraph(
+    entries, captured, runner, spec = _trace_subgraph(
         build, slice_phs + state_phs)
     cap_syms = [Symbol([(n, i)]) for n, i in captured]
     node_out = _make_op(
         "_foreach", data_list + init_states + cap_syms,
         {"n_data": len(data_list), "n_states": len(init_states),
          "n_captured": len(captured),
-         "n_out": result["n_out"], "__subgraph__": runner}, name)
+         "n_out": result["n_out"], "__subgraph__": runner,
+         "__subgraph_spec__": spec}, name)
     n_out = result["n_out"]
     outs = [node_out[i] for i in range(n_out)]
     states = [node_out[i] for i in range(n_out, n_out + len(init_states))]
@@ -1234,7 +1254,8 @@ def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
     def build_cond():
         return [call_cond(phs)]
 
-    c_entries, c_captured, c_runner = _trace_subgraph(build_cond, phs)
+    c_entries, c_captured, c_runner, c_spec = _trace_subgraph(
+        build_cond, phs)
 
     def build_body():
         outs, new_vars = call_func(phs)
@@ -1247,7 +1268,8 @@ def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
         result["n_out"] = len(outs)
         return outs + new_vars
 
-    b_entries, b_captured, b_runner = _trace_subgraph(build_body, phs)
+    b_entries, b_captured, b_runner, b_spec = _trace_subgraph(
+        build_body, phs)
     cap_syms = ([Symbol([(n, i)]) for n, i in c_captured]
                 + [Symbol([(n, i)]) for n, i in b_captured])
     node_out = _make_op(
@@ -1255,7 +1277,8 @@ def _contrib_while_loop(cond, func, loop_vars, max_iterations, name=None):
         {"n_loop_vars": len(loop_vars), "n_cond_captured": len(c_captured),
          "n_captured": len(b_captured), "n_out": result["n_out"],
          "max_iterations": int(max_iterations),
-         "__cond_subgraph__": c_runner, "__subgraph__": b_runner}, name)
+         "__cond_subgraph__": c_runner, "__cond_subgraph_spec__": c_spec,
+         "__subgraph__": b_runner, "__subgraph_spec__": b_spec}, name)
     n_out = result["n_out"]
     outs = [node_out[i] for i in range(n_out)]
     final = [node_out[i] for i in range(n_out, n_out + len(loop_vars))]
@@ -1291,9 +1314,9 @@ def _contrib_cond(pred, then_func, else_func, name=None):
     match in count/shape (XLA static-shape contract, like the
     reference)."""
     name = name or _sym_auto_name("cond")
-    t_entries, t_captured, t_runner = _trace_subgraph(
+    t_entries, t_captured, t_runner, t_spec = _trace_subgraph(
         lambda: _as_sym_list(then_func()), [])
-    e_entries, e_captured, e_runner = _trace_subgraph(
+    e_entries, e_captured, e_runner, e_spec = _trace_subgraph(
         lambda: _as_sym_list(else_func()), [])
     n_out = len(t_entries)
     if n_out != len(e_entries):
@@ -1305,7 +1328,9 @@ def _contrib_cond(pred, then_func, else_func, name=None):
         "_cond", [pred] + cap_syms,
         {"n_then_captured": len(t_captured),
          "n_else_captured": len(e_captured), "n_out": n_out,
-         "__subgraph__": t_runner, "__else_subgraph__": e_runner}, name)
+         "__subgraph__": t_runner, "__subgraph_spec__": t_spec,
+         "__else_subgraph__": e_runner, "__else_subgraph_spec__": e_spec},
+        name)
     return node_out if n_out > 1 else node_out[0]
 
 

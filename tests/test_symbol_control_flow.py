@@ -2,7 +2,6 @@
 reference tests/python/unittest/test_contrib_control_flow.py). Lowered to
 lax.scan / lax.cond inside the executor's jitted program."""
 import numpy as np
-import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import symbol as sym
@@ -80,15 +79,22 @@ def test_cond_branches():
         np.testing.assert_allclose(ex.forward()[0].asnumpy(), want)
 
 
-def test_control_flow_tojson_raises():
+def test_control_flow_tojson_embeds_subgraph_spec():
+    """Control-flow graphs serialize: the body is nested as a subgraph
+    spec in the node attrs (reference nnvm subgraph-in-json layout), and
+    the runner callable itself is dropped from the json."""
+    import json
     data = sym.Variable("data")
 
     def body(x, states):
         return x, [states[0]]
 
     outs, _ = sym.contrib.foreach(body, data, [sym.Variable("s")])
-    with pytest.raises(NotImplementedError):
-        outs.tojson()
+    d = json.loads(outs.tojson())
+    fe = [n for n in d["nodes"] if n["op"] == "_foreach"]
+    assert len(fe) == 1
+    assert "__subgraph_spec__" in fe[0]["attrs"]
+    assert "__subgraph__" not in fe[0]["attrs"]
 
 
 def test_foreach_multiple_outputs_and_states():
@@ -241,3 +247,91 @@ def test_while_loop_reference_calling_convention():
     np.testing.assert_allclose(facc, [6.0])     # 0+1+2+3
     np.testing.assert_allclose(o.ravel()[:4], [0.0, 10.0, 20.0, 30.0])
     assert (o.ravel()[4:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# serialization: control-flow graphs roundtrip through json (reference:
+# nnvm nests subgraph json in node attrs, src/operator/subgraph_op_common.cc)
+# ---------------------------------------------------------------------------
+
+def test_foreach_json_roundtrip_outputs_and_grads():
+    data, w, s0 = sym.Variable("data"), sym.Variable("w"), sym.Variable("s0")
+
+    def body(x, st):
+        s = sym.tanh(st[0] + x * w)
+        return s, [s]
+
+    outs, states = sym.contrib.foreach(body, data, [s0])
+    loss = sym.sum(outs) + sym.sum(states[0])
+    loss2 = sym.load_json(loss.tojson())
+
+    args = {"data": np.random.RandomState(0).randn(4, 3).astype(np.float32),
+            "w": np.array([0.5, -1.0, 2.0], np.float32),
+            "s0": np.zeros(3, np.float32)}
+
+    def run(s):
+        ex = s.bind(args=dict(args),
+                    args_grad={"w": np.zeros(3, np.float32)},
+                    grad_req={"w": "write", "data": "null", "s0": "null"})
+        v = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward()
+        return v, ex.grad_dict["w"].asnumpy()
+
+    v1, g1 = run(loss)
+    v2, g2 = run(loss2)
+    np.testing.assert_allclose(v2, v1, rtol=1e-6)
+    np.testing.assert_allclose(g2, g1, rtol=1e-6)
+
+
+def test_while_loop_and_cond_json_roundtrip():
+    i0 = sym.Variable("i0")
+    o, fin = sym.contrib.while_loop(
+        lambda v: sym.broadcast_lesser(v, sym.ones(shape=(1,)) * 5),
+        lambda v: (v * 2.0, v + 1.0), i0, max_iterations=8)
+    g = sym.Group([o, fin])
+    g2 = sym.load_json(g.tojson())
+    a = {"i0": np.array([0.0], np.float32)}
+    r1 = [t.asnumpy() for t in g.bind(args=dict(a),
+                                      grad_req="null").forward()]
+    r2 = [t.asnumpy() for t in g2.bind(args=dict(a),
+                                       grad_req="null").forward()]
+    for x, y in zip(r1, r2):
+        np.testing.assert_array_equal(x, y)
+
+    p, aa = sym.Variable("p"), sym.Variable("a")
+    out = sym.contrib.cond(p, lambda: aa * 2, lambda: aa - 1)
+    out2 = sym.load_json(out.tojson())
+    for pv in (1.0, 0.0):
+        ar = {"p": np.array(pv, np.float32), "a": np.array([3.0],
+                                                           np.float32)}
+        x = out.bind(args=dict(ar), grad_req="null").forward()[0].asnumpy()
+        y = out2.bind(args=dict(ar), grad_req="null").forward()[0].asnumpy()
+        np.testing.assert_array_equal(x, y)
+
+
+def test_nested_foreach_json_roundtrip():
+    """Nested control flow serializes recursively (spec inside spec)."""
+    data, s0 = sym.Variable("data"), sym.Variable("s0")
+
+    def outer_body(row, st):
+        def inner_body(x, ist):
+            s = ist[0] + x
+            return s, [s]
+
+        inner_outs, _ = sym.contrib.foreach(inner_body, row,
+                                            [sym.zeros(shape=(1,))])
+        tot = st[0] + sym.sum(inner_outs)
+        return tot, [tot]
+
+    outs, states = sym.contrib.foreach(outer_body, data, [s0])
+    g = sym.Group([outs, states[0]])
+    g2 = sym.load_json(g.tojson())
+    a = {"data": np.arange(12, dtype=np.float32).reshape(3, 4, 1),
+         "s0": np.zeros(1, np.float32)}
+    r1 = [t.asnumpy() for t in g.bind(args=dict(a),
+                                      grad_req="null").forward()]
+    r2 = [t.asnumpy() for t in g2.bind(args=dict(a),
+                                       grad_req="null").forward()]
+    for x, y in zip(r1, r2):
+        np.testing.assert_array_equal(x, y)
+    np.testing.assert_allclose(r1[1].ravel(), [150.0])
