@@ -1,0 +1,341 @@
+"""Int8 inference quantization (parity: python/mxnet/contrib/quantization.py,
+src/operator/quantization/ — quantize/dequantize ops, quantized FC/conv,
+min-max calibration, `quantize_model`).
+
+TPU-native design: symmetric int8 with zero-free scales (per-tensor for
+activations, per-output-channel for weights), so the
+matmul/conv stays a pure integer op the MXU consumes directly
+(`lax.dot_general` / `conv_general_dilated` with
+`preferred_element_type=int32`) and the single fp rescale at the end fuses
+into neighbouring elementwise work. The reference's asymmetric uint8 path
+(zero-points, per-op requantize kernels) targets x86 VNNI; on TPU the
+symmetric form is both simpler and faster, and calibration only has to
+find one |max| per tensor.
+
+Modes, mirroring the reference's `quantize_model` API surface:
+- no calibration: activation ranges computed per batch on device (dynamic);
+- 'naive' calibration: run calib batches through the fp32 net, record each
+  quantized layer's input |max|, bake static scales (no per-batch reduce).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon.parameter import DeferredInitializationError
+from ..ndarray import NDArray, _apply
+from ..ops import _raw as _rawops
+from .. import gluon
+
+__all__ = ["quantize", "dequantize", "quantize_v2",
+           "QuantizedDense", "QuantizedConv2D",
+           "quantize_net", "quantize_model"]
+
+
+_QTYPES = {"int8": (jnp.int8, 127.0), "uint8": (jnp.uint8, 255.0)}
+
+
+def _q_raw(x, min_r, max_r, qtype):
+    dt, qmax = _QTYPES[qtype]
+    if qtype == "int8":
+        # eps guard: a constant-zero tensor quantizes to zeros, not NaN
+        scale = qmax / jnp.maximum(jnp.maximum(jnp.abs(min_r),
+                                               jnp.abs(max_r)), 1e-12)
+        q = jnp.clip(jnp.rint(x * scale), -qmax, qmax).astype(dt)
+    else:
+        scale = qmax / jnp.maximum(max_r - min_r, 1e-12)
+        q = jnp.clip(jnp.rint((x - min_r) * scale), 0, qmax).astype(dt)
+    return q
+
+
+def _dq_raw(q, min_r, max_r):
+    if q.dtype == jnp.uint8:
+        return q.astype(jnp.float32) * ((max_r - min_r) / 255.0) + min_r
+    scale = jnp.maximum(jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)),
+                        1e-12) / 127.0
+    return q.astype(jnp.float32) * scale
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    """(q, min, max) = contrib.quantize(data, min, max) — reference
+    src/operator/quantization/quantize.cc. int8 is symmetric (scale =
+    127/|max|), uint8 affine."""
+    if out_type not in _QTYPES:
+        raise ValueError(f"out_type must be int8/uint8, got {out_type!r}")
+    q = _apply(lambda x, lo, hi: _q_raw(x, lo, hi, out_type),
+               [data, _as_nd(min_range), _as_nd(max_range)],
+               name="quantize")
+    return q, _as_nd(min_range), _as_nd(max_range)
+
+
+def dequantize(data, min_range, max_range):
+    """Reference src/operator/quantization/dequantize.cc."""
+    return _apply(_dq_raw, [data, _as_nd(min_range), _as_nd(max_range)],
+                  name="dequantize")
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Quantize with auto range when no calibration is given (reference
+    quantize_v2.cc). Returns (q, min, max)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = float(jnp.min(data._data))
+        mx_ = float(jnp.max(data._data))
+    else:
+        mn, mx_ = float(min_calib_range), float(max_calib_range)
+    return quantize(data, mn, mx_, out_type)
+
+
+def _as_nd(v):
+    if isinstance(v, NDArray):
+        return v
+    return NDArray(jnp.asarray(v, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+
+def _int8_pair(x_f32, a_scale):
+    """fp32 -> int8 with the given symmetric scale (jax-level)."""
+    return jnp.clip(jnp.rint(x_f32 * a_scale), -127, 127).astype(jnp.int8)
+
+
+class QuantizedDense(gluon.nn.HybridBlock):
+    """Int8 Dense (reference quantized_fully_connected.cc): weights are
+    quantized ONCE at wrap time with PER-OUTPUT-CHANNEL scales (reference
+    channel-wise quantization), activations per batch (dynamic) or with a
+    baked calib scale. Accumulates in int32 on the MXU, one fp rescale."""
+
+    def __init__(self, dense, prefix=None, params=None):
+        super().__init__(prefix, params)
+        # device-resident from the start (no per-forward host->device copy);
+        # the fp32 source layer is deliberately NOT kept — int8 replaces it
+        w = dense.weight.data()._data.astype(jnp.float32)   # (out, in)
+        amax = jnp.maximum(jnp.max(jnp.abs(w), axis=1), 1e-12)
+        self._w_scale = (127.0 / amax).astype(jnp.float32)       # (out,)
+        self._qw = _int8_pair(w, self._w_scale[:, None])
+        self._bias = (None if dense.bias is None
+                      else dense.bias.data()._data.astype(jnp.float32))
+        self._flatten = dense._flatten
+        self._act = dense.act
+        self.calib_max = None            # set by calibration
+
+    def forward(self, x):
+        qw, w_scale = self._qw, self._w_scale
+        bias, act, flatten = self._bias, self._act, self._flatten
+        calib = self.calib_max
+
+        def fn(xr):
+            xf = xr.astype(jnp.float32)
+            if flatten and xf.ndim > 2:
+                xf = xf.reshape(xf.shape[0], -1)
+            amax = (jnp.float32(calib) if calib is not None
+                    else jnp.maximum(jnp.max(jnp.abs(xf)), 1e-12))
+            a_scale = 127.0 / amax
+            qx = _int8_pair(xf, a_scale)
+            acc = jax.lax.dot_general(
+                qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) / (a_scale * w_scale)
+            if bias is not None:
+                y = y + bias
+            if act:
+                y = _rawops.activation(y, act)
+            return y
+
+        return _apply(fn, [x], name="quantized_dense")
+
+
+class QuantizedConv2D(gluon.nn.HybridBlock):
+    """Int8 2D convolution (reference quantized_conv.cc): int8×int8→int32
+    via conv_general_dilated, symmetric per-tensor scales."""
+
+    def __init__(self, conv, prefix=None, params=None):
+        super().__init__(prefix, params)
+        w = conv.weight.data()._data.astype(jnp.float32)
+        # per-output-channel scales; O axis is 0 for OIHW (NCHW layouts),
+        # last for HWIO (channels-last layouts)
+        o_axis = 0 if conv._layout.startswith("NC") else w.ndim - 1
+        red = tuple(a for a in range(w.ndim) if a != o_axis)
+        amax = jnp.maximum(jnp.max(jnp.abs(w), axis=red), 1e-12)
+        self._w_scale = (127.0 / amax).astype(jnp.float32)     # (O,)
+        bshape = [1] * w.ndim
+        bshape[o_axis] = w.shape[o_axis]
+        self._qw = _int8_pair(w, self._w_scale.reshape(bshape))
+        self._bias = (None if conv.bias is None
+                      else conv.bias.data()._data.astype(jnp.float32))
+        self._stride = conv._stride
+        self._pad = conv._pad
+        self._dilate = conv._dilate
+        self._groups = conv._groups
+        self._layout = conv._layout
+        self._act = conv.act
+        self.calib_max = None
+
+    def forward(self, x):
+        qw, w_scale = self._qw, self._w_scale
+        bias, act = self._bias, self._act
+        stride, pad, dilate = self._stride, self._pad, self._dilate
+        groups, layout = self._groups, self._layout
+        calib = self.calib_max
+
+        def fn(xr):
+            xf = xr.astype(jnp.float32)
+            amax = (jnp.float32(calib) if calib is not None
+                    else jnp.maximum(jnp.max(jnp.abs(xf)), 1e-12))
+            a_scale = 127.0 / amax
+            qx = _int8_pair(xf, a_scale)
+            dn = _rawops._conv_dn(qx.ndim, layout)
+            acc = jax.lax.conv_general_dilated(
+                qx, qw,
+                window_strides=tuple(stride),
+                padding=[(p, p) for p in pad],
+                rhs_dilation=tuple(dilate),
+                dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            ws = w_scale
+            if not layout.endswith("C"):           # NCHW...: C is axis 1
+                ws = ws.reshape((1, -1) + (1,) * (acc.ndim - 2))
+            y = acc.astype(jnp.float32) / (a_scale * ws)
+            if bias is not None:
+                if layout.endswith("C"):
+                    y = y + bias
+                else:
+                    y = y + bias.reshape((1, -1) + (1,) * (y.ndim - 2))
+            if act:
+                y = _rawops.activation(y, act)
+            return y
+
+        return _apply(fn, [x], name="quantized_conv2d")
+
+
+# ---------------------------------------------------------------------------
+# net transformation + calibration
+# ---------------------------------------------------------------------------
+
+def _wrap(block):
+    if isinstance(block, gluon.nn.Dense):
+        return QuantizedDense(block)
+    if isinstance(block, gluon.nn.Conv2D):
+        return QuantizedConv2D(block)
+    return None
+
+
+def _clear_hybrid_caches(block):
+    """Drop every HybridBlock's traced-graph cache in the tree: a cached
+    fp32 CachedOp would otherwise keep serving the OLD graph after layers
+    are swapped (and would bypass calibration pre-hooks)."""
+    if hasattr(block, "_cache"):
+        block._cache = {}
+    for child in block._children.values():
+        _clear_hybrid_caches(child)
+
+
+def quantize_net(net, calib_data=None, exclude=()):
+    """Replace every Dense/Conv2D in `net` (in place, recursively) with its
+    int8 twin; with `calib_data` (an iterable of input batches) run a
+    'naive' min/max calibration pass first so activation scales are baked
+    static (reference `quantize_model(..., calib_mode='naive')`). Blocks
+    in `exclude` (by reference) are left fp32. Returns `net`.
+
+    Works on hybridized nets too: traced-graph caches are cleared so both
+    the calibration pass and the quantized net retrace. Deferred-shape
+    nets must have run one forward (or provide calib_data, whose first
+    batch completes the deferred init).
+    """
+    targets = []            # (parent, name, child)
+
+    def collect(parent):
+        for name, child in list(parent._children.items()):
+            if child in exclude:
+                continue
+            if isinstance(child, (QuantizedDense, QuantizedConv2D)):
+                continue                       # idempotent re-entry
+            if isinstance(child, (gluon.nn.Dense, gluon.nn.Conv2D)):
+                targets.append((parent, name, child))
+            else:
+                collect(child)
+
+    collect(net)
+    if not targets:
+        raise ValueError("no quantizable (Dense/Conv2D) layers found")
+    # validate BEFORE any mutation so a failure cannot leave the net
+    # half-quantized
+    for _, _, child in targets:
+        try:
+            child.weight.data()
+        except DeferredInitializationError:
+            raise ValueError(
+                f"layer {child!r} has uninitialized (deferred) shapes; run "
+                f"one forward pass (or pass calib_data through the full "
+                f"net) before quantize_net")
+    _clear_hybrid_caches(net)   # hooks must fire; fp32 trace is stale soon
+
+    ranges = None
+    if calib_data is not None:
+        ranges = {id(c): 0.0 for _, _, c in targets}
+        hooked = []
+        # calibration must run EAGERLY: a hybridized (traced) forward would
+        # hand the hooks abstract tracers with no values to record
+        deactivated = []
+
+        def deactivate(b):
+            if getattr(b, "_active", False):
+                deactivated.append(b)
+                b._active = False
+            for c in b._children.values():
+                deactivate(c)
+
+        deactivate(net)
+        try:
+            for _, _, child in targets:
+                def mk(cid):
+                    def pre_hook(block, inputs):
+                        x = inputs[0]
+                        m = float(jnp.max(jnp.abs(x._data)))
+                        ranges[cid] = max(ranges[cid], m)
+                    return pre_hook
+                child.register_forward_pre_hook(mk(id(child)))
+                hooked.append(child)
+            for batch in calib_data:
+                net(batch if isinstance(batch, NDArray) else NDArray(batch))
+        finally:
+            for child in hooked:            # calibration hooks are one-shot
+                child._forward_pre_hooks.pop()
+            for b in deactivated:
+                b._active = True
+
+    for parent, name, child in targets:
+        wrapped = _wrap(child)
+        if ranges is not None:
+            if ranges[id(child)] > 0.0:
+                wrapped.calib_max = ranges[id(child)]
+            else:
+                # layer never saw calibration data (conditional branch /
+                # aux head): fall back to dynamic ranges rather than bake
+                # a garbage scale
+                import logging
+                logging.getLogger(__name__).warning(
+                    "quantize_net: %r received no calibration data; using "
+                    "dynamic per-batch activation ranges for it", child)
+        parent._children[name] = wrapped
+        if getattr(parent, name, None) is child:
+            object.__setattr__(parent, name, wrapped)
+    _clear_hybrid_caches(net)   # force retrace onto the int8 graph
+    return net
+
+
+def quantize_model(sym_or_net, calib_data=None, **kwargs):
+    """Reference-name alias: upstream `contrib.quantization.quantize_model`
+    takes a Symbol+params triple; the gluon-first equivalent here takes a
+    net (see MIGRATION.md). A dict where calib_data belongs means the call
+    site still passes the reference's arg_params — fail fast with
+    guidance instead of iterating parameter names as batches."""
+    if isinstance(calib_data, dict):
+        raise TypeError(
+            "quantize_model(net, arg_params, ...) is the reference Symbol "
+            "signature; here pass a gluon net and calib_data=[batches] — "
+            "see MIGRATION.md 'Int8 quantization'")
+    return quantize_net(sym_or_net, calib_data=calib_data, **kwargs)

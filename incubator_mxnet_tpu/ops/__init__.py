@@ -481,6 +481,18 @@ def _mirror_into_nd():
                  "MultiBoxDetection", "multihead_attention",
                  "foreach", "while_loop", "cond"]:
         setattr(contrib, name, globals()[name])
+
+    def _contrib_getattr(name):
+        # quantization ops live with contrib.quantization (which imports
+        # gluon, loaded after ops) — resolve lazily, PEP 562 style
+        if name in ("quantize", "dequantize", "quantize_v2"):
+            from ..contrib import quantization as _q
+            return getattr(_q, name)
+        raise AttributeError(
+            f"module 'incubator_mxnet_tpu.ndarray.contrib' has no "
+            f"attribute {name!r}")
+
+    contrib.__getattr__ = _contrib_getattr
     nd_mod.contrib = contrib
     sys.modules["incubator_mxnet_tpu.ndarray.contrib"] = contrib
 
