@@ -23,6 +23,7 @@ import numpy as np
 __all__ = [
     "record", "pause", "train_mode", "predict_mode", "is_recording",
     "is_training", "mark_variables", "backward", "grad", "Function",
+    "get_symbol",
 ]
 
 _STATE = threading.local()
@@ -403,3 +404,81 @@ class _CustomFn:
 
     def __call__(self, *raws):  # only used if someone re-runs forward
         raise RuntimeError("custom Function cannot be re-executed from the tape")
+
+
+def get_symbol(x):
+    """Parity: mx.autograd.get_symbol (python/mxnet/autograd.py) — lift the
+    recorded tape that produced NDArray `x` into a Symbol graph.
+
+    Each tape node becomes a graph node that replays the same pure jax
+    function (so bind/forward/backward give identical numerics and
+    gradients to the tape); grad-attached leaf arrays become Variables
+    named var0, var1, ... in the order the graph walk first reaches them
+    (depth-first over inputs from the output — read
+    `result.list_arguments()` for the binding order rather than assuming
+    trace order); constants captured mid-graph are baked in. The result
+    composes/binds like any Symbol but is runtime-only (tojson raises —
+    the fns are closures); for serializable graphs use HybridBlock.export
+    + SymbolBlock (MIGRATION.md). Custom autograd.Function nodes cannot
+    be lifted (their forward is not re-runnable) and raise here."""
+    from .ndarray import NDArray
+    from .symbol import Symbol, Variable, _make_op
+    from .symbol import _auto_name as _sym_auto_name
+    if not isinstance(x, NDArray):
+        raise TypeError(f"get_symbol expects an NDArray, got {type(x)}")
+    if x._node is None:
+        raise ValueError(
+            "array carries no recorded graph; compute it under "
+            "autograd.record() first")
+
+    memo = {}        # id(tape Node) -> Symbol with all its outputs
+    leaf_syms = {}   # id(leaf NDArray) -> Variable
+    counter = [0]
+
+    def lift(node):
+        """Build this node's Symbol; every parent is already in memo."""
+        if isinstance(node.fn, _CustomFn):
+            raise ValueError(
+                f"tape contains a custom autograd.Function "
+                f"({node.fn.func and type(node.fn.func).__name__}); its "
+                f"forward cannot be re-executed, so this graph cannot be "
+                f"lifted to a Symbol")
+        in_syms = []
+        for i, parent in enumerate(node.parents):
+            if parent is not None:
+                pnode, pidx = parent
+                in_syms.append(Symbol([memo[id(pnode)]._entries[pidx]]))
+            else:
+                leaf = node.leaf_refs[i]
+                if leaf is not None:
+                    if id(leaf) not in leaf_syms:
+                        leaf_syms[id(leaf)] = Variable(f"var{counter[0]}")
+                        counter[0] += 1
+                    in_syms.append(leaf_syms[id(leaf)])
+                else:
+                    in_syms.append(_make_op(
+                        "_traced_const", [],
+                        {"__value__": node.input_values[i]}))
+        return _make_op("_traced_fn", in_syms,
+                        {"__fn__": node.fn, "n_out": node.n_out},
+                        name=_sym_auto_name(node.name or "traced_fn"))
+
+    # iterative post-order: eager-loop tapes run thousands of ops deep,
+    # past Python's recursion limit (the backward engine walks its
+    # toposort iteratively for the same reason)
+    root, idx = x._node
+    stack = [root]
+    while stack:
+        node = stack[-1]
+        if id(node) in memo:
+            stack.pop()
+            continue
+        pending = [p[0] for p in node.parents
+                   if p is not None and id(p[0]) not in memo]
+        if pending:
+            stack.extend(reversed(pending))   # input 0's subtree lifts first
+            continue
+        stack.pop()
+        memo[id(node)] = lift(node)
+
+    return Symbol([memo[id(root)]._entries[idx]])

@@ -70,19 +70,23 @@ def _auto_name(hint):
 
 
 def _topo(entries):
-    """Topological order of nodes reachable from output entries."""
+    """Topological order of nodes reachable from output entries.
+    Iterative: graphs lifted from eager loops (autograd.get_symbol) can be
+    thousands of nodes deep, past Python's recursion limit."""
     seen, order = set(), []
-
-    def visit(node):
+    stack = [(n, False) for n, _ in reversed(list(entries))]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
         if id(node) in seen:
-            return
+            continue
         seen.add(id(node))
-        for n, _ in node.inputs:
-            visit(n)
-        order.append(node)
-
-    for n, _ in entries:
-        visit(n)
+        stack.append((node, True))
+        for n, _ in reversed(node.inputs):
+            if id(n) not in seen:
+                stack.append((n, False))
     return order
 
 

@@ -1337,3 +1337,15 @@ def _contrib_cond(pred, then_func, else_func, name=None):
 _sym_mod.contrib.foreach = _contrib_foreach
 _sym_mod.contrib.while_loop = _contrib_while_loop
 _sym_mod.contrib.cond = _contrib_cond
+
+
+# ---------------------------------------------------------------------------
+# autograd.get_symbol support: tape -> Symbol lifting (reference
+# python/mxnet/autograd.py get_symbol). Each recorded eager op replays as a
+# graph node executing the same pure function.
+# ---------------------------------------------------------------------------
+
+register_op("_traced_fn",
+            lambda rt, a, *ins: a["__fn__"](*ins),
+            (), n_out=lambda a: a.get("n_out", 1))
+register_op("_traced_const", lambda rt, a: a["__value__"], ())

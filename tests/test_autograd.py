@@ -157,3 +157,86 @@ def test_backward_through_concat_split():
         (p.sum() + 2 * q.sum()).backward()
     assert_close(a.grad.asnumpy(), np.full((2, 2), 2.0))
     assert_close(b.grad.asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_get_symbol_lifts_tape_to_symbol():
+    """Parity: mx.autograd.get_symbol — imperative trace -> Symbol with
+    identical forward values and gradients."""
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    w = nd.array(np.random.RandomState(1).randn(3, 2).astype(np.float32))
+    w.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = (nd.tanh(y) + 1.0).sum()
+    z.backward()
+    tape_gw = w.grad.asnumpy().copy()
+
+    s = autograd.get_symbol(z)
+    args = s.list_arguments()
+    assert args == ["var0", "var1"]
+    ex = s.bind(args={args[0]: x.asnumpy(), args[1]: w.asnumpy()},
+                args_grad={args[1]: np.zeros_like(w.asnumpy())},
+                grad_req={args[0]: "null", args[1]: "write"})
+    v = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(v, z.asnumpy(), rtol=1e-6)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict[args[1]].asnumpy(), tape_gw,
+                               rtol=1e-5)
+
+
+def test_get_symbol_bakes_constants_and_reuses_leaves():
+    """Non-leaf constants captured by the trace are baked into the graph;
+    a leaf used twice maps to ONE Variable."""
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    a.attach_grad()
+    c = nd.array(np.array([10.0, 20.0], np.float32))   # no grad: constant
+    with autograd.record():
+        out = a * a + c
+    s = autograd.get_symbol(out)
+    assert s.list_arguments() == ["var0"]              # a appears once
+    ex = s.bind(args={"var0": np.array([3.0, 4.0], np.float32)},
+                grad_req="null")
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               [19.0, 36.0])           # 9+10, 16+20
+
+
+def test_get_symbol_requires_recorded_array():
+    import pytest
+    plain = nd.array(np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="record"):
+        autograd.get_symbol(plain)
+    with pytest.raises(TypeError):
+        autograd.get_symbol(np.ones(3))
+
+
+def test_get_symbol_deep_tape_no_recursion_error():
+    """Eager-loop tapes run thousands of ops deep; lifting and executing
+    must not hit Python's recursion limit."""
+    y = nd.array(np.zeros(2, np.float32))
+    y.attach_grad()
+    with autograd.record():
+        out = y
+        for _ in range(1500):
+            out = out + 1.0
+    s = autograd.get_symbol(out)
+    ex = s.bind(args={"var0": np.zeros(2, np.float32)}, grad_req="null")
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 1500.0)
+
+
+def test_get_symbol_rejects_custom_function():
+    import pytest
+
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    a = nd.array(np.ones(2, np.float32))
+    a.attach_grad()
+    with autograd.record():
+        out = Double()(a) + 1.0
+    with pytest.raises(ValueError, match="custom autograd.Function"):
+        autograd.get_symbol(out)
