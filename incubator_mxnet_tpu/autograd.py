@@ -413,8 +413,8 @@ def get_symbol(x):
     Each tape node becomes a graph node that replays the same pure jax
     function (so bind/forward/backward give identical numerics and
     gradients to the tape); grad-attached leaf arrays become Variables
-    named var0, var1, ... in the order the graph walk first reaches them
-    (depth-first over inputs from the output — read
+    named var0, var1, ... in the order a depth-first walk over inputs
+    from the output first reaches them (still read
     `result.list_arguments()` for the binding order rather than assuming
     trace order); constants captured mid-graph are baked in. The result
     composes/binds like any Symbol but is runtime-only (tojson raises —
@@ -463,10 +463,37 @@ def get_symbol(x):
                         {"__fn__": node.fn, "n_out": node.n_out},
                         name=_sym_auto_name(node.name or "traced_fn"))
 
+    root, idx = x._node
+
+    # pre-pass: name leaves in EXACT depth-first first-reach order from
+    # the output (the documented var0/var1/... rule) — the lift below runs
+    # post-order, which would number them differently
+    visited = set()
+    walk = [root]
+    while walk:
+        item = walk.pop()
+        if isinstance(item, tuple):               # ("leaf", ndarray)
+            leaf = item[1]
+            if id(leaf) not in leaf_syms:
+                from .symbol import Variable as _Var
+                leaf_syms[id(leaf)] = _Var(f"var{counter[0]}")
+                counter[0] += 1
+            continue
+        if id(item) in visited:
+            continue
+        visited.add(id(item))
+        entries = []
+        for i, parent in enumerate(item.parents):
+            if parent is None:
+                if item.leaf_refs[i] is not None:
+                    entries.append(("leaf", item.leaf_refs[i]))
+            else:
+                entries.append(parent[0])
+        walk.extend(reversed(entries))            # input 0 reached first
+
     # iterative post-order: eager-loop tapes run thousands of ops deep,
     # past Python's recursion limit (the backward engine walks its
     # toposort iteratively for the same reason)
-    root, idx = x._node
     stack = [root]
     while stack:
         node = stack[-1]

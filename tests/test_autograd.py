@@ -240,3 +240,18 @@ def test_get_symbol_rejects_custom_function():
         out = Double()(a) + 1.0
     with pytest.raises(ValueError, match="custom autograd.Function"):
         autograd.get_symbol(out)
+
+
+def test_get_symbol_leaf_numbering_first_reach_order():
+    """var numbering follows depth-first first-reach order from the
+    output, even when a leaf's subtree lifts after a sibling subtree."""
+    a = nd.array(np.array([1.0, 2.0], np.float32)); a.attach_grad()
+    b = nd.array(np.array([3.0, 4.0], np.float32)); b.attach_grad()
+    with autograd.record():
+        out = a + nd.tanh(b * 2.0)      # DFS reaches `a` (input 0) first
+    s = autograd.get_symbol(out)
+    ex = s.bind(args={"var0": np.array([10.0, 20.0], np.float32),
+                      "var1": np.array([0.0, 0.0], np.float32)},
+                grad_req="null")
+    # var0 must be `a`: tanh(0)=0, so out == the var0 values exactly
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [10.0, 20.0])
