@@ -1,9 +1,10 @@
 """Network visualization (parity: python/mxnet/visualization.py).
 
 print_summary walks a Symbol graph and prints the reference's layer table
-(name, output shape, params, previous layers). plot_network requires
-graphviz, which is not in this image — it raises with instructions, rather
-than silently producing nothing.
+(name, output shape, params, previous layers). plot_network returns a
+Digraph-like object carrying the network in DOT form (`.source`,
+`.save('net.dot')`); only `.render()` — which needs the graphviz binary
+absent from this image — raises, with instructions.
 """
 from __future__ import annotations
 
@@ -77,8 +78,103 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     return total_params
 
 
+class _Digraph:
+    """Minimal graphviz.Digraph stand-in: collects nodes/edges and renders
+    DOT source. The python `graphviz` package is not in this image, so
+    plot_network returns this instead — `.source` is valid DOT (feed it to
+    an external `dot -Tpdf`), `.save(path)` writes the .dot file, and
+    `.render()` explains what is unavailable rather than failing silently."""
+
+    def __init__(self, title):
+        self.title = title
+        self._lines = []
+
+    @staticmethod
+    def _q(s):
+        """DOT double-quoted string: escape backslashes and quotes (but
+        keep \\n, the DOT line-break escape labels rely on)."""
+        s = str(s).replace("\\", "\\\\").replace('"', '\\"')
+        return s.replace("\\\\n", "\\n")
+
+    def node(self, name, label, **attrs):
+        a = ", ".join([f'label="{self._q(label)}"'] +
+                      [f'{k}="{self._q(v)}"'
+                       for k, v in sorted(attrs.items())])
+        self._lines.append(f'  "{self._q(name)}" [{a}];')
+
+    def edge(self, src, dst, label=None):
+        suffix = f' [label="{self._q(label)}"]' if label else ""
+        self._lines.append(f'  "{self._q(src)}" -> "{self._q(dst)}"'
+                           f'{suffix};')
+
+    @property
+    def source(self):
+        return (f'digraph "{self._q(self.title)}" {{\n'
+                "  rankdir=BT;\n" + "\n".join(self._lines) + "\n}\n")
+
+    def save(self, filename):
+        with open(filename, "w") as f:
+            f.write(self.source)
+        return filename
+
+    def render(self, *a, **kw):
+        raise ImportError(
+            "rendering needs the graphviz binary, which is not in this "
+            "image; use .source / .save('net.dot') and run "
+            "`dot -Tpdf net.dot` elsewhere")
+
+    def _repr_mimebundle_(self, *a, **kw):   # notebook display: show DOT
+        return {"text/plain": self.source}
+
+
+_NODE_COLORS = {
+    "Convolution": "royalblue1", "Deconvolution": "royalblue3",
+    "FullyConnected": "brown3", "Activation": "salmon",
+    "BatchNorm": "orchid1", "Pooling": "firebrick", "Flatten": "gold",
+    "Reshape": "gold", "Concat": "seagreen1", "softmax": "yellow",
+    "SoftmaxOutput": "yellow",
+}
+
+
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    raise ImportError(
-        "plot_network needs graphviz, which is not available in this "
-        "image; use print_summary(symbol, shape) for a text summary")
+    """Parity: mx.viz.plot_network (python/mxnet/visualization.py).
+    Returns a Digraph-like object whose `.source` is the network in DOT
+    form (same node shapes/colors scheme as the reference); the graphviz
+    renderer is not in this image, so `.render()` raises with
+    instructions while `.save()` writes the .dot file."""
+    from .symbol import Symbol, _topo
+    if not isinstance(symbol, Symbol):
+        raise TypeError("plot_network expects a Symbol")
+    shapes = {}
+    if shape:
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        shapes = dict(zip(symbol.list_arguments(), arg_shapes))
+    node_attrs = dict(node_attrs or {})   # merged into every node, like
+    g = _Digraph(title)                   # the reference
+    order = _topo(symbol._entries)
+    def is_weight(n):
+        return n.is_var and (n.name.endswith(("_weight", "_bias", "_gamma",
+                                              "_beta", "_moving_mean",
+                                              "_moving_var")))
+    keep = {id(n) for n in order
+            if not (hide_weights and is_weight(n))}
+    for n in order:
+        if id(n) not in keep:
+            continue
+        if n.is_var:
+            label = n.name
+            if n.name in shapes:
+                label += f"\\n{tuple(shapes[n.name])}"
+            g.node(n.name, label, **{"shape": "oval",
+                                     "fillcolor": "lightblue",
+                                     "style": "filled", **node_attrs})
+        else:
+            color = _NODE_COLORS.get(n.op, "olivedrab1")
+            g.node(n.name, f"{n.name}\\n({n.op})",
+                   **{"shape": "box", "fillcolor": color,
+                      "style": "filled", **node_attrs})
+        for m, _i in n.inputs:
+            if id(m) in keep:
+                g.edge(m.name, n.name)
+    return g

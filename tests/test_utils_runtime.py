@@ -111,3 +111,33 @@ def test_check_symbolic_forward_backward():
     og = np.ones_like(x)
     test_utils.check_symbolic_backward(out, {"data": x}, [og],
                                        {"data": 2 * x})
+
+
+def test_plot_network_emits_dot():
+    """Parity: mx.viz.plot_network — DOT source with reference node
+    scheme; weights hidden by default; .save writes, .render explains."""
+    import pytest
+    from incubator_mxnet_tpu import symbol as sym
+    x = sym.Variable("data")
+    net = sym.FullyConnected(sym.Activation(sym.Convolution(
+        x, kernel=(3, 3), num_filter=8, name="conv0"), act_type="relu"),
+        num_hidden=10, name="fc0")
+    g = mx.viz.plot_network(net, shape={"data": (1, 3, 8, 8)})
+    src = g.source
+    assert src.startswith('digraph') and "conv0" in src and "fc0" in src
+    assert "conv0_weight" not in src
+    assert "conv0_weight" in mx.viz.plot_network(
+        net, hide_weights=False).source
+    with pytest.raises(ImportError):
+        g.render()
+
+
+def test_plot_network_escaping_and_node_attrs():
+    from incubator_mxnet_tpu import symbol as sym
+    x = sym.Variable('a"b')
+    out = sym.relu(x, name="r0")
+    g = mx.viz.plot_network(out, title='my "best" net', hide_weights=False,
+                            node_attrs={"fontsize": "9"})
+    src = g.source
+    assert '\\"best\\"' in src and '"a\\"b"' in src   # DOT-escaped
+    assert 'fontsize="9"' in src                      # node_attrs merged
