@@ -170,7 +170,59 @@ def _build_bert(batch, dtype):
     return net, L, x, y, flops_per_sample, f"bert_base_seq{seq}"
 
 
-_BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert}
+def _build_lenet(batch, dtype):
+    """BASELINE config 1: LeNet on MNIST shapes
+    (example/image-classification/train_mnist.py)."""
+    net = get_model("lenet", classes=10)
+    net.initialize(init=mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    x = nd.array(np.random.rand(batch, 1, 28, 28).astype(np.float32))
+    if dtype == "bfloat16":
+        x = x.astype("bfloat16")
+    y = nd.array(np.random.randint(0, 10, batch))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    return net, L, x, y, 3 * 4.3e6, "lenet_mnist"
+
+
+def _build_ssd(batch, dtype):
+    """BASELINE config 4: SSD-512 VOC-shape training step (example/ssd).
+    Synthetic boxes; matching targets precomputed ONCE — anchor matching
+    depends only on the fixed anchors + labels, but the hard-negative set
+    is mined against the INITIAL predictions and then frozen, which is
+    fine for a throughput bench (constant per-step work) but not for a
+    convergence run (train against fresh targets there)."""
+    from incubator_mxnet_tpu.models.ssd import ssd_512_resnet50_v1, SSDLoss
+    from incubator_mxnet_tpu import autograd as ag
+    classes = 20
+    net = ssd_512_resnet50_v1(classes=classes, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 512, 512, 3).astype(np.float32))
+    if dtype == "bfloat16":
+        x = x.astype("bfloat16")
+    label = np.zeros((batch, 2, 5), np.float32)
+    for b in range(batch):
+        for j in range(2):
+            x0, y0 = rng.rand(2) * 0.5
+            label[b, j] = [rng.randint(0, classes), x0, y0,
+                           x0 + 0.3, y0 + 0.3]
+    with ag.pause():
+        anchor, cls_pred, _ = net(x)
+        bt, bm, ct = net.targets(anchor, cls_pred, nd.array(label))
+    ssd_l = SSDLoss()
+
+    def loss_fn(out, _y):
+        return ssd_l(out[1], out[2], ct, bt, bm)
+
+    y = nd.array(np.zeros(batch, np.float32))     # unused placeholder
+    return net, loss_fn, x, y, 3 * 30e9, "ssd512_voc"
+
+
+_BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
+                 "lenet": _build_lenet, "ssd": _build_ssd}
 
 
 class _CastNorm(gluon.nn.HybridBlock):
@@ -347,7 +399,8 @@ def main():
     if model not in _BENCH_MODELS:
         raise ValueError(f"unknown BENCH_MODEL {model!r}; choose from "
                          f"{sorted(_BENCH_MODELS)}")
-    default_batch = {"resnet50": "128", "bert": "32"}[model]
+    default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
+                     "ssd": "16"}.get(model, "32")
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -385,7 +438,12 @@ def main():
         print(json.dumps(result))
         return
 
-    net, L, x, y, flops_per_sample, tag = _BENCH_MODELS[model](batch, dtype)
+    # builders can do real device work (SSD runs a full forward to
+    # precompute matching targets) — deadline it like every device phase
+    with _phase_deadline(int(os.environ.get("BENCH_BUILD_TIMEOUT", "1200")),
+                         "model build"):
+        net, L, x, y, flops_per_sample, tag = _BENCH_MODELS[model](batch,
+                                                                   dtype)
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
                               multi_precision=(dtype == "bfloat16"))
     step = FusedTrainStep(net, L, opt,
