@@ -136,7 +136,11 @@ def acquire_backend(attempts=6, first_delay=3.0,
 
 
 def _build_resnet(batch, dtype):
-    net = get_model("resnet50_v1", classes=1000, layout="NHWC")
+    # BENCH_S2D=1: MLPerf-style space-to-depth stem — exact-equivalent
+    # 4x4/s1 conv on a (112,112,12) image instead of 7x7/s2 on (224,224,3),
+    # quadrupling MXU input-lane utilization in the stem
+    net = get_model("resnet50_v1", classes=1000, layout="NHWC",
+                    stem_s2d=os.environ.get("BENCH_S2D") == "1")
     net.initialize(init=mx.init.Xavier())
     if dtype == "bfloat16":
         net.cast("bfloat16")
@@ -399,8 +403,12 @@ def main():
     if model not in _BENCH_MODELS:
         raise ValueError(f"unknown BENCH_MODEL {model!r}; choose from "
                          f"{sorted(_BENCH_MODELS)}")
-    default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
-                     "ssd": "16"}.get(model, "32")
+    try:
+        default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
+                         "ssd": "16"}[model]
+    except KeyError:
+        raise ValueError(f"BENCH_MODEL {model!r} has no default batch; "
+                         f"set BENCH_BATCH explicitly")
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")

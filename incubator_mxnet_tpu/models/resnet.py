@@ -10,7 +10,8 @@ from __future__ import annotations
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
-__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BottleneckV1",
+__all__ = ["ResNetV1", "ResNetV2", "SpaceToDepthStem",
+           "BasicBlockV1", "BottleneckV1",
            "BasicBlockV2", "BottleneckV2", "resnet18_v1", "resnet34_v1",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
@@ -20,6 +21,58 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BottleneckV1",
 def _conv(channels, kernel, stride, pad, layout, in_channels=0):
     return nn.Conv2D(channels, kernel, strides=stride, padding=pad,
                      use_bias=False, layout=layout, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """EXACT-equivalent replacement for the 7x7/stride-2 stem conv (NHWC).
+
+    The standard stem feeds the MXU 3 input channels — 3 of 128 lanes do
+    work. Space-to-depth (MLPerf ResNet's TPU trick) reshapes the image
+    to (H/2, W/2, 4C) and runs the mathematically identical 4x4/stride-1
+    conv with asymmetric (2,1) padding; the kernel is rearranged IN-GRAPH
+    from the same (7,7,C,O) HWIO parameter, so checkpoints interchange
+    with the standard stem bit-for-bit and XLA constant-folds the
+    rearrangement.
+
+    Derivation: y[p,q] = sum_{i,j} w[i,j] x[2p+i-3, 2q+j-3]; write
+    i = 2*ai + di - 1 (di in {0,1}) and the sum becomes a 4-tap conv over
+    the s2d image with channel index (di, dj, c)."""
+
+    def __init__(self, channels, in_channels=3, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.weight = self.params.get(
+            "weight", shape=(7, 7, in_channels, channels))
+
+    def forward(self, x):
+        from ..ndarray import _apply
+        import jax
+        import jax.numpy as jnp
+
+        def fn(xr, w):
+            N, H, W, C = xr.shape
+            if C != w.shape[2]:
+                raise ValueError(
+                    f"SpaceToDepthStem was built for {w.shape[2]} input "
+                    f"channels, got {C}; pass in_channels= to match")
+            if H % 2 or W % 2:
+                raise ValueError(
+                    f"SpaceToDepthStem needs even H/W, got {(H, W)}")
+            xs = (xr.reshape(N, H // 2, 2, W // 2, 2, C)
+                  .transpose(0, 1, 3, 2, 4, 5)
+                  .reshape(N, H // 2, W // 2, 4 * C))
+            # kernel index i = 2*ai + di - 1  ->  pad one zero row/col at
+            # the front so wp[2*ai + di] == w[i] (wp[0] is the i=-1 zero)
+            wf = w.astype(jnp.float32)
+            wp = jnp.pad(wf, ((1, 0), (1, 0), (0, 0), (0, 0)))
+            O = wf.shape[-1]
+            w2 = (wp.reshape(4, 2, 4, 2, C, O)
+                  .transpose(0, 2, 1, 3, 4, 5)
+                  .reshape(4, 4, 4 * C, O)).astype(xs.dtype)
+            return jax.lax.conv_general_dilated(
+                xs, w2, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        return _apply(fn, [x, self.weight.data()], name="s2d_stem")
 
 
 def _bn(layout, **kw):
@@ -124,7 +177,7 @@ class BottleneckV2(HybridBlock):
 
 class _ResNetBase(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, layout="NHWC",
-                 thumbnail=False, version=1, **kwargs):
+                 thumbnail=False, version=1, stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         self._layout = layout
         self.features = nn.HybridSequential()
@@ -133,8 +186,14 @@ class _ResNetBase(HybridBlock):
         if thumbnail:
             self.features.add(_conv(channels[0], 3, 1, 1, layout))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, strides=2, padding=3,
-                                        use_bias=False, layout=layout))
+            if stem_s2d:
+                if layout != "NHWC":
+                    raise ValueError("stem_s2d requires layout='NHWC'")
+                self.features.add(SpaceToDepthStem(channels[0]))
+            else:
+                self.features.add(nn.Conv2D(channels[0], 7, strides=2,
+                                            padding=3, use_bias=False,
+                                            layout=layout))
             if version == 1:
                 self.features.add(_bn(layout))
                 self.features.add(nn.Activation("relu"))

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import gluon, nd
 from incubator_mxnet_tpu.models import get_model
 
 
@@ -116,3 +116,51 @@ def test_zoo_registry_complete():
     ]
     missing = [n for n in expected if n not in _MODELS]
     assert not missing, f"unregistered models: {missing}"
+
+
+class TestSpaceToDepthStem:
+    def test_exact_parity_with_conv_stem(self):
+        """S2D stem == 7x7/s2 conv stem bit-for-bit (fwd and weight
+        grad) from the SAME (7,7,3,O) parameter."""
+        from incubator_mxnet_tpu.models.resnet import SpaceToDepthStem
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.randn(2, 32, 32, 3).astype(np.float32))
+        w = rng.randn(7, 7, 3, 16).astype(np.float32) * 0.1
+        cot = nd.array(rng.randn(2, 16, 16, 16).astype(np.float32))
+
+        conv = gluon.nn.Conv2D(16, 7, strides=2, padding=3, use_bias=False,
+                               layout="NHWC", in_channels=3)
+        conv.initialize(); conv(x); conv.weight.set_data(nd.array(w))
+        s2d = SpaceToDepthStem(16)
+        s2d.initialize(); s2d(x); s2d.weight.set_data(nd.array(w))
+
+        np.testing.assert_allclose(s2d(x).asnumpy(), conv(x).asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+        grads = []
+        for blk in (conv, s2d):
+            with mx.autograd.record():
+                loss = (blk(x) * cot).sum()
+            loss.backward()
+            grads.append(blk.weight.grad().asnumpy())
+        np.testing.assert_allclose(grads[1], grads[0], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_checkpoint_interchange_with_standard_stem(self, tmp_path):
+        """A standard-stem checkpoint loads into a stem_s2d model (same
+        parameter structure) and predicts identically."""
+        from incubator_mxnet_tpu.models import get_model
+        mx.random.seed(0)
+        rng = np.random.RandomState(1)
+        x = nd.array(rng.rand(2, 32, 32, 3).astype(np.float32))
+        net = get_model("resnet18_v1", classes=10)
+        net.initialize(init=mx.init.Xavier())
+        ref = net(x).asnumpy()
+        p = str(tmp_path / "std.params")
+        net.save_parameters(p)
+
+        net2 = get_model("resnet18_v1", classes=10, stem_s2d=True)
+        net2.initialize()
+        net2(x * 0)                            # shape-complete then load
+        net2.load_parameters(p)
+        np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=2e-5,
+                                   atol=2e-5)
