@@ -182,11 +182,13 @@ def _nms_mask(boxes, scores, ids, iou_threshold, valid, force_suppress):
 
 def _box_nms(data, overlap_thresh, valid_thresh, topk, coord_start,
              score_index, id_index, force_suppress, background_id,
-             in_format="corner"):
+             in_format="corner", out_format=None):
     """data (B, K, E) rows [.. id? score coords ..] -> same shape, suppressed
     rows set to -1, kept rows score-sorted first (reference box_nms
     semantics). Only the top-`topk` candidates enter the O(T^2) suppression
-    matrix — the rest are below them in score and returned as -1."""
+    matrix — the rest are below them in score and returned as -1.
+    out_format != in_format converts surviving rows' coordinate columns
+    (shared raw body for nd.contrib/sym.contrib box_nms)."""
     scores = data[..., score_index]
     ids = (data[..., id_index].astype(jnp.int32) if id_index >= 0
            else jnp.zeros(scores.shape, jnp.int32))
@@ -210,7 +212,17 @@ def _box_nms(data, overlap_thresh, valid_thresh, topk, coord_start,
         pad = -jnp.ones((K - T, d.shape[-1]), d.dtype)
         return jnp.concatenate([out_top, pad], axis=0)
 
-    return jax.vmap(per_image)(data, boxes, scores, ids, valid, order)
+    out = jax.vmap(per_image)(data, boxes, scores, ids, valid, order)
+    out_format = out_format or in_format
+    if out_format != in_format:
+        conv = (_corner_to_center if out_format == "center"
+                else _center_to_corner)
+        coords = out[..., coord_start:coord_start + 4]
+        alive = (coords != -1.0).any(axis=-1, keepdims=True)
+        out = jnp.concatenate(
+            [out[..., :coord_start], jnp.where(alive, conv(coords), coords),
+             out[..., coord_start + 4:]], axis=-1)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -230,19 +242,25 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
             coord_start=2, score_index=1, id_index=-1, background_id=-1,
             force_suppress=False, in_format="corner", out_format="corner"):
     """Non-maximum suppression (reference: mx.nd.contrib.box_nms).
-    Suppressed/invalid rows become all -1; rows are returned score-sorted."""
-    if out_format != in_format:
-        raise NotImplementedError("box_nms: out_format conversion not "
-                                  "supported; rows keep their input format")
+    Suppressed/invalid rows become all -1; rows are returned score-sorted.
+    out_format != in_format converts surviving rows' coordinate columns
+    (corner <-> center), leaving suppressed all-(-1) rows untouched."""
+    _validate_nms_formats(in_format, out_format)
 
     def f(d):
         one = d.ndim == 2
         db = d[None] if one else d
         out = _box_nms(db, overlap_thresh, valid_thresh, topk, coord_start,
                        score_index, id_index, force_suppress, background_id,
-                       in_format)
+                       in_format, out_format)
         return out[0] if one else out
     return _apply(f, [data], name="box_nms")
+
+
+def _validate_nms_formats(in_format, out_format):
+    for fmt in (in_format, out_format):
+        if fmt not in ("corner", "center"):
+            raise ValueError(f"box_nms: unknown format {fmt!r}")
 
 
 def _multibox_prior_raw(x, sizes, ratios, steps, offsets, clip, layout):

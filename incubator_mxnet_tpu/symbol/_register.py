@@ -827,9 +827,11 @@ register_op("_contrib_MultiBoxDetection", _detection_fn,
 def _box_nms_fn(rt, a, d):
     one = d.ndim == 2
     db = d[None] if one else d
-    out = _box._box_nms(db, a["overlap_thresh"], a["valid_thresh"], a["topk"],
-                        a["coord_start"], a["score_index"], a["id_index"],
-                        a["force_suppress"], a["background_id"], a["in_format"])
+    out = _box._box_nms(db, a["overlap_thresh"], a["valid_thresh"],
+                        a["topk"], a["coord_start"], a["score_index"],
+                        a["id_index"], a["force_suppress"],
+                        a["background_id"], a["in_format"],
+                        a.get("out_format", a["in_format"]))
     return out[0] if one else out
 
 
@@ -888,14 +890,16 @@ def _contrib_MultiBoxDetection(cls_prob=None, loc_pred=None, anchor=None,
 def _contrib_box_nms(data=None, overlap_thresh=0.5, valid_thresh=0.0,
                      topk=-1, coord_start=2, score_index=1, id_index=-1,
                      background_id=-1, force_suppress=False,
-                     in_format="corner", name=None):
+                     in_format="corner", out_format=None, name=None):
+    _box._validate_nms_formats(in_format, out_format or in_format)
     return _make_op("_contrib_box_nms", [data],
                     _attrs(overlap_thresh=overlap_thresh,
                            valid_thresh=valid_thresh, topk=topk,
                            coord_start=coord_start, score_index=score_index,
                            id_index=id_index, background_id=background_id,
                            force_suppress=force_suppress,
-                           in_format=in_format), name)
+                           in_format=in_format,
+                           out_format=out_format or in_format), name)
 
 
 def _contrib_box_iou(lhs=None, rhs=None, format="corner", name=None):  # noqa: A002

@@ -282,3 +282,31 @@ def test_slice_channel():
     parts = ops.SliceChannel(x, 3, axis=1)
     assert len(parts) == 3
     np.testing.assert_array_equal(parts[0].asnumpy(), [[0, 1], [6, 7]])
+
+
+def test_box_nms_out_format_conversion():
+    """out_format != in_format converts surviving rows corner<->center;
+    suppressed all-(-1) rows stay -1 (reference box_nms semantics)."""
+    boxes = np.array([[0, 0.9, 0.0, 0.0, 0.4, 0.4],
+                      [0, 0.8, 0.0, 0.0, 0.38, 0.42],   # suppressed
+                      [1, 0.7, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    out = mx.nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5,
+                                force_suppress=True,
+                                in_format="corner",
+                                out_format="center").asnumpy()
+    # top row: corner (0,0,.4,.4) -> center (.2,.2,.4,.4)
+    np.testing.assert_allclose(out[0, 2:6], [0.2, 0.2, 0.4, 0.4],
+                               atol=1e-6)
+    assert (out[1] == -1).all()          # suppressed row stays all -1
+    np.testing.assert_allclose(out[2, 2:6], [0.7, 0.7, 0.4, 0.4],
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        mx.nd.contrib.box_nms(nd.array(boxes), out_format="diag")
+    # symbol surface validates and converts identically
+    from incubator_mxnet_tpu import symbol as sym
+    with pytest.raises(ValueError):
+        sym.contrib.box_nms(sym.Variable("d"), out_format="diag")
+    s = sym.contrib.box_nms(sym.Variable("d"), overlap_thresh=0.5,
+                            force_suppress=True, out_format="center")
+    r = s.bind(args={"d": boxes}, grad_req="null").forward()[0].asnumpy()
+    np.testing.assert_allclose(r, out)

@@ -100,12 +100,19 @@ def main():
             log("aborting sweep (unhealthy run)")
             break
     else:
-        # 2) batch sweep, gradual; 256 ONLY with remat (hard rule)
+        # 2) stem + batch sweep, gradual; 256 ONLY with remat (hard rule).
+        #    Both K8 variants (with and without S2D) are kept at each
+        #    batch size so S2D's effect is isolated, not confounded with K.
         for cfg in ([] if quick else
-                    [{"BENCH_BATCH": 192},
+                    [{"BENCH_S2D": 1},
+                     {"BENCH_S2D": 1, "BENCH_K": 8},
+                     {"BENCH_BATCH": 192},
                      {"BENCH_BATCH": 192, "BENCH_K": 8},
+                     {"BENCH_BATCH": 192, "BENCH_K": 8, "BENCH_S2D": 1},
                      {"BENCH_BATCH": 256, "BENCH_REMAT": 1},
-                     {"BENCH_BATCH": 256, "BENCH_REMAT": 1, "BENCH_K": 8}]):
+                     {"BENCH_BATCH": 256, "BENCH_REMAT": 1, "BENCH_K": 8},
+                     {"BENCH_BATCH": 256, "BENCH_REMAT": 1, "BENCH_K": 8,
+                      "BENCH_S2D": 1}]):
             assert not (cfg.get("BENCH_BATCH", 0) >= 256
                         and not cfg.get("BENCH_REMAT")), "banned config"
             if record({**base, **cfg}) is None:
