@@ -428,7 +428,10 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     fused pallas kernel on TPU (ops/pallas/layer_norm.py)."""
     if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
         from . import pallas as _pallas
-        if _pallas.enabled() and (jax.default_backend() != "tpu"
+        # on a real TPU (canonical or plugin platform) only 128-lane-
+        # aligned widths go to the Mosaic kernel; off-TPU interpret mode
+        # takes any shape
+        if _pallas.enabled() and (not _pallas.is_tpu()
                                   or x.shape[-1] % 128 == 0):
             return _pallas.layer_norm(x, gamma, beta, eps)
     xf = x.astype(jnp.float32)

@@ -25,4 +25,18 @@ def enabled() -> bool:
         return False
     if _truthy("MXTPU_FORCE_PALLAS"):
         return True
-    return jax.default_backend() == "tpu"
+    return is_tpu()
+
+
+def is_tpu() -> bool:
+    """True when the attached device is a TPU, however the platform
+    registers itself — the canonical 'tpu' backend OR a plugin name (the
+    axon relay reports platform 'axon' with TPU device_kind). The single
+    definition of "on TPU" for kernel dispatch, interpret-mode selection,
+    and runtime feature flags."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return any("tpu" in d.device_kind.lower() for d in jax.devices())
+    except Exception:  # noqa: BLE001  (no backend reachable)
+        return False

@@ -301,7 +301,8 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
     incoming cotangent there is zero).
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from . import is_tpu
+        interpret = not is_tpu()
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)

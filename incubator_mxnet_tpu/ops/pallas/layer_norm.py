@@ -86,7 +86,8 @@ _ln.defvjp(_ln_fwd, _ln_bwd)
 def layer_norm(x, gamma, beta, eps=1e-5, block_rows=256, interpret=None):
     """Fused layernorm over the LAST axis of x; gamma/beta shape (D,)."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from . import is_tpu
+        interpret = not is_tpu()
     d = x.shape[-1]
     rows = 1
     for s in x.shape[:-1]:

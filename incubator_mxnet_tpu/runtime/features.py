@@ -23,8 +23,13 @@ def _detect():
         pallas_ok = _pallas.enabled()
     except Exception:
         pallas_ok = False
+    try:
+        from ..ops.pallas import is_tpu as _is_tpu
+        on_tpu = _is_tpu()
+    except Exception:  # noqa: BLE001
+        on_tpu = backend == "tpu"
     return {
-        "TPU": backend == "tpu",
+        "TPU": on_tpu,
         "CPU": True,
         "CUDA": backend == "gpu",          # reference flag name; XLA:GPU here
         "BF16": True,                       # native MXU dtype

@@ -61,3 +61,9 @@ def test_example_rnn_bucketing():
     out = _run("train_rnn_bucketing.py", "--num-sentences", "800",
                "--epochs", "3")
     assert "perplexity=" in out
+
+
+@pytest.mark.slow
+def test_example_quantize_inference():
+    out = _run("quantize_inference.py")
+    assert "agreement" in out

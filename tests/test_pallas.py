@@ -162,3 +162,42 @@ def test_mha_routes_to_flash(monkeypatch):
     ref = _raw.multihead_attention(q, k, v, num_heads=4)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_enabled_detects_plugin_tpu_platforms(monkeypatch):
+    """The real chip can register under a plugin platform name (axon
+    relay: platform 'axon', device_kind 'TPU v5 lite'); enabled() must
+    detect TPU by device kind, not only the canonical backend name."""
+    import jax
+    from incubator_mxnet_tpu.ops import pallas
+
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+    monkeypatch.delenv("MXTPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("MXTPU_NO_PALLAS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    assert pallas.enabled()
+    monkeypatch.setattr(jax, "devices", lambda: [type("C", (), {
+        "device_kind": "cpu"})()])
+    assert not pallas.enabled()
+
+
+def test_is_tpu_consistent_across_dispatch_sites(monkeypatch):
+    """One definition of "on TPU": under a plugin platform with TPU
+    devices, enabled() is True AND interpret-mode selection sees a real
+    TPU (Mosaic, not interpret) AND runtime features report TPU."""
+    import jax
+    from incubator_mxnet_tpu.ops import pallas
+
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+    monkeypatch.delenv("MXTPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("MXTPU_NO_PALLAS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    assert pallas.is_tpu() and pallas.enabled()
+    from incubator_mxnet_tpu.runtime import features
+    assert features.Features().is_enabled("TPU")
