@@ -34,6 +34,15 @@ class Optimizer:
         self.rescale_grad = rescale_grad
         self.clip_gradient = clip_gradient
         self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            # reference python/mxnet/optimizer/optimizer.py: the
+            # optimizer's learning_rate becomes the scheduler's base_lr.
+            # Reference quirk carried over verbatim: warmup_final_lr keeps
+            # the value captured at scheduler construction, so a warmup
+            # ramp targets the scheduler's ORIGINAL base_lr — pass a
+            # matching learning_rate/base_lr pair when using warmup, as
+            # reference users must.
+            lr_scheduler.base_lr = learning_rate
         self.multi_precision = multi_precision
         self.num_update = begin_num_update
         self._index_update_count = {}

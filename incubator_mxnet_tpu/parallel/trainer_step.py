@@ -66,6 +66,20 @@ class FusedTrainStep:
         self._num_update = 0
         self.params = None      # resolved at first call (after deferred init)
         self._states = None
+        self._scalar_cache = {}   # hyper name -> (float, device scalar)
+
+    def _f32(self, name, v):
+        """Device scalar for a hyperparameter, one slot per name: lr/wd/
+        rescale rarely change, and re-uploading three host scalars every
+        step is measurable latency through a remote dispatch relay. A
+        per-step-varying scheduler just overwrites its slot (O(1) memory,
+        never evicts the constant hyperparameters)."""
+        v = float(v)
+        hit = self._scalar_cache.get(name)
+        if hit is None or hit[0] != v:
+            hit = (v, jnp.float32(v))
+            self._scalar_cache[name] = hit
+        return hit[1]
 
     # -- setup ------------------------------------------------------------
     def _resolve(self, x, y):
@@ -229,8 +243,8 @@ class FusedTrainStep:
             self._resolve(x, y)
         self._num_update += 1
         self.optimizer.num_update = self._num_update
-        lr = jnp.float32(self.optimizer.learning_rate)
-        wd = jnp.float32(self.optimizer.wd)
+        lr = self._f32("lr", self.optimizer.learning_rate)
+        wd = self._f32("wd", self.optimizer.wd)
         t = jnp.int32(self._num_update)
         key = ndrandom._key()
         xb, yb = x._data, y._data
@@ -240,7 +254,7 @@ class FusedTrainStep:
             yb = jax.device_put(yb, batch_sharding)
         train_raws = [self.params[i].data()._data for i in self.train_idx]
         aux_raws = [self.params[i].data()._data for i in self.aux_idx]
-        rescale = jnp.float32(self.optimizer.rescale_grad)
+        rescale = self._f32("rescale", self.optimizer.rescale_grad)
         loss, new_train, new_aux, new_states = self._jitted(
             train_raws, aux_raws, self._states, key, lr, wd, t, rescale, xb, yb)
         for j, i in enumerate(self.train_idx):
@@ -280,8 +294,8 @@ class FusedTrainStep:
         # first step a sequential loop would take; schedulers advance in
         # k-step granularity)
         self.optimizer.num_update = self._num_update + 1
-        lr = jnp.float32(self.optimizer.learning_rate)
-        wd = jnp.float32(self.optimizer.wd)
+        lr = self._f32("lr", self.optimizer.learning_rate)
+        wd = self._f32("wd", self.optimizer.wd)
         t0 = jnp.int32(self._num_update + 1)
         key = ndrandom._key()
         if self._stacked_sharding is not None:
@@ -289,7 +303,7 @@ class FusedTrainStep:
             ys = jax.device_put(ys, self._stacked_sharding)
         train_raws = [self.params[i].data()._data for i in self.train_idx]
         aux_raws = [self.params[i].data()._data for i in self.aux_idx]
-        rescale = jnp.float32(self.optimizer.rescale_grad)
+        rescale = self._f32("rescale", self.optimizer.rescale_grad)
         losses, new_train, new_aux, new_states = self._jitted_k(
             train_raws, aux_raws, self._states, key, lr, wd, t0, rescale,
             xs, ys)

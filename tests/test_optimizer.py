@@ -127,9 +127,15 @@ def test_lr_schedulers():
     assert c(100) == 0.0
     w = lrs.CosineScheduler(max_update=100, base_lr=1.0, warmup_steps=10)
     assert w(5) == 0.5  # linear warmup
-    sched = lrs.FactorScheduler(step=1000, base_lr=0.0)
-    o = opt.create("sgd", lr_scheduler=lrs.FactorScheduler(step=10, base_lr=2.0))
-    assert o.learning_rate == 2.0
+    # reference semantics: Optimizer.__init__ overrides the scheduler's
+    # base_lr with its learning_rate (default 0.01) — the scheduler's own
+    # base_lr only matters when the scheduler is used standalone
+    o = opt.create("sgd",
+                   lr_scheduler=lrs.FactorScheduler(step=10, base_lr=2.0))
+    assert o.learning_rate == 0.01
+    o2 = opt.create("sgd", learning_rate=2.0,
+                    lr_scheduler=lrs.FactorScheduler(step=10))
+    assert o2.learning_rate == 2.0
 
 
 def test_optimizer_with_scheduler_in_trainer():
@@ -211,3 +217,18 @@ def test_lars_zero_grad_trust_is_one():
     o = opt.create("lars", learning_rate=0.1, momentum=0.0)
     got = run_steps(o, [2.0], [[0.0]])
     assert_close(got, [2.0])
+
+
+def test_optimizer_learning_rate_becomes_scheduler_base():
+    """Parity: Optimizer.__init__ sets lr_scheduler.base_lr to the given
+    learning_rate (python/mxnet/optimizer/optimizer.py), so
+    create('sgd', learning_rate=0.2, lr_scheduler=FactorScheduler(...))
+    starts at 0.2, not the scheduler's default base."""
+    from incubator_mxnet_tpu.optimizer import lr_scheduler
+    opt = mx.optimizer.create(
+        "sgd", learning_rate=0.2,
+        lr_scheduler=lr_scheduler.FactorScheduler(step=2, factor=0.5))
+    opt.num_update = 1
+    assert abs(opt.learning_rate - 0.2) < 1e-9
+    opt.num_update = 3
+    assert abs(opt.learning_rate - 0.1) < 1e-9
