@@ -116,66 +116,104 @@ def get(reg_name) -> type:
 # shared execution helpers
 # ---------------------------------------------------------------------------
 
+_PROP_CACHE: dict = {}
+
+
 def _make_prop(op_type, attrs):
-    prop_cls = get(op_type)
+    """Build (or reuse) the user's CustomOpProp. Cached per
+    (op_type, kwargs): graph building consults the prop several times per
+    node (n_out, aux positions, shape hints, execution) and a prop with a
+    heavy __init__ shouldn't pay per consultation. Falls back to a fresh
+    instance when kwargs are unhashable."""
     kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
-    return prop_cls(**kwargs)
+    try:
+        key = (op_type, tuple(sorted(kwargs.items())))
+        prop = _PROP_CACHE.get(key)
+        if prop is None:
+            prop = get(op_type)(**kwargs)
+            _PROP_CACHE[key] = prop
+        return prop
+    except TypeError:               # unhashable kwarg value
+        return get(op_type)(**kwargs)
 
 
 def _infer(prop, in_shapes, in_dtypes):
+    """in_shapes/in_dtypes are for the DATA arguments only. Returns
+    (out_shapes, out_dtypes)."""
     shp = prop.infer_shape([list(s) for s in in_shapes])
-    in_s, out_s = shp[0], shp[1]
-    aux_s = shp[2] if len(shp) > 2 else []
-    if aux_s:
-        raise NotImplementedError(
-            "custom ops with auxiliary states are not supported yet; "
-            "model aux as explicit inputs")
+    out_s = shp[1]
     _, out_t, _ = prop.infer_type(list(in_dtypes))
     return ([tuple(s) for s in out_s], out_t)
 
 
-def _host_forward(prop, attrs, is_train, raw_inputs, out_shapes, out_dtypes):
-    """Run the user's forward on host arrays; returns tuple of np arrays."""
+def _n_args(prop):
+    return len(prop.list_arguments())
+
+
+def _n_aux(prop):
+    return len(prop.list_auxiliary_states())
+
+
+def _host_forward(prop, attrs, is_train, raw_inputs, raw_aux, out_shapes,
+                  out_dtypes):
+    """Run the user's forward on host arrays; returns (outs, new_aux) as
+    tuples of np arrays — aux NDArrays the user mutated in place come back
+    as updated values (the reference's in-place aux contract)."""
     from .ndarray import NDArray
     op = prop.create_operator(None, [a.shape for a in raw_inputs],
                               [a.dtype for a in raw_inputs])
     in_data = [NDArray(jnp.asarray(a)) for a in raw_inputs]
+    aux = [NDArray(jnp.asarray(a)) for a in raw_aux]
     out_data = [NDArray(jnp.zeros(s, d))
                 for s, d in zip(out_shapes, out_dtypes)]
-    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, [])
-    return tuple(np.asarray(o._data) for o in out_data)
+    op.forward(is_train, ["write"] * len(out_data), in_data, out_data, aux)
+    return (tuple(np.asarray(o._data) for o in out_data)
+            + tuple(np.asarray(a._data) for a in aux))
 
 
-def _host_backward(prop, attrs, raw_out_grads, raw_inputs, raw_outputs):
+def _host_backward(prop, attrs, raw_out_grads, raw_inputs, raw_outputs,
+                   raw_aux):
     from .ndarray import NDArray
     op = prop.create_operator(None, [a.shape for a in raw_inputs],
                               [a.dtype for a in raw_inputs])
     in_data = [NDArray(jnp.asarray(a)) for a in raw_inputs]
     out_data = [NDArray(jnp.asarray(a)) for a in raw_outputs]
     out_grad = [NDArray(jnp.asarray(g)) for g in raw_out_grads]
+    aux = [NDArray(jnp.asarray(a)) for a in raw_aux]
     in_grad = [NDArray(jnp.zeros(a.shape, a.dtype)) for a in raw_inputs]
+    # aux mutations during backward are dropped (forward-only updates,
+    # like BatchNorm moving stats; the reference applies them but no
+    # training loop observes the difference before the next forward)
     op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
-                in_grad, [])
+                in_grad, aux)
     return tuple(np.asarray(g._data) for g in in_grad)
 
 
 def custom_sym_fn(rt, a, *raws):
     """The traced (rt, attrs, *raws) op fn for the symbol executor:
-    pure_callback forward + custom_vjp backward."""
+    pure_callback forward + custom_vjp backward. Trailing inputs beyond
+    the prop's arguments are auxiliary states; their updated values are
+    returned after the real outputs (the executor's aux write-back
+    protocol) and they receive zero gradients."""
     prop = _make_prop(a["op_type"], a)
-    in_shapes = [r.shape for r in raws]
-    in_dtypes = [r.dtype for r in raws]
+    n_in = _n_args(prop)
+    data_raws, aux_raws = raws[:n_in], raws[n_in:]
+    in_shapes = [r.shape for r in data_raws]
+    in_dtypes = [r.dtype for r in data_raws]
     out_shapes, out_dtypes = _infer(prop, in_shapes, in_dtypes)
-    result_avals = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
-                         for s, d in zip(out_shapes, out_dtypes))
+    result_avals = (
+        tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
+              for s, d in zip(out_shapes, out_dtypes))
+        + tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in aux_raws))
     is_train = bool(rt.is_train)
-    n_in = len(raws)
+    n_out = len(out_shapes)
+    n_aux = len(aux_raws)
 
     @jax.custom_vjp
     def run(*xs):
         return jax.pure_callback(
-            lambda *hs: _host_forward(prop, a, is_train, hs,
-                                      out_shapes, out_dtypes),
+            lambda *hs: _host_forward(prop, a, is_train, hs[:n_in],
+                                      hs[n_in:], out_shapes, out_dtypes),
             result_avals, *xs)
 
     def run_fwd(*xs):
@@ -184,34 +222,73 @@ def custom_sym_fn(rt, a, *raws):
 
     def run_bwd(res, gs):
         xs, ys = res
-        in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
-        n_out = len(ys)
-        return jax.pure_callback(
+        data_xs, aux_xs = xs[:n_in], xs[n_in:]
+        outs_only = ys[:n_out]
+        in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                         for x in data_xs)
+        # flat layout: [out_grads (n_out), inputs (n_in), outputs (n_out),
+        # aux (n_aux)]
+        data_cots = jax.pure_callback(
             lambda *flat: _host_backward(
                 prop, a, flat[:n_out],
                 flat[n_out:n_out + n_in],
-                flat[n_out + n_in:]),
-            in_avals, *gs, *xs, *ys)
+                flat[n_out + n_in:2 * n_out + n_in],
+                flat[2 * n_out + n_in:]),
+            in_avals, *gs[:n_out], *data_xs, *outs_only, *aux_xs)
+        aux_cots = tuple(jnp.zeros(x.shape, x.dtype) for x in aux_xs)
+        return tuple(data_cots) + aux_cots
 
     run.defvjp(run_fwd, run_bwd)
     out = run(*raws)
-    return out if len(out) > 1 else out[0]
+    if n_aux == 0:
+        return out if len(out) > 1 else out[0]
+    return out        # (outs..., new_aux...): executor strips the aux tail
 
 
 def custom_n_out(attrs):
     return len(_make_prop(attrs["op_type"], attrs).list_outputs())
 
 
+def custom_aux_pos(attrs):
+    """Aux inputs sit after the prop's declared arguments (dynamic — the
+    registry's aux_pos callable form)."""
+    prop = _make_prop(attrs["op_type"], attrs)
+    return tuple(range(_n_args(prop), _n_args(prop) + _n_aux(prop)))
+
+
+def custom_infer_hint(in_shapes, attrs):
+    """Fill unknown argument/aux shapes from the prop's infer_shape, so
+    simple_bind can allocate aux states (the reference's shape-inference
+    pass does the same through CustomOpProp)."""
+    prop = _make_prop(attrs["op_type"], attrs)
+    na = _n_args(prop)
+    data_shapes = in_shapes[:na]
+    if any(s is None for s in data_shapes):
+        return None
+    shp = prop.infer_shape([list(s) for s in data_shapes])
+    aux_s = shp[2] if len(shp) > 2 else []
+    fills = {}
+    for j, s in enumerate(aux_s):
+        pos = na + j
+        if pos < len(in_shapes) and in_shapes[pos] is None:
+            fills[pos] = tuple(s)
+    return fills
+
+
 def eager_custom(inputs, attrs):
     """nd.Custom: run the user op on concrete arrays, record the user's
-    backward on the autograd tape."""
+    backward on the autograd tape. Inputs beyond the prop's arguments are
+    auxiliary states — mutated IN PLACE on the caller's NDArrays (the
+    reference's aux contract) and excluded from gradients."""
     from . import autograd
     from .ndarray import NDArray
 
     op_type = attrs["op_type"]
     prop = _make_prop(op_type, attrs)
-    in_shapes = [tuple(x.shape) for x in inputs]
-    in_dtypes = [x._data.dtype for x in inputs]
+    n_in = _n_args(prop)
+    data_in, aux_in = list(inputs[:n_in]), list(inputs[n_in:])
+    in_shapes = [tuple(x.shape) for x in data_in]
+    in_dtypes = [x._data.dtype for x in data_in]
     out_shapes, out_dtypes = _infer(prop, in_shapes, in_dtypes)
     op = prop.create_operator(None, in_shapes, in_dtypes)
 
@@ -221,7 +298,7 @@ def eager_custom(inputs, attrs):
             outs = [NDArray(jnp.zeros(s, d))
                     for s, d in zip(out_shapes, out_dtypes)]
             op.forward(autograd.is_training(), ["write"] * len(outs),
-                       list(ins), outs, [])
+                       list(ins), outs, aux_in)
             self._outs = outs
             return outs if len(outs) > 1 else outs[0]
 
@@ -230,7 +307,7 @@ def eager_custom(inputs, attrs):
             in_grads = [NDArray(jnp.zeros(x.shape, d))
                         for x, d in zip(ins, in_dtypes)]
             op.backward(["write"] * len(in_grads), list(ogs), ins,
-                        self._outs, in_grads, [])
+                        self._outs, in_grads, aux_in)
             return in_grads if len(in_grads) > 1 else in_grads[0]
 
-    return _Fn()(*inputs)
+    return _Fn()(*data_in)

@@ -102,7 +102,9 @@ class _OpDef:
         self.name = name
         self.fn = fn                  # fn(rt, attrs, *raw_inputs) -> raw | tuple
         self.arg_names = arg_names    # suffixes for auto-created inputs
-        self.aux_pos = tuple(aux_pos)
+        # static tuple, or callable(attrs)->tuple for ops whose aux input
+        # positions depend on the node (Custom: after the prop's arguments)
+        self.aux_pos = aux_pos if callable(aux_pos) else tuple(aux_pos)
         self.n_out = n_out            # None=1, or callable(attrs)->int
         self.infer_hint = infer_hint  # (in_shapes, attrs) -> partial fills
 
@@ -119,6 +121,10 @@ def _num_outputs(node):
     if od.n_out is None:
         return 1
     return od.n_out(node.attrs) if callable(od.n_out) else od.n_out
+
+
+def _aux_positions(od, attrs):
+    return tuple(od.aux_pos(attrs)) if callable(od.aux_pos) else od.aux_pos
 
 
 class _Runtime:
@@ -635,18 +641,19 @@ def _make_op(op, inputs, attrs=None, name=None):
     reference's auto `fc1_weight`)."""
     od = _OPS[op]
     name = name or _auto_name(op.lower().lstrip("_"))
+    aux_pos = _aux_positions(od, attrs or {})
     entries = []
     for pos, s in enumerate(inputs):
         if s is None:
             argname = od.arg_names[pos] if pos < len(od.arg_names) else f"in{pos}"
-            vnode = _Node(None, f"{name}_{argname}", is_aux=pos in od.aux_pos)
+            vnode = _Node(None, f"{name}_{argname}", is_aux=pos in aux_pos)
             entries.append((vnode, 0))
         else:
             if len(s._entries) != 1:
                 raise ValueError(f"op {op} input {pos}: expected single-output "
                                  f"symbol, got {len(s._entries)} outputs")
             node, idx = s._entries[0]
-            if pos in od.aux_pos and node.is_var:
+            if pos in aux_pos and node.is_var:
                 node.is_aux = True
             entries.append((node, idx))
     node = _Node(op, name, attrs or {}, entries)

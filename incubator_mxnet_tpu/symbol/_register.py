@@ -731,14 +731,29 @@ for _n in ["InstanceNorm", "UpSampling", "RNN"]:
 from .. import operator as _operator  # noqa: E402
 
 register_op("Custom", _operator.custom_sym_fn, (),
-            n_out=_operator.custom_n_out)
+            n_out=_operator.custom_n_out,
+            aux_pos=_operator.custom_aux_pos,
+            infer_hint=_operator.custom_infer_hint)
 
 
 def Custom(*args, op_type=None, name=None, **kwargs):
-    """mx.sym.Custom(data, ..., op_type='my_op', **string_kwargs)."""
+    """mx.sym.Custom(data, ..., op_type='my_op', **string_kwargs).
+    Auxiliary states declared by the prop but not passed explicitly are
+    auto-created as `{name}_{auxname}` variables (reference behavior:
+    simple_bind allocates declared aux automatically)."""
     if op_type is None:
         raise ValueError("Custom requires op_type=")
-    return _make_op("Custom", list(args), dict(kwargs, op_type=op_type), name)
+    attrs = dict(kwargs, op_type=op_type)
+    prop = _operator._make_prop(op_type, attrs)
+    total = _operator._n_args(prop) + _operator._n_aux(prop)
+    inputs = list(args)
+    if len(inputs) < total:
+        name = name or _sym_auto_name("custom")
+        slot_names = (list(prop.list_arguments())
+                      + list(prop.list_auxiliary_states()))
+        for pos in range(len(inputs), total):
+            inputs.append(_Variable(f"{name}_{slot_names[pos]}"))
+    return _make_op("Custom", inputs, attrs, name)
 
 
 setattr(_sym_mod, "Custom", Custom)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..context import current_context
 from ..ndarray import NDArray
-from . import _OPS, _Runtime, _num_outputs, _topo
+from . import _OPS, _Runtime, _aux_positions, _num_outputs, _topo
 
 __all__ = ["Executor", "simple_bind"]
 
@@ -48,8 +48,9 @@ def _graph_runner(entries, arg_nodes, aux_nodes):
             res = od.fn(rt, node.attrs, *ins)
             res = res if isinstance(res, tuple) else (res,)
             n_real = _num_outputs(node)
-            if od.aux_pos:
-                for pos, new in zip(od.aux_pos, res[n_real:]):
+            aux_pos = _aux_positions(od, node.attrs)
+            if aux_pos:
+                for pos, new in zip(aux_pos, res[n_real:]):
                     rt.aux_updates[id(node.inputs[pos][0])] = new
                 res = res[:n_real]
             for i, r in enumerate(res):

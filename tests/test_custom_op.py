@@ -5,7 +5,8 @@ import numpy as np
 import pytest
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import nd, operator
+from incubator_mxnet_tpu import symbol as sym
 
 
 @mx.operator.register("test_sigmoid")
@@ -114,3 +115,98 @@ def test_nd_custom_multi_output():
 def test_custom_unregistered_raises():
     with pytest.raises(KeyError, match="no custom op registered"):
         mx.nd.Custom(nd.zeros((2,)), op_type="nope_not_here")
+
+
+@operator.register("scale_with_counter")
+class ScaleWithCounterProp(operator.CustomOpProp):
+    """out = 2*x; aux 'count' increments per forward (reference-style
+    auxiliary state, mutated in place)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return ["count"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [[1]]
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class _Op(operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * 2)
+                aux[0]._data = aux[0]._data + 1
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * 2)
+        return _Op()
+
+
+def test_custom_op_aux_states_symbol():
+    """sym.Custom with auxiliary states: aux binds via aux_states, the
+    forward's in-place update writes back, grads flow to data only."""
+    x = sym.Variable("x")
+    aux = sym.Variable("count")
+    out = sym.Custom(x, aux, op_type="scale_with_counter")
+    assert out.list_auxiliary_states() == ["count"]
+    ex = out.bind(args={"x": np.array([1.0, 2.0], np.float32)},
+                  aux_states={"count": np.zeros(1, np.float32)},
+                  args_grad={"x": np.zeros(2, np.float32)},
+                  grad_req={"x": "write"})
+    v = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(v, [2.0, 4.0])
+    np.testing.assert_allclose(ex.aux_dict["count"].asnumpy(), [1.0])
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.aux_dict["count"].asnumpy(), [2.0])
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [2.0, 2.0])
+
+
+def test_custom_op_aux_states_eager():
+    """nd.Custom mutates the caller's aux NDArray in place."""
+    x = nd.array(np.array([3.0], np.float32))
+    count = nd.array(np.zeros(1, np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, count, op_type="scale_with_counter")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [6.0])
+    np.testing.assert_allclose(count.asnumpy(), [1.0])   # mutated in place
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_sym_custom_multi_output_backward():
+    """Symbolic Custom with n_out != n_in: backward callback arg slicing
+    must route out_data/out_grad correctly (regression guard)."""
+    x = np.array([1.0, 2.0], np.float32)
+    out = mx.sym.Custom(mx.sym.Variable("a"), op_type="test_scale2")
+    loss = sym.sum(out[0]) + sym.sum(out[1] * out[1])
+    ex = loss.bind(args={"a": x},
+                   args_grad={"a": np.zeros(2, np.float32)},
+                   grad_req={"a": "write"})
+    v = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    # loss = 2x + (x+1)^2 -> dloss/dx = 2 + 2(x+1)
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               2 + 2 * (x + 1), rtol=1e-5)
+
+
+def test_sym_custom_auto_creates_aux_variable():
+    """Reference style: aux declared by the prop but not passed appears
+    automatically as {name}_{auxname}."""
+    out = mx.sym.Custom(mx.sym.Variable("x"),
+                        op_type="scale_with_counter", name="swc")
+    assert out.list_auxiliary_states() == ["swc_count"]
+    ex = out.bind(args={"x": np.array([1.0], np.float32)},
+                  aux_states={"swc_count": np.zeros(1, np.float32)},
+                  grad_req="null")
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.aux_dict["swc_count"].asnumpy(), [1.0])
