@@ -25,6 +25,7 @@ from ..ndarray import NDArray
 
 __all__ = [
     "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "copyMakeBorder",
     "random_crop", "center_crop", "random_size_crop", "color_normalize",
     "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
     "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
@@ -888,3 +889,39 @@ class ImageDetIter:
 
     def __next__(self):
         return self.next()
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, values=0.0):
+    """Pad an HWC image with a border (parity: mx.image.copyMakeBorder /
+    cv2.copyMakeBorder). border_type 0 = constant (`values`), 1 =
+    replicate edge pixels."""
+    import jax.numpy as jnp
+    from ..ndarray import NDArray, _apply
+
+    def f(img):
+        pads = ((top, bot), (left, right)) + ((0, 0),) * (img.ndim - 2)
+        if border_type == 1:
+            return jnp.pad(img, pads, mode="edge")
+        if border_type != 0:
+            raise ValueError(f"unsupported border_type {border_type}; "
+                             "0 (constant) and 1 (replicate) are supported")
+        if jnp.ndim(jnp.asarray(values)) == 0:
+            return jnp.pad(img, pads, mode="constant",
+                           constant_values=values)
+        # sequence `values` = per-CHANNEL border color (the cv2 contract),
+        # not numpy's per-axis pad constants
+        vals = jnp.asarray(values, img.dtype)
+        if img.ndim != 3 or vals.shape != (img.shape[-1],):
+            raise ValueError(
+                f"per-channel values needs an HWC image with "
+                f"{vals.shape[0]} channels, got image shape {img.shape}")
+        padded = jnp.pad(img, pads, mode="constant")
+        h, w = img.shape[:2]
+        row = jnp.arange(padded.shape[0])[:, None]
+        col = jnp.arange(padded.shape[1])[None, :]
+        border = ((row < top) | (row >= top + h)
+                  | (col < left) | (col >= left + w))
+        return jnp.where(border[..., None], vals, padded)
+
+    return _apply(f, [src if isinstance(src, NDArray) else NDArray(src)],
+                  name="copyMakeBorder")

@@ -187,3 +187,79 @@ def truncated_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
     r = loc + scale * jax.random.truncated_normal(_key(), -2.0, 2.0, _shape(shape),
                                                   normalize_dtype(dtype))
     return _wrap(r, ctx)
+
+
+# ---------------------------------------------------------------------------
+# sample_* family: per-element distribution parameters (parity:
+# mx.nd.sample_uniform/... — src/operator/random/sample_op.cc). Each
+# parameter array contributes one output row of `shape` draws.
+# ---------------------------------------------------------------------------
+
+def _param_raw(p, dt):
+    from . import NDArray
+    raw = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+    return raw.astype(dt)
+
+
+def _bcast(p, extra):
+    """Parameter array -> shape broadcastable against (p.shape + extra)."""
+    return p.reshape(p.shape + (1,) * len(extra))
+
+
+def _extra(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def sample_uniform(low, high, shape=None, dtype="float32", ctx=None):
+    dt = normalize_dtype(dtype)
+    low, high = _param_raw(low, dt), _param_raw(high, dt)
+    extra = _extra(shape)
+    r = jax.random.uniform(_key(), low.shape + extra, dt)
+    return _wrap(_bcast(low, extra) + r * _bcast(high - low, extra), ctx)
+
+
+def sample_normal(mu, sigma, shape=None, dtype="float32", ctx=None):
+    dt = normalize_dtype(dtype)
+    mu, sigma = _param_raw(mu, dt), _param_raw(sigma, dt)
+    extra = _extra(shape)
+    r = jax.random.normal(_key(), mu.shape + extra, dt)
+    return _wrap(_bcast(mu, extra) + r * _bcast(sigma, extra), ctx)
+
+
+def sample_exponential(lam, shape=None, dtype="float32", ctx=None):
+    dt = normalize_dtype(dtype)
+    lam = _param_raw(lam, dt)
+    extra = _extra(shape)
+    r = jax.random.exponential(_key(), lam.shape + extra, dt)
+    return _wrap(r / _bcast(lam, extra), ctx)
+
+
+def sample_poisson(lam, shape=None, dtype="float32", ctx=None):
+    lam = _param_raw(lam, jnp.float32)
+    extra = _extra(shape)
+    r = jax.random.poisson(_key(), _bcast(lam, extra), lam.shape + extra)
+    return _wrap(r.astype(normalize_dtype(dtype)), ctx)
+
+
+def sample_gamma(alpha, beta, shape=None, dtype="float32", ctx=None):
+    dt = normalize_dtype(dtype)
+    alpha, beta = _param_raw(alpha, dt), _param_raw(beta, dt)
+    extra = _extra(shape)
+    r = jax.random.gamma(_key(), _bcast(alpha, extra),
+                         alpha.shape + extra, dt)
+    return _wrap(r * _bcast(beta, extra), ctx)
+
+
+def _mirror_samples_into_nd():
+    """mx.nd.sample_uniform etc. — the reference exposes the family at
+    the nd top level as well as nd.random."""
+    import sys
+    nd_mod = sys.modules["incubator_mxnet_tpu.ndarray"]
+    for n in ("sample_uniform", "sample_normal", "sample_exponential",
+              "sample_poisson", "sample_gamma"):
+        setattr(nd_mod, n, globals()[n])
+
+
+_mirror_samples_into_nd()

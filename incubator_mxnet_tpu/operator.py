@@ -121,20 +121,25 @@ _PROP_CACHE: dict = {}
 
 def _make_prop(op_type, attrs):
     """Build (or reuse) the user's CustomOpProp. Cached per
-    (op_type, kwargs): graph building consults the prop several times per
-    node (n_out, aux positions, shape hints, execution) and a prop with a
-    heavy __init__ shouldn't pay per consultation. Falls back to a fresh
+    (prop CLASS, kwargs): graph building consults the prop several times
+    per node (n_out, aux positions, shape hints, execution) and a prop
+    with a heavy __init__ shouldn't pay per consultation. Keying on the
+    class (not the name) means re-registering an op_type takes effect
+    immediately. The cached prop is treated as stateless METADATA —
+    per-execution state belongs in the CustomOp that create_operator
+    returns fresh each run, as in the reference. Falls back to a fresh
     instance when kwargs are unhashable."""
     kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    cls = get(op_type)
     try:
-        key = (op_type, tuple(sorted(kwargs.items())))
+        key = (cls, tuple(sorted(kwargs.items())))
         prop = _PROP_CACHE.get(key)
         if prop is None:
-            prop = get(op_type)(**kwargs)
+            prop = cls(**kwargs)
             _PROP_CACHE[key] = prop
         return prop
     except TypeError:               # unhashable kwarg value
-        return get(op_type)(**kwargs)
+        return cls(**kwargs)
 
 
 def _infer(prop, in_shapes, in_dtypes):
@@ -224,6 +229,9 @@ def custom_sym_fn(rt, a, *raws):
         xs, ys = res
         data_xs, aux_xs = xs[:n_in], xs[n_in:]
         outs_only = ys[:n_out]
+        # backward sees the POST-forward aux (ys tail), matching the
+        # reference's in-place-updated aux and the eager path
+        aux_after = ys[n_out:]
         in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                          for x in data_xs)
         # flat layout: [out_grads (n_out), inputs (n_in), outputs (n_out),
@@ -234,7 +242,7 @@ def custom_sym_fn(rt, a, *raws):
                 flat[n_out:n_out + n_in],
                 flat[n_out + n_in:2 * n_out + n_in],
                 flat[2 * n_out + n_in:]),
-            in_avals, *gs[:n_out], *data_xs, *outs_only, *aux_xs)
+            in_avals, *gs[:n_out], *data_xs, *outs_only, *aux_after)
         aux_cots = tuple(jnp.zeros(x.shape, x.dtype) for x in aux_xs)
         return tuple(data_cots) + aux_cots
 

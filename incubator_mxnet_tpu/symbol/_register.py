@@ -355,6 +355,41 @@ _make_regression("MAERegressionOutput", lambda x: x, lambda p, l: jnp.sign(p - l
 _make_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda p, l: p - l)
 
 
+def _svm_output_fn(rt, a, x, label):
+    """Parity: mx.sym.SVMOutput (src/operator/svm_output.cc). Forward is
+    identity over the class scores; backward is the one-vs-all hinge
+    gradient with targets y=+1 for the labelled class and -1 otherwise —
+    squared hinge (L2-SVM) by default, linear hinge with use_linear.
+    Like SoftmaxOutput, the gradient ignores head cotangents."""
+    margin = float(a.get("margin", 1.0))
+    C = float(a.get("regularization_coefficient", 1.0))
+    use_linear = bool(a.get("use_linear", False))
+
+    @jax.custom_vjp
+    def f(x, label):
+        return x
+
+    def fwd(x, label):
+        return x, (x, label)
+
+    def bwd(res, g):
+        x, label = res
+        y = 2.0 * jax.nn.one_hot(label.astype(jnp.int32), x.shape[-1],
+                                 dtype=x.dtype) - 1.0
+        viol = margin - y * x
+        if use_linear:                       # L1-SVM: -C*y on violations
+            grad = -C * y * (viol > 0).astype(x.dtype)
+        else:                                # L2-SVM: -2C*y*max(0, viol)
+            grad = -2.0 * C * y * jnp.maximum(viol, 0.0)
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f(x, label)
+
+
+register_op("SVMOutput", _svm_output_fn, ("data", "label"))
+
+
 # ---------------------------------------------------------------------------
 # symbol-level builders (the sym.* functions)
 # ---------------------------------------------------------------------------
@@ -454,6 +489,14 @@ def MAERegressionOutput(data=None, label=None, grad_scale=1.0, name=None):
 def LogisticRegressionOutput(data=None, label=None, grad_scale=1.0, name=None):
     return _make_op("LogisticRegressionOutput", [data, label],
                     {"grad_scale": grad_scale}, name)
+
+
+def SVMOutput(data=None, label=None, margin=1.0,
+              regularization_coefficient=1.0, use_linear=False, name=None):
+    return _make_op("SVMOutput", [data, label],
+                    {"margin": margin,
+                     "regularization_coefficient": regularization_coefficient,
+                     "use_linear": use_linear}, name)
 
 
 def MakeLoss(data=None, grad_scale=1.0, name=None):

@@ -210,3 +210,36 @@ def test_sym_custom_auto_creates_aux_variable():
                   grad_req="null")
     ex.forward(is_train=True)
     np.testing.assert_allclose(ex.aux_dict["swc_count"].asnumpy(), [1.0])
+
+
+def test_sym_custom_backward_sees_post_forward_aux():
+    """Symbolic backward receives the aux values AFTER forward's in-place
+    update (reference semantics; matches the eager path)."""
+    seen = {}
+
+    @operator.register("aux_reader")
+    class AuxReaderProp(operator.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def list_outputs(self): return ["output"]
+        def list_auxiliary_states(self): return ["flag"]
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [[1]]
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    aux[0]._data = aux[0]._data * 0 + 7.0
+                    self.assign(out_data[0], req[0], in_data[0])
+                def backward(self, req, og, ind, outd, ig, aux):
+                    seen["aux_in_bwd"] = float(np.asarray(aux[0]._data)[0])
+                    self.assign(ig[0], req[0], og[0])
+            return _Op()
+
+    out = mx.sym.Custom(mx.sym.Variable("x"), op_type="aux_reader",
+                        name="ar")
+    ex = out.bind(args={"x": np.ones(2, np.float32)},
+                  aux_states={"ar_flag": np.zeros(1, np.float32)},
+                  args_grad={"x": np.zeros(2, np.float32)},
+                  grad_req={"x": "write"})
+    ex.forward(is_train=True)
+    ex.backward(nd.array(np.ones(2, np.float32)))
+    assert seen["aux_in_bwd"] == 7.0, seen

@@ -308,3 +308,17 @@ def test_native_jpeg_decoder_matches_pil():
     Image.fromarray(img).save(png, format="PNG")
     np.testing.assert_array_equal(image.imdecode(png.getvalue()).asnumpy(),
                                   img)
+
+
+def test_copy_make_border():
+    img = nd.array(np.arange(12, dtype=np.float32).reshape(2, 2, 3))
+    b = image.copyMakeBorder(img, 1, 1, 2, 2, border_type=0,
+                             values=5.0).asnumpy()
+    assert b.shape == (4, 6, 3)
+    assert (b[0] == 5.0).all() and (b[:, 0] == 5.0).all()
+    np.testing.assert_array_equal(b[1:3, 2:4], img.asnumpy())
+    r = image.copyMakeBorder(img, 1, 0, 0, 0, border_type=1).asnumpy()
+    np.testing.assert_array_equal(r[0], img.asnumpy()[0])
+    import pytest
+    with pytest.raises(ValueError):
+        image.copyMakeBorder(img, 1, 1, 1, 1, border_type=4)

@@ -268,3 +268,28 @@ def test_stop_gradient():
         y = x * nd.stop_gradient(x * x) + x
     y.backward()
     assert_close(x.grad.asnumpy(), [5.0])  # d/dx (x*sg(x^2)+x) = sg(x^2)+1
+
+
+def test_sample_distributions_per_element_params():
+    """Parity: mx.nd.sample_uniform/normal/exponential/poisson/gamma —
+    one output row of `shape` draws per parameter element."""
+    low = nd.array(np.array([0.0, 10.0], np.float32))
+    high = nd.array(np.array([1.0, 20.0], np.float32))
+    s = mx.nd.sample_uniform(low, high, shape=500).asnumpy()
+    assert s.shape == (2, 500)
+    assert 0 <= s[0].min() and s[0].max() <= 1
+    assert 10 <= s[1].min() <= s[1].max() <= 20
+    sn = mx.nd.sample_normal(nd.array(np.array([0.0, 100.0], np.float32)),
+                             nd.array(np.array([1.0, 1.0], np.float32)),
+                             shape=500).asnumpy()
+    assert abs(sn[0].mean()) < 0.3 and abs(sn[1].mean() - 100) < 0.3
+    sp = mx.nd.sample_poisson(nd.array(np.array([2.0], np.float32)),
+                              shape=500).asnumpy()
+    assert abs(sp.mean() - 2) < 0.5
+    sg = mx.nd.sample_gamma(nd.array(np.array([2.0], np.float32)),
+                            nd.array(np.array([3.0], np.float32)),
+                            shape=2000).asnumpy()
+    assert abs(sg.mean() - 6.0) < 0.6
+    se = mx.nd.sample_exponential(nd.array(np.array([4.0], np.float32)),
+                                  shape=2000).asnumpy()
+    assert abs(se.mean() - 0.25) < 0.05

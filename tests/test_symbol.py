@@ -210,3 +210,23 @@ def test_attr_scope():
     assert a.attr("group") == "stage1" and a.attr("lr_mult") == "2"
     assert b.attr("group") == "stage2" and b.attr("lr_mult") == "2"
     assert c.attr("group") is None
+
+
+def test_svm_output_hinge_gradients():
+    """Parity: mx.sym.SVMOutput (src/operator/svm_output.cc) — identity
+    forward, one-vs-all hinge backward; L1 and L2 variants."""
+    x = np.array([[2.0, 0.5, -1.0]], np.float32)
+    lab = np.array([0.0], np.float32)
+    for use_linear, want in ((True, [[0.0, 1.0, 0.0]]),
+                             # L2: -2*y*max(0, 1-y*x): y=[+1,-1,-1],
+                             # viol=[-1,1.5,0] -> [0, 2*1.5, 0]
+                             (False, [[0.0, 3.0, 0.0]])):
+        out = sym.SVMOutput(sym.Variable("d"), sym.Variable("l"),
+                            margin=1.0, use_linear=use_linear)
+        ex = out.bind(args={"d": x, "l": lab},
+                      args_grad={"d": np.zeros_like(x)},
+                      grad_req={"d": "write", "l": "null"})
+        np.testing.assert_allclose(ex.forward(is_train=True)[0].asnumpy(),
+                                   x)
+        ex.backward()
+        np.testing.assert_allclose(ex.grad_dict["d"].asnumpy(), want)
