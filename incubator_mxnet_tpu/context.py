@@ -142,3 +142,23 @@ def ctx_from_device(dev: jax.Device) -> Context:
     ctx = Context(devtype, dev.id)
     ctx._device = dev
     return ctx
+
+
+def gpu_memory_info(device_id=0):
+    """Parity: mx.context.gpu_memory_info — (free, total) bytes for the
+    accelerator. Backed by the jax device's memory_stats(); raises on
+    backends that expose none (the reference raises on non-GPU builds)."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if device_id >= len(devs):
+        raise ValueError(f"no accelerator device {device_id} "
+                         f"(have {len(devs)})")
+    stats = devs[device_id].memory_stats()
+    if not stats:
+        raise RuntimeError("device exposes no memory statistics")
+    total = stats.get("bytes_limit", stats.get("bytes_reservable_limit"))
+    if not total:
+        raise RuntimeError("device memory statistics carry no capacity "
+                           f"limit (keys: {sorted(stats)})")
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total

@@ -17,7 +17,7 @@ from . import ndarray as _nd
 from .runtime import engine_type, get_engine
 
 __all__ = ["push", "new_var", "wait_for_var", "wait_all", "engine_type",
-           "get_engine"]
+           "get_engine", "bulk"]
 
 
 def new_var() -> int:
@@ -37,3 +37,20 @@ def wait_all():
     """Barrier on host-engine tasks AND device async work (mx.nd.waitall)."""
     get_engine().wait_all()
     _nd.waitall()
+
+
+class bulk:
+    """Parity: mx.engine.bulk(size) — the reference batches `size` async
+    engine ops into one bulk segment to cut scheduling overhead. Here XLA
+    already batches device work per dispatch (and FusedTrainStep.run_k is
+    the explicit bulk form), so the context manager is semantically a
+    no-op that preserves reference code shape."""
+
+    def __init__(self, size=15):
+        self.size = int(size)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

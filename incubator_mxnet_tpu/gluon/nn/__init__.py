@@ -19,7 +19,7 @@ from ..block import Block, HybridBlock
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
-           "SiLU", "Embedding", "BatchNorm", "LayerNorm", "InstanceNorm",
+           "SiLU", "Embedding", "BatchNorm", "BatchNormReLU", "LayerNorm", "InstanceNorm",
            "GroupNorm", "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
            "Conv2DTranspose", "Conv3DTranspose", "MaxPool1D", "MaxPool2D",
            "MaxPool3D", "AvgPool1D", "AvgPool2D", "AvgPool3D",
@@ -291,6 +291,17 @@ class BatchNorm(HybridBlock):
             self.running_mean.update_aux(nm._data)
             self.running_var.update_aux(nv._data)
         return y
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm with a fused trailing ReLU (parity:
+    gluon.nn.BatchNormReLU / the reference's fused CUDNN_BATCHNORM_OPS
+    path). On TPU the fusion is XLA's job — the relu rides in the same
+    compiled computation as the normalization — so this class is pure
+    API parity with identical numerics."""
+
+    def forward(self, x):
+        return super().forward(x).relu()
 
 
 class LayerNorm(HybridBlock):

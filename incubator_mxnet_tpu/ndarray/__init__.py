@@ -541,6 +541,7 @@ arctanh = _make_unary(jnp.arctanh, "arctanh")
 erf = _make_unary(jax.scipy.special.erf, "erf")
 erfinv = _make_unary(jax.scipy.special.erfinv, "erfinv")
 gammaln = _make_unary(jax.scipy.special.gammaln, "gammaln")
+digamma = _make_unary(jax.scipy.special.digamma, "digamma")
 relu = _make_unary(jax.nn.relu, "relu")
 sigmoid = _make_unary(jax.nn.sigmoid, "sigmoid")
 softsign = _make_unary(jax.nn.soft_sign, "softsign")
@@ -813,6 +814,60 @@ def stack(*args, axis=0):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return _apply(lambda *xs: jnp.stack(xs, axis=axis), list(args), name="stack")
+
+
+def add_n(*args):
+    """Sum of N arrays (parity: mx.nd.add_n / ElementWiseSum,
+    src/operator/tensor/elemwise_sum.cc)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def f(*xs):
+        total = xs[0]
+        for x in xs[1:]:
+            total = total + x
+        return total
+
+    return _apply(f, list(args), name="add_n")
+
+
+ElementWiseSum = add_n
+
+
+def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (parity: mx.nd.reshape_like)."""
+    return _apply(lambda a, b: a.reshape(b.shape), [_as_nd(lhs),
+                                                    _as_nd(rhs)],
+                  name="reshape_like")
+
+
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares (parity: mx.nd.multi_sum_sq — the LARS
+    helper): one 1-D NDArray of shape (num_arrays,), like the reference."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    if num_arrays is not None and num_arrays != len(arrays):
+        raise ValueError(f"num_arrays={num_arrays} but got "
+                         f"{len(arrays)} arrays")
+    return _apply(
+        lambda *xs: jnp.stack([jnp.sum(jnp.square(x).astype(jnp.float32))
+                               for x in xs]),
+        [_as_nd(x) for x in arrays], name="multi_sum_sq")
+
+
+def khatri_rao(*args):
+    """Column-wise Kronecker product (parity: mx.nd.khatri_rao,
+    src/operator/contrib/krprod.cc): inputs (r_i, k) -> (prod r_i, k)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def f(*xs):
+        out = xs[0]
+        for b in xs[1:]:
+            out = (out[:, None, :] * b[None, :, :]).reshape(-1, b.shape[1])
+        return out
+
+    return _apply(f, list(args), name="khatri_rao")
 
 
 def split(x, num_outputs, axis=0, squeeze_axis=False):

@@ -468,6 +468,46 @@ def cond(pred, then_func, else_func, inputs):
     return res[0] if len(res) == 1 else res
 
 
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Parity: mx.nd.contrib.arange_like — arange sized by `data`'s shape
+    (whole array flattened-shape when axis is None, else that axis); with
+    repeat=r, r consecutive elements share a value, total size unchanged."""
+    def f(x):
+        n = x.shape[axis] if axis is not None else int(np.prod(x.shape))
+        if n % repeat:
+            raise ValueError(
+                f"arange_like: size {n} not divisible by repeat {repeat}")
+        # exact length: index arithmetic, never float-endpoint arange
+        r = start + step * jnp.arange(n // repeat, dtype=jnp.float32)
+        if repeat > 1:
+            r = jnp.repeat(r, repeat)
+        r = r.astype(x.dtype)
+        return r.reshape(x.shape) if axis is None else r
+    return _apply(f, [data], name="arange_like")
+
+
+def fft(data, compute_size=128):
+    """Parity: mx.nd.contrib.fft (src/operator/contrib/fft.cc): real input
+    (..., d) -> packed complex output (..., 2d), interleaved re/im."""
+    def f(x):
+        c = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+        out = jnp.stack([c.real, c.imag], axis=-1)
+        return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+    return _apply(f, [data], name="fft")
+
+
+def ifft(data, compute_size=128):
+    """Parity: mx.nd.contrib.ifft — input packed (..., 2d) interleaved
+    re/im, output real (..., d). Matches the reference's UNNORMALIZED
+    inverse: ifft(fft(x)) == d * x."""
+    def f(x):
+        d = x.shape[-1] // 2
+        z = x.astype(jnp.float32).reshape(x.shape[:-1] + (d, 2))
+        c = z[..., 0] + 1j * z[..., 1]
+        return (jnp.fft.ifft(c, axis=-1).real * d).astype(x.dtype)
+    return _apply(f, [data], name="ifft")
+
+
 # Mirror the op namespace onto mx.nd for reference-style calls, and expose
 # the box/SSD family under mx.nd.contrib.* like the reference.
 def _mirror_into_nd():
@@ -479,7 +519,8 @@ def _mirror_into_nd():
     contrib = types.ModuleType("incubator_mxnet_tpu.ndarray.contrib")
     for name in ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
                  "MultiBoxDetection", "multihead_attention",
-                 "foreach", "while_loop", "cond"]:
+                 "foreach", "while_loop", "cond",
+                 "arange_like", "fft", "ifft"]:
         setattr(contrib, name, globals()[name])
 
     def _contrib_getattr(name):
@@ -498,3 +539,4 @@ def _mirror_into_nd():
 
 
 _mirror_into_nd()
+

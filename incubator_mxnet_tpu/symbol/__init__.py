@@ -631,6 +631,15 @@ def ones(shape, dtype="float32", name=None):
                     name=name)
 
 
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", name=None):
+    """Parity: mx.sym.arange (src/operator/tensor/init_op.cc)."""
+    return _make_op("_arange", [],
+                    attrs={"start": float(start),
+                           "stop": None if stop is None else float(stop),
+                           "step": float(step), "repeat": int(repeat),
+                           "dtype": str(dtype)}, name=name)
+
+
 # ---------------------------------------------------------------------------
 # op application
 # ---------------------------------------------------------------------------

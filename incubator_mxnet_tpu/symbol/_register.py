@@ -87,6 +87,16 @@ for _name, _fn in {
 }.items():
     _reg_unary(_name, _fn)
 
+def _arange_fn(rt, a):
+    start, stop = a["start"], a.get("stop")
+    if stop is None:                      # mx.arange(N) == [0, N)
+        start, stop = 0.0, start
+    r = jnp.arange(start, stop, a["step"], normalize_dtype(a["dtype"]))
+    rep = int(a.get("repeat", 1))
+    return jnp.repeat(r, rep) if rep > 1 else r
+
+
+register_op("_arange", _arange_fn, ())
 register_op("_zeros", lambda rt, a: jnp.zeros(tuple(a["shape"]),
                                               normalize_dtype(a["dtype"])), ())
 register_op("_ones", lambda rt, a: jnp.ones(tuple(a["shape"]),
@@ -1032,7 +1042,7 @@ def _reg_nd_mirror(opname, arg_names, n_out=None):
 
 
 for _n in ["ceil", "floor", "trunc", "fix", "rint", "round", "cbrt", "rcbrt",
-           "reciprocal", "gammaln", "erfinv", "expm1", "log1p", "log2",
+           "reciprocal", "gammaln", "erfinv", "digamma", "expm1", "log1p", "log2",
            "log10", "sinh", "cosh", "arcsin", "arccos", "arctan", "arcsinh",
            "arccosh", "arctanh", "softsign", "isnan", "isinf", "logical_not",
            "gamma", "shape_array", "size_array"]:

@@ -213,3 +213,66 @@ def test_reflection_pad2d_reference_8tuple():
     np.testing.assert_array_equal(pad8(x).asnumpy(), ref)
     with pytest.raises(ValueError):
         gluon.nn.ReflectionPad2D(padding=(1, 0, 0, 0, 2, 0, 1, 1))
+
+
+def test_parity_sweep_round3_ops():
+    """Round-3 parity batch: add_n/ElementWiseSum, reshape_like,
+    multi_sum_sq, khatri_rao, digamma, sym.arange, contrib
+    arange_like/fft/ifft, BatchNormReLU, engine.bulk."""
+    from incubator_mxnet_tpu import gluon
+    a = nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    b = nd.array(np.array([[10., 20.], [30., 40.]], np.float32))
+    np.testing.assert_allclose(mx.nd.add_n(a, b).asnumpy(),
+                               [[11, 22], [33, 44]])
+    np.testing.assert_allclose(mx.nd.ElementWiseSum([a, b]).asnumpy(),
+                               [[11, 22], [33, 44]])
+    assert mx.nd.reshape_like(
+        nd.array(np.arange(4, dtype=np.float32)), a).shape == (2, 2)
+    ss = mx.nd.multi_sum_sq(a, b, num_arrays=2)
+    assert ss.shape == (2,)                     # one 1-D NDArray, like ref
+    np.testing.assert_allclose(ss.asnumpy(), [30.0, 3000.0])
+    kr = mx.nd.khatri_rao(
+        nd.array(np.array([[1., 2.], [3., 4.]], np.float32)),
+        nd.array(np.array([[1., 1.], [2., 2.]], np.float32)))
+    assert kr.shape == (4, 2)
+    np.testing.assert_allclose(kr.asnumpy()[:, 0], [1, 2, 3, 6])
+    np.testing.assert_allclose(
+        mx.nd.digamma(nd.array(np.array([1.0], np.float32))).asnumpy(),
+        [-0.5772157], rtol=1e-5)
+
+    np.testing.assert_allclose(
+        mx.sym.arange(5).bind(args={}, grad_req="null")
+        .forward()[0].asnumpy(), [0, 1, 2, 3, 4])
+    np.testing.assert_allclose(
+        mx.sym.arange(2, 6, step=2).bind(args={}, grad_req="null")
+        .forward()[0].asnumpy(), [2, 4])
+    np.testing.assert_allclose(
+        mx.nd.contrib.arange_like(a, start=1.0).asnumpy(),
+        [[1, 2], [3, 4]])
+    np.testing.assert_allclose(
+        mx.nd.contrib.arange_like(a, step=0.1).asnumpy(),
+        [[0, 0.1], [0.2, 0.3]], atol=1e-6)      # exact length w/ float step
+    np.testing.assert_allclose(
+        mx.nd.contrib.arange_like(a, repeat=2).asnumpy(),
+        [[0, 0], [1, 1]])                       # repeat keeps data's shape
+    np.testing.assert_allclose(
+        mx.sym.digamma(mx.sym.Variable("x")).bind(
+            args={"x": np.array([1.0], np.float32)},
+            grad_req="null").forward()[0].asnumpy(),
+        [-0.5772157], rtol=1e-5)
+
+    x = nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    fx = mx.nd.contrib.fft(x)
+    assert fx.shape == (2, 16)
+    # reference ifft is unnormalized: ifft(fft(x)) == d * x
+    np.testing.assert_allclose(mx.nd.contrib.ifft(fx).asnumpy(),
+                               8 * x.asnumpy(), rtol=1e-4, atol=1e-4)
+
+    bnr = gluon.nn.BatchNormReLU(axis=-1, in_channels=3)
+    bnr.initialize()
+    y = bnr(nd.array(np.random.RandomState(1).randn(4, 3)
+                     .astype(np.float32)))
+    assert (y.asnumpy() >= 0).all() and (y.asnumpy() > 0).any()
+
+    with mx.engine.bulk(30):
+        np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
