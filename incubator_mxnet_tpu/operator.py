@@ -19,6 +19,11 @@ results through `assign` per the `req` mode. TPU-native realisation:
 The op body itself is host Python (that is the contract of the reference
 API — use pallas / jax ops for device-speed custom kernels instead); the
 framework guarantees correctness, not MXU throughput, for this surface.
+
+Auxiliary states (list_auxiliary_states) are supported on both surfaces:
+eager aux NDArrays mutate in place; symbolic aux flows through the
+executor's aux write-back protocol, with backward seeing the post-forward
+values and aux receiving zero gradients.
 """
 from __future__ import annotations
 
