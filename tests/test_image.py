@@ -322,3 +322,38 @@ def test_copy_make_border():
     import pytest
     with pytest.raises(ValueError):
         image.copyMakeBorder(img, 1, 1, 1, 1, border_type=4)
+
+
+@pytest.mark.slow
+def test_im2rec_cli_roundtrip(tmp_path):
+    """tools/im2rec.py: folder -> .lst/.rec/.idx consumable by
+    ImageRecordIter with subdirectory labels (reference tools/im2rec)."""
+    import subprocess
+    import sys as _sys
+    from PIL import Image as PILImage
+    root = tmp_path / "imgs"
+    rng = np.random.RandomState(0)
+    for ci, cls in enumerate(["cat", "dog"]):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = rng.randint(0, 255, (20 + ci, 24, 3), np.uint8)
+            PILImage.fromarray(arr).save(root / cls / f"{i}.jpg",
+                                         quality=95)
+    prefix = str(tmp_path / "data")
+    out = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "im2rec.py"),
+         prefix, str(root), "--resize", "16"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PALLAS_AXON_POOL_IPS=""))
+    assert out.returncode == 0, out.stderr[-500:]
+    assert os.path.exists(prefix + ".lst")
+    assert os.path.exists(prefix + ".rec")
+    it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                             data_shape=(3, 16, 16), batch_size=6,
+                             shuffle=False)
+    batch = it.next()
+    labels = batch.label[0].asnumpy()
+    np.testing.assert_allclose(sorted(labels), [0, 0, 0, 1, 1, 1])

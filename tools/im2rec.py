@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""im2rec: image folder -> .lst / .rec / .idx (parity: the reference's
+tools/im2rec.py data-prep CLI).
+
+Labels come from the immediate subdirectory of `root` (sorted name order,
+like the reference's folder walk); pass an existing .lst to pack a curated
+split instead. Images are re-encoded to JPEG at --quality (and optionally
+--resize shortest side) so training-time decode is uniform — the
+reference's offline-preprocessing recipe that keeps the input pipeline
+chip-bound instead of decode-bound.
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT [--list] [--resize N] [--quality Q]
+                                     [--exts .jpg,.jpeg,.png]
+
+  --list       only generate PREFIX.lst (index \t label \t relpath)
+  otherwise    read/auto-generate PREFIX.lst and write PREFIX.rec + .idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_list(root, exts):
+    """[(index, label, relpath)] — labels by sorted subdirectory name."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: float(i) for i, c in enumerate(classes)}
+    entries = []
+    i = 0
+    for c in classes:
+        cdir = os.path.join(root, c)
+        for f in sorted(os.listdir(cdir)):
+            if os.path.splitext(f)[1].lower() in exts:
+                entries.append((i, label_of[c], os.path.join(c, f)))
+                i += 1
+    if not entries:
+        raise SystemExit(f"no images with extensions {sorted(exts)} under "
+                         f"{root!r}")
+    return entries
+
+
+def write_list(path, entries):
+    with open(path, "w") as f:
+        for idx, label, rel in entries:
+            f.write(f"{idx}\t{label:g}\t{rel}\n")
+
+
+def read_list(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            out.append((int(parts[0]), float(parts[1]), parts[-1]))
+    return out
+
+
+def pack(prefix, root, entries, resize, quality):
+    import numpy as np
+    from PIL import Image
+
+    from incubator_mxnet_tpu import recordio
+
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    n = 0
+    for idx, label, rel in entries:
+        img = Image.open(os.path.join(root, rel)).convert("RGB")
+        if resize:
+            w, h = img.size
+            s = resize / min(w, h)
+            img = img.resize((max(1, round(w * s)), max(1, round(h * s))),
+                             Image.BILINEAR)
+        payload = recordio.pack_img(
+            recordio.IRHeader(0, label, idx, 0),
+            np.asarray(img, np.uint8), quality=quality)
+        writer.write_idx(idx, payload)
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images", file=sys.stderr)
+    writer.close()
+    print(f"wrote {n} records -> {prefix}.rec / {prefix}.idx")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (PREFIX.lst/.rec/.idx)")
+    p.add_argument("root", help="image folder (class subdirectories)")
+    p.add_argument("--list", action="store_true",
+                   help="only generate PREFIX.lst")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shortest side to N pixels (0 = keep)")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--exts", default=".jpg,.jpeg,.png",
+                   help="comma-separated image extensions")
+    a = p.parse_args(argv)
+    exts = {e if e.startswith(".") else "." + e
+            for e in a.exts.lower().split(",")}
+
+    lst = a.prefix + ".lst"
+    if a.list or not os.path.exists(lst):
+        entries = make_list(a.root, exts)
+        write_list(lst, entries)
+        print(f"wrote {len(entries)} entries -> {lst}")
+        if a.list:
+            return
+    entries = read_list(lst)
+    pack(a.prefix, a.root, entries, a.resize, a.quality)
+
+
+if __name__ == "__main__":
+    main()
