@@ -20,6 +20,11 @@ import argparse
 import os
 import sys
 
+# host-only tool: never initialize an accelerator backend (the framework
+# import would otherwise register the TPU platform for pure CPU work)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -49,11 +54,21 @@ def write_list(path, entries):
 
 
 def read_list(path):
+    """idx \t label... \t relpath — multi-label rows keep the full label
+    vector (the reference's detection/multi-task .lst format)."""
     out = []
     with open(path) as f:
-        for line in f:
+        for ln, line in enumerate(f, 1):
+            if not line.strip():
+                continue
             parts = line.rstrip("\n").split("\t")
-            out.append((int(parts[0]), float(parts[1]), parts[-1]))
+            try:
+                labels = [float(v) for v in parts[1:-1]]
+                label = labels[0] if len(labels) == 1 else labels
+                out.append((int(parts[0]), label, parts[-1]))
+            except (ValueError, IndexError):
+                raise SystemExit(f"{path}:{ln}: malformed .lst line "
+                                 f"{line.rstrip()!r}")
     return out
 
 
@@ -65,23 +80,35 @@ def pack(prefix, root, entries, resize, quality):
 
     writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
                                         "w")
-    n = 0
+    n = skipped = 0
     for idx, label, rel in entries:
-        img = Image.open(os.path.join(root, rel)).convert("RGB")
-        if resize:
-            w, h = img.size
-            s = resize / min(w, h)
-            img = img.resize((max(1, round(w * s)), max(1, round(h * s))),
-                             Image.BILINEAR)
-        payload = recordio.pack_img(
-            recordio.IRHeader(0, label, idx, 0),
-            np.asarray(img, np.uint8), quality=quality)
+        try:
+            img = Image.open(os.path.join(root, rel)).convert("RGB")
+            if resize:
+                w, h = img.size
+                s = resize / min(w, h)
+                img = img.resize((max(1, round(w * s)),
+                                  max(1, round(h * s))), Image.BILINEAR)
+            flag = len(label) if isinstance(label, list) else 0
+            header = recordio.IRHeader(
+                flag, np.asarray(label, np.float32)
+                if isinstance(label, list) else label, idx, 0)
+            payload = recordio.pack_img(header, np.asarray(img, np.uint8),
+                                        quality=quality)
+        except Exception as e:  # noqa: BLE001 — one bad image must not
+            skipped += 1        # abort an hours-long pack (reference logs
+            print(f"skipping {rel}: {type(e).__name__}: {e}",
+                  file=sys.stderr)      # and continues the same way)
+            continue
         writer.write_idx(idx, payload)
         n += 1
         if n % 1000 == 0:
             print(f"packed {n} images", file=sys.stderr)
     writer.close()
-    print(f"wrote {n} records -> {prefix}.rec / {prefix}.idx")
+    msg = f"wrote {n} records -> {prefix}.rec / {prefix}.idx"
+    if skipped:
+        msg += f" ({skipped} unreadable images skipped)"
+    print(msg)
 
 
 def main(argv=None):
