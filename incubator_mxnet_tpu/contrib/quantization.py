@@ -15,7 +15,11 @@ find one |max| per tensor.
 Modes, mirroring the reference's `quantize_model` API surface:
 - no calibration: activation ranges computed per batch on device (dynamic);
 - 'naive' calibration: run calib batches through the fp32 net, record each
-  quantized layer's input |max|, bake static scales (no per-batch reduce).
+  quantized layer's input |max|, bake static scales (no per-batch reduce);
+- 'entropy' calibration: per-layer KL-optimal clip thresholds over the
+  observed |activation| distribution (the reference's
+  _get_optimal_threshold), clipping rare outliers for finer in-range
+  resolution.
 """
 from __future__ import annotations
 
@@ -223,6 +227,66 @@ def _wrap(block):
     return None
 
 
+def _entropy_threshold(samples, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence-optimal clip threshold over |activation| samples
+    (reference _get_optimal_threshold, python/mxnet/contrib/quantization.py):
+    slide the clip point, compare the clipped distribution P against its
+    num_quantized_bins quantization Q, keep the threshold minimizing
+    KL(P||Q). Clips rare outliers so the int8 grid spends its codes where
+    the mass is."""
+    import numpy as _np
+    samples = _np.abs(_np.asarray(samples, _np.float64).ravel())
+    amax = float(samples.max()) if samples.size else 0.0
+    if amax <= 0.0:
+        return 1e-12
+    hist, edges = _np.histogram(samples, bins=num_bins, range=(0.0, amax))
+    hist = hist.astype(_np.float64)
+
+    def smooth(d, eps=1e-4):
+        """Reference _smooth_distribution: move eps mass onto zero bins so
+        KL stays finite without hard skip rules."""
+        is_zero = d == 0
+        n_zero = int(is_zero.sum())
+        n_nonzero = d.size - n_zero
+        if n_nonzero == 0 or n_zero == 0:
+            return d
+        out = d.copy()
+        out[is_zero] = eps
+        out[~is_zero] -= eps * n_zero / n_nonzero
+        return out
+
+    best_kl, best_i = _np.inf, num_bins
+    for i in range(num_quantized_bins, num_bins + 1):
+        sliced = hist[:i]
+        p = sliced.copy()
+        p[i - 1] += hist[i:].sum()            # outliers clip into the edge
+        if p.sum() == 0:
+            continue
+        # q: the SLICED (pre-clip) distribution quantized to
+        # num_quantized_bins and expanded back — clipped outlier mass
+        # lives in p but not q, so aggressive clipping raises KL (the
+        # reference's construction). Vectorized: per-chunk sums and
+        # nonzero counts via reduceat, expanded with repeat.
+        bounds = (_np.arange(num_quantized_bins) * i) // num_quantized_bins
+        bounds = _np.unique(bounds)
+        sizes = _np.diff(_np.append(bounds, i))
+        nzmask = sliced > 0
+        sums = _np.add.reduceat(sliced, bounds)
+        nzcnt = _np.add.reduceat(nzmask.astype(_np.float64), bounds)
+        avg = _np.where(nzcnt > 0, sums / _np.maximum(nzcnt, 1.0), 0.0)
+        q = _np.repeat(avg, sizes) * nzmask
+        if q.sum() == 0:
+            continue
+        p_s = smooth(p)                       # smooth raw counts, like ref
+        q_s = smooth(q)
+        p_n = p_s / p_s.sum()
+        q_n = q_s / q_s.sum()
+        kl = float(_np.sum(p_n * _np.log(p_n / q_n)))
+        if _np.isfinite(kl) and kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(edges[best_i])
+
+
 def _clear_hybrid_caches(block):
     """Drop every HybridBlock's traced-graph cache in the tree: a cached
     fp32 CachedOp would otherwise keep serving the OLD graph after layers
@@ -233,12 +297,16 @@ def _clear_hybrid_caches(block):
         _clear_hybrid_caches(child)
 
 
-def quantize_net(net, calib_data=None, exclude=()):
+def quantize_net(net, calib_data=None, exclude=(), calib_mode=None):
     """Replace every Dense/Conv2D in `net` (in place, recursively) with its
     int8 twin; with `calib_data` (an iterable of input batches) run a
-    'naive' min/max calibration pass first so activation scales are baked
-    static (reference `quantize_model(..., calib_mode='naive')`). Blocks
-    in `exclude` (by reference) are left fp32. Returns `net`.
+    calibration pass first so activation scales are baked static.
+    calib_mode='naive' records each layer's |max| (reference
+    `quantize_model(..., calib_mode='naive')`); 'entropy' collects
+    |activation| samples and picks the KL-optimal clip threshold per layer
+    (reference calib_mode='entropy'), trading rare-outlier fidelity for
+    finer resolution where the mass is. Blocks in `exclude` (by
+    reference) are left fp32. Returns `net`.
 
     Works on hybridized nets too: traced-graph caches are cleared so both
     the calibration pass and the quantized net retrace. Deferred-shape
@@ -258,6 +326,15 @@ def quantize_net(net, calib_data=None, exclude=()):
             else:
                 collect(child)
 
+    if calib_mode is not None and calib_data is None:
+        raise ValueError(
+            f"calib_mode={calib_mode!r} needs calib_data; omit both for "
+            f"dynamic per-batch ranges")
+    if calib_mode is None:
+        calib_mode = "naive"
+    if calib_mode not in ("naive", "entropy"):
+        raise ValueError(f"calib_mode must be 'naive' or 'entropy', "
+                         f"got {calib_mode!r}")
     collect(net)
     if not targets:
         raise ValueError("no quantizable (Dense/Conv2D) layers found")
@@ -275,7 +352,9 @@ def quantize_net(net, calib_data=None, exclude=()):
 
     ranges = None
     if calib_data is not None:
+        import numpy as _np
         ranges = {id(c): 0.0 for _, _, c in targets}
+        samples = {id(c): [] for _, _, c in targets}
         hooked = []
         # calibration must run EAGERLY: a hybridized (traced) forward would
         # hand the hooks abstract tracers with no values to record
@@ -296,6 +375,16 @@ def quantize_net(net, calib_data=None, exclude=()):
                         x = inputs[0]
                         m = float(jnp.max(jnp.abs(x._data)))
                         ranges[cid] = max(ranges[cid], m)
+                        if calib_mode == "entropy":
+                            held = sum(c.size for c in samples[cid])
+                            if held >= 512 * 1024:
+                                return      # per-layer TOTAL cap: histogram
+                            flat = _np.abs(_np.asarray(x._data).ravel())
+                            if flat.size > 65536:   # per-batch cap
+                                flat = flat[_np.random.RandomState(0)
+                                            .choice(flat.size, 65536,
+                                                    replace=False)]
+                            samples[cid].append(flat.astype(_np.float32))
                     return pre_hook
                 child.register_forward_pre_hook(mk(id(child)))
                 hooked.append(child)
@@ -306,6 +395,11 @@ def quantize_net(net, calib_data=None, exclude=()):
                 child._forward_pre_hooks.pop()
             for b in deactivated:
                 b._active = True
+        if calib_mode == "entropy":
+            for cid, chunks in samples.items():
+                if chunks and ranges[cid] > 0.0:
+                    ranges[cid] = _entropy_threshold(
+                        _np.concatenate(chunks))
 
     for parent, name, child in targets:
         wrapped = _wrap(child)

@@ -63,6 +63,8 @@ def read_list(path):
                 continue
             parts = line.rstrip("\n").split("\t")
             try:
+                if len(parts) < 3:
+                    raise ValueError("need idx, label(s), path")
                 labels = [float(v) for v in parts[1:-1]]
                 label = labels[0] if len(labels) == 1 else labels
                 out.append((int(parts[0]), label, parts[-1]))
@@ -89,12 +91,10 @@ def pack(prefix, root, entries, resize, quality):
                 s = resize / min(w, h)
                 img = img.resize((max(1, round(w * s)),
                                   max(1, round(h * s))), Image.BILINEAR)
-            flag = len(label) if isinstance(label, list) else 0
-            header = recordio.IRHeader(
-                flag, np.asarray(label, np.float32)
-                if isinstance(label, list) else label, idx, 0)
-            payload = recordio.pack_img(header, np.asarray(img, np.uint8),
-                                        quality=quality)
+            # recordio.pack handles list labels (float32 vector + flag)
+            payload = recordio.pack_img(
+                recordio.IRHeader(0, label, idx, 0),
+                np.asarray(img, np.uint8), quality=quality)
         except Exception as e:  # noqa: BLE001 — one bad image must not
             skipped += 1        # abort an hours-long pack (reference logs
             print(f"skipping {rel}: {type(e).__name__}: {e}",
