@@ -1,0 +1,1126 @@
+"""ONNX model interchange: export (mx2onnx) and import (onnx2mx).
+
+Reference parity: ``python/mxnet/contrib/onnx/`` — ``mx2onnx/export_model.py``
+(symbol+params -> ModelProto) and ``onnx2mx/import_model.py``
+(ModelProto -> symbol, arg_params, aux_params). The reference serializes
+through the pip ``onnx`` package; that package is not in this image, so this
+module carries the public ONNX IR schema (``onnx_ir.proto``, field numbers
+identical to upstream onnx.proto3) and uses protoc-generated bindings.
+Files written here load in stock onnx/onnxruntime and vice versa.
+
+TPU-first note: the exporter works on the *symbol graph*, which in this
+framework is the single serialization format for every frontend (Gluon
+HybridBlock export, Module checkpoints) — so one graph walker covers all
+model families. Layout must be NCHW (ONNX's convention); NHWC graphs
+(the TPU-preferred layout of the model zoo) are rejected with a clear
+error rather than silently transposed.
+
+Supported op surface (opset 13): Convolution/Deconvolution, Pooling
+(incl. global), BatchNorm, FullyConnected, Activation, LeakyReLU/ELU/SELU,
+Dropout, Flatten, Reshape, transpose, expand_dims, squeeze, slice_axis,
+Concat, add_n, Embedding, softmax/log_softmax/SoftmaxOutput, elementwise
+and broadcast arithmetic, scalar arithmetic, clip, sum/mean/max/min
+reductions, and the common unary math ops — enough for every CNN in the
+model zoo plus MLP/embedding models.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+if _HERE not in sys.path:  # protoc gencode does a top-level sibling import
+    sys.path.insert(0, _HERE)
+from . import onnx_ir_pb2 as P  # noqa: E402
+
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "import_to_gluon"]
+
+_ONNX_OPSET = 13
+_IR_VERSION = 8
+
+# numpy dtype -> TensorProto.DataType
+_NP2ONNX = {
+    np.dtype("float32"): P.TensorProto.FLOAT,
+    np.dtype("float64"): P.TensorProto.DOUBLE,
+    np.dtype("float16"): P.TensorProto.FLOAT16,
+    np.dtype("uint8"): P.TensorProto.UINT8,
+    np.dtype("int8"): P.TensorProto.INT8,
+    np.dtype("int32"): P.TensorProto.INT32,
+    np.dtype("int64"): P.TensorProto.INT64,
+    np.dtype("bool"): P.TensorProto.BOOL,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def _np_to_tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _NP2ONNX:  # bfloat16 etc. -> float32
+        arr = arr.astype(np.float32)
+    t = P.TensorProto(name=name, data_type=_NP2ONNX[arr.dtype])
+    t.dims.extend(arr.shape)
+    t.raw_data = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    return t
+
+
+def _tensor_to_np(t):
+    if t.data_type not in _ONNX2NP:
+        raise ValueError("unsupported ONNX tensor dtype %d" % t.data_type)
+    dt = _ONNX2NP[t.data_type].newbyteorder("<")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+    if t.data_type == P.TensorProto.FLOAT:
+        return np.asarray(t.float_data, np.float32).reshape(shape)
+    if t.data_type == P.TensorProto.DOUBLE:
+        return np.asarray(t.double_data, np.float64).reshape(shape)
+    if t.data_type == P.TensorProto.INT64:
+        return np.asarray(t.int64_data, np.int64).reshape(shape)
+    if t.data_type in (P.TensorProto.INT32, P.TensorProto.INT8,
+                       P.TensorProto.UINT8, P.TensorProto.BOOL,
+                       P.TensorProto.FLOAT16):
+        raw = np.asarray(t.int32_data, np.int32)
+        return raw.astype(_ONNX2NP[t.data_type]).reshape(shape)
+    raise ValueError("empty tensor %r" % t.name)
+
+
+def _vi(name, shape, elem_type=P.TensorProto.FLOAT):
+    v = P.ValueInfoProto(name=name)
+    tt = v.type.tensor_type
+    tt.elem_type = elem_type
+    if shape is not None:
+        for d in shape:
+            dim = tt.shape.dim.add()
+            dim.dim_value = int(d)
+    # unknown shape: leave the shape field unset (unknown rank); an empty
+    # TensorShapeProto would wrongly claim a scalar
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Export (mx2onnx)
+# ---------------------------------------------------------------------------
+
+class _Exporter:
+    def __init__(self, graph_json, params, opset):
+        self.nodes = graph_json["nodes"]
+        self.heads = graph_json["heads"]
+        self.params = params
+        self.opset = opset
+        self.g = P.GraphProto()
+        self.names = {}          # (node_idx, out_idx) -> tensor name
+        self.emitted_inits = set()
+        self.used_inputs = []    # graph-input var names in consumption order
+
+    # -- helpers ------------------------------------------------------------
+    def name_of(self, node_idx, out_idx=0):
+        return self.names[(node_idx, out_idx)]
+
+    def in_names(self, node):
+        return [self.name_of(i, o) for i, o in node["inputs"]]
+
+    def add_node(self, op_type, inputs, outputs, name, **attrs):
+        n = self.g.node.add(op_type=op_type, name=name)
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            a = n.attribute.add(name=k)
+            if isinstance(v, float):
+                a.type = P.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, bool) or isinstance(v, int):
+                a.type = P.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, str):
+                a.type = P.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)):
+                if v and isinstance(v[0], float):
+                    a.type = P.AttributeProto.FLOATS
+                    a.floats.extend(v)
+                else:
+                    a.type = P.AttributeProto.INTS
+                    a.ints.extend(int(x) for x in v)
+            else:
+                raise TypeError("attr %s=%r" % (k, v))
+        return n
+
+    def add_init(self, name, arr):
+        if name not in self.emitted_inits:
+            self.g.initializer.append(_np_to_tensor(name, np.asarray(arr)))
+            self.emitted_inits.add(name)
+        return name
+
+    def var_used(self, node_idx):
+        """Mark a null node as consumed: param -> initializer, else input."""
+        node = self.nodes[node_idx]
+        name = node["name"]
+        if name in self.params:
+            self.add_init(name, self.params[name].asnumpy()
+                          if hasattr(self.params[name], "asnumpy")
+                          else self.params[name])
+        elif name not in self.used_inputs:
+            self.used_inputs.append(name)
+        return name
+
+    # -- conversion ---------------------------------------------------------
+    def run(self):
+        for idx, node in enumerate(self.nodes):
+            op = node["op"]
+            name = node["name"]
+            if op == "null":
+                self.names[(idx, 0)] = name
+                continue
+            fn = _EXPORTERS.get(op)
+            if fn is None:
+                raise NotImplementedError(
+                    "ONNX export: unsupported op %r (node %r). Supported: %s"
+                    % (op, name, ", ".join(sorted(_EXPORTERS))))
+            # mark consumed variable inputs (the handler may drop some,
+            # e.g. SoftmaxOutput's label — handlers call var_used themselves
+            # via resolve())
+            fn(self, idx, node)
+        return self.g
+
+    def resolve(self, node, positions=None):
+        """Tensor names for a node's inputs, registering consumed vars."""
+        ins = node["inputs"]
+        if positions is not None:
+            ins = [ins[p] for p in positions if p < len(ins)]
+        out = []
+        for i, o in ins:
+            if self.nodes[i]["op"] == "null":
+                out.append(self.var_used(i))
+            else:
+                out.append(self.name_of(i, o))
+        return out
+
+
+_EXPORTERS = {}
+
+
+def _export(*ops):
+    def deco(fn):
+        for op in ops:
+            _EXPORTERS[op] = fn
+        return fn
+    return deco
+
+
+def _sym_pads(pad, ndim):
+    pad = tuple(pad or (0,) * ndim)
+    return list(pad) + list(pad)
+
+
+@_export("Convolution")
+def _exp_conv(ex, idx, node):
+    a = node["attrs"]
+    if (a.get("layout") or "NCHW") not in ("NCHW", "NCW", "NCDHW"):
+        raise NotImplementedError(
+            "ONNX export requires NCHW layout (got %s); rebuild the model "
+            "with layout='NCHW'" % a["layout"])
+    k = tuple(a["kernel"])
+    ex.add_node("Conv", ex.resolve(node), [node["name"]], node["name"],
+                kernel_shape=list(k),
+                strides=list(a.get("stride") or (1,) * len(k)),
+                dilations=list(a.get("dilate") or (1,) * len(k)),
+                pads=_sym_pads(a.get("pad"), len(k)),
+                group=int(a.get("num_group", 1)))
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Deconvolution")
+def _exp_deconv(ex, idx, node):
+    a = node["attrs"]
+    if (a.get("layout") or "NCHW") != "NCHW":
+        raise NotImplementedError("ONNX export requires NCHW layout")
+    k = tuple(a["kernel"])
+    kw = dict(kernel_shape=list(k),
+              strides=list(a.get("stride") or (1,) * len(k)),
+              dilations=list(a.get("dilate") or (1,) * len(k)),
+              pads=_sym_pads(a.get("pad"), len(k)),
+              group=int(a.get("num_group", 1)))
+    if a.get("adj"):
+        kw["output_padding"] = list(a["adj"])
+    ex.add_node("ConvTranspose", ex.resolve(node), [node["name"]],
+                node["name"], **kw)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("FullyConnected")
+def _exp_fc(ex, idx, node):
+    a = node["attrs"]
+    ins = ex.resolve(node)
+    data = ins[0]
+    if a.get("flatten", True):
+        flat = node["name"] + "_flat"
+        ex.add_node("Flatten", [data], [flat], flat, axis=1)
+        data = flat
+    ex.add_node("Gemm", [data] + ins[1:], [node["name"]], node["name"],
+                alpha=1.0, beta=1.0, transA=0, transB=1)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Pooling")
+def _exp_pool(ex, idx, node):
+    a = node["attrs"]
+    if (a.get("layout") or "NCHW") not in ("NCHW", "NCW", "NCDHW"):
+        raise NotImplementedError("ONNX export requires NCHW layout")
+    ptype = a.get("pool_type", "max")
+    ins = ex.resolve(node)
+    if a.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise NotImplementedError("global %s pooling" % ptype)
+        ex.add_node(op, ins, [node["name"]], node["name"])
+    else:
+        k = tuple(a.get("kernel", (2, 2)))
+        kw = dict(kernel_shape=list(k),
+                  strides=list(a.get("stride") or k),
+                  pads=_sym_pads(a.get("pad"), len(k)),
+                  ceil_mode=int(bool(a.get("ceil_mode", False))))
+        if ptype == "max":
+            op = "MaxPool"
+        elif ptype == "avg":
+            op = "AveragePool"
+            kw["count_include_pad"] = int(bool(a.get("count_include_pad",
+                                                     True)))
+        else:
+            raise NotImplementedError("pool_type=%s" % ptype)
+        ex.add_node(op, ins, [node["name"]], node["name"], **kw)
+    ex.names[(idx, 0)] = node["name"]
+
+
+_ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@_export("Activation")
+def _exp_act(ex, idx, node):
+    act = node["attrs"].get("act_type", "relu")
+    if act not in _ACT2ONNX:
+        raise NotImplementedError("Activation act_type=%s" % act)
+    ex.add_node(_ACT2ONNX[act], ex.resolve(node), [node["name"]],
+                node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("LeakyReLU")
+def _exp_leaky(ex, idx, node):
+    a = node["attrs"]
+    act = a.get("act_type", "leaky")
+    slope = float(a.get("slope", 0.25))
+    ins = ex.resolve(node)
+    if act == "leaky":
+        ex.add_node("LeakyRelu", ins, [node["name"]], node["name"],
+                    alpha=slope)
+    elif act == "elu":
+        ex.add_node("Elu", ins, [node["name"]], node["name"], alpha=slope)
+    elif act == "selu":
+        ex.add_node("Selu", ins, [node["name"]], node["name"])
+    else:
+        raise NotImplementedError("LeakyReLU act_type=%s" % act)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("BatchNorm")
+def _exp_bn(ex, idx, node):
+    a = node["attrs"]
+    ins = ex.resolve(node)
+    if a.get("fix_gamma", False):
+        gname = ins[1]
+        if gname in ex.params:
+            gamma = ex.params[gname]
+            gamma = gamma.asnumpy() if hasattr(gamma, "asnumpy") else gamma
+            # fix_gamma pins gamma to 1 at run time (reference batch_norm.cc
+            # semantics); bake that into the exported initializer
+            for t in ex.g.initializer:
+                if t.name == gname:
+                    t.CopyFrom(_np_to_tensor(gname, np.ones_like(gamma)))
+    ex.add_node("BatchNormalization", ins, [node["name"]], node["name"],
+                epsilon=float(a.get("eps", 1e-5)),
+                momentum=float(a.get("momentum", 0.9)))
+    ex.names[(idx, 0)] = node["name"]
+    # outputs 1/2 (updated moving stats) exist only in training graphs;
+    # consuming them in an exported inference graph is an error caught by
+    # name_of raising KeyError.
+
+
+@_export("Flatten")
+def _exp_flatten(ex, idx, node):
+    ex.add_node("Flatten", ex.resolve(node), [node["name"]], node["name"],
+                axis=1)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Reshape")
+def _exp_reshape(ex, idx, node):
+    shape = tuple(node["attrs"]["shape"])
+    if any(s in (-2, -3, -4) for s in shape):
+        raise NotImplementedError("Reshape specials -2/-3/-4 have no ONNX "
+                                  "equivalent")
+    sname = ex.add_init(node["name"] + "_shape",
+                        np.asarray(shape, np.int64))
+    ex.add_node("Reshape", ex.resolve(node) + [sname], [node["name"]],
+                node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("transpose")
+def _exp_transpose(ex, idx, node):
+    axes = node["attrs"].get("axes")
+    kw = {"perm": list(axes)} if axes else {}
+    ex.add_node("Transpose", ex.resolve(node), [node["name"]], node["name"],
+                **kw)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("expand_dims")
+def _exp_expand(ex, idx, node):
+    aname = ex.add_init(node["name"] + "_axes",
+                        np.asarray([node["attrs"]["axis"]], np.int64))
+    ex.add_node("Unsqueeze", ex.resolve(node) + [aname], [node["name"]],
+                node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("squeeze")
+def _exp_squeeze(ex, idx, node):
+    axis = node["attrs"].get("axis")
+    ins = ex.resolve(node)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        ins = ins + [ex.add_init(node["name"] + "_axes",
+                                 np.asarray(axes, np.int64))]
+    ex.add_node("Squeeze", ins, [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("slice_axis")
+def _exp_slice(ex, idx, node):
+    a = node["attrs"]
+    end = a.get("end")
+    end = np.iinfo(np.int64).max if end is None else end
+    ins = ex.resolve(node) + [
+        ex.add_init(node["name"] + "_starts",
+                    np.asarray([a["begin"]], np.int64)),
+        ex.add_init(node["name"] + "_ends", np.asarray([end], np.int64)),
+        ex.add_init(node["name"] + "_axes",
+                    np.asarray([a["axis"]], np.int64))]
+    ex.add_node("Slice", ins, [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Concat")
+def _exp_concat(ex, idx, node):
+    ex.add_node("Concat", ex.resolve(node), [node["name"]], node["name"],
+                axis=int(node["attrs"].get("dim", 1)))
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("add_n")
+def _exp_addn(ex, idx, node):
+    ex.add_node("Sum", ex.resolve(node), [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Embedding")
+def _exp_embedding(ex, idx, node):
+    ins = ex.resolve(node)  # (indices, weight)
+    cast = node["name"] + "_idx64"
+    ex.add_node("Cast", [ins[0]], [cast], cast, to=int(P.TensorProto.INT64))
+    ex.add_node("Gather", [ins[1], cast], [node["name"]], node["name"],
+                axis=0)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("softmax")
+def _exp_softmax(ex, idx, node):
+    ex.add_node("Softmax", ex.resolve(node), [node["name"]], node["name"],
+                axis=int(node["attrs"].get("axis", -1)))
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("log_softmax")
+def _exp_log_softmax(ex, idx, node):
+    ex.add_node("LogSoftmax", ex.resolve(node), [node["name"]],
+                node["name"], axis=int(node["attrs"].get("axis", -1)))
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("SoftmaxOutput")
+def _exp_softmax_output(ex, idx, node):
+    # inference export: softmax over the class axis; the label input is
+    # dropped (reference mx2onnx does the same)
+    ins = ex.resolve(node, positions=[0])
+    ex.add_node("Softmax", ins, [node["name"]], node["name"], axis=1)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("Dropout")
+def _exp_dropout(ex, idx, node):
+    # inference graph: identity (ONNX Dropout in eval mode is identity too)
+    ex.add_node("Identity", ex.resolve(node, positions=[0]),
+                [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+_BINOP = {"_plus": "Add", "elemwise_add": "Add", "broadcast_add": "Add",
+          "_minus": "Sub", "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+          "_mul": "Mul", "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+          "_div": "Div", "elemwise_div": "Div", "broadcast_div": "Div",
+          "_power": "Pow", "broadcast_power": "Pow",
+          "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+          "dot": "MatMul"}
+
+
+@_export(*_BINOP)
+def _exp_binop(ex, idx, node):
+    ex.add_node(_BINOP[node["op"]], ex.resolve(node), [node["name"]],
+                node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+_SCALAR_OP = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+              "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+              "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+              "_power_scalar": ("Pow", False)}
+
+
+@_export(*_SCALAR_OP)
+def _exp_scalar(ex, idx, node):
+    op, reverse = _SCALAR_OP[node["op"]]
+    s = ex.add_init(node["name"] + "_scalar",
+                    np.asarray(node["attrs"]["scalar"], np.float32))
+    ins = ex.resolve(node)
+    ins = [s, ins[0]] if reverse else [ins[0], s]
+    ex.add_node(op, ins, [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("clip")
+def _exp_clip(ex, idx, node):
+    a = node["attrs"]
+    ins = ex.resolve(node) + [
+        ex.add_init(node["name"] + "_min", np.asarray(a["a_min"], np.float32)),
+        ex.add_init(node["name"] + "_max", np.asarray(a["a_max"], np.float32))]
+    ex.add_node("Clip", ins, [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+_REDUCE = {"mean": "ReduceMean", "max": "ReduceMax", "min": "ReduceMin",
+           "prod": "ReduceProd"}
+
+
+@_export("mean", "max", "min", "prod")
+def _exp_reduce(ex, idx, node):
+    a = node["attrs"]
+    axis = a.get("axis")
+    kw = {"keepdims": int(bool(a.get("keepdims", False)))}
+    if axis is not None:
+        kw["axes"] = [axis] if isinstance(axis, int) else list(axis)
+    ex.add_node(_REDUCE[node["op"]], ex.resolve(node), [node["name"]],
+                node["name"], **kw)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("sum")
+def _exp_sum(ex, idx, node):
+    # ReduceSum moved axes to an input at opset 13
+    a = node["attrs"]
+    axis = a.get("axis")
+    ins = ex.resolve(node)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        ins = ins + [ex.add_init(node["name"] + "_axes",
+                                 np.asarray(axes, np.int64))]
+    ex.add_node("ReduceSum", ins, [node["name"]], node["name"],
+                keepdims=int(bool(a.get("keepdims", False))))
+    ex.names[(idx, 0)] = node["name"]
+
+
+_UNARY = {"exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+          "negative": "Neg", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "relu": "Relu", "floor": "Floor", "ceil": "Ceil", "erf": "Erf",
+          "sin": "Sin", "cos": "Cos"}
+
+
+@_export(*_UNARY)
+def _exp_unary(ex, idx, node):
+    ex.add_node(_UNARY[node["op"]], ex.resolve(node), [node["name"]],
+                node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("gelu")
+def _exp_gelu(ex, idx, node):
+    # opset 13 has no Gelu; emit the exact erf form
+    # 0.5 * x * (1 + erf(x / sqrt(2))). A tanh-approximate gelu exports to
+    # the same erf form (divergence < 1e-2, documented).
+    x = ex.resolve(node)[0]
+    n = node["name"]
+    inv = ex.add_init(n + "_rsqrt2", np.asarray(1.0 / np.sqrt(2.0),
+                                                np.float32))
+    half = ex.add_init(n + "_half", np.asarray(0.5, np.float32))
+    one = ex.add_init(n + "_one", np.asarray(1.0, np.float32))
+    ex.add_node("Mul", [x, inv], [n + "_s"], n + "_s")
+    ex.add_node("Erf", [n + "_s"], [n + "_e"], n + "_e")
+    ex.add_node("Add", [n + "_e", one], [n + "_a"], n + "_a")
+    ex.add_node("Mul", [x, n + "_a"], [n + "_m"], n + "_m")
+    ex.add_node("Mul", [n + "_m", half], [n], n)
+    ex.names[(idx, 0)] = n
+
+
+@_export("silu")
+def _exp_silu(ex, idx, node):
+    x = ex.resolve(node)[0]
+    n = node["name"]
+    ex.add_node("Sigmoid", [x], [n + "_sig"], n + "_sig")
+    ex.add_node("Mul", [x, n + "_sig"], [n], n)
+    ex.names[(idx, 0)] = n
+
+
+@_export("square")
+def _exp_square(ex, idx, node):
+    x = ex.resolve(node)[0]
+    ex.add_node("Mul", [x, x], [node["name"]], node["name"])
+    ex.names[(idx, 0)] = node["name"]
+
+
+def export_model(sym, params, input_shape, input_type="float32",
+                 onnx_file_path=None, model_name="incubator_mxnet_tpu_model",
+                 opset=_ONNX_OPSET):
+    """Symbol + params -> serialized ONNX ModelProto bytes.
+
+    Mirrors the reference ``onnx_mxnet.export_model`` signature
+    (mx2onnx/export_model.py): ``params`` maps arg/aux names to arrays
+    (NDArray or numpy; ``arg:``/``aux:`` name prefixes are stripped);
+    ``input_shape`` is a list of shapes for the graph's data inputs in
+    ``list_inputs()`` order. Writes ``onnx_file_path`` if given and always
+    returns the serialized bytes.
+    """
+    params = {k.split(":", 1)[-1]: v for k, v in dict(params).items()}
+    graph_json = json.loads(sym.tojson())
+    if any(n["op"] in ("_foreach", "_while_loop", "_cond")
+           for n in graph_json["nodes"]):
+        raise NotImplementedError("control-flow subgraphs cannot be "
+                                  "exported to ONNX")
+    ex = _Exporter(graph_json, params, opset)
+    g = ex.run()
+    g.name = model_name
+
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    elem = _NP2ONNX.get(np.dtype(input_type), P.TensorProto.FLOAT)
+    data_inputs = ex.used_inputs
+    if len(input_shape) < len(data_inputs):
+        raise ValueError("model has %d data inputs %r but input_shape has %d"
+                         % (len(data_inputs), data_inputs, len(input_shape)))
+    shape_of = dict(zip(data_inputs, input_shape))
+    for name in data_inputs:
+        g.input.append(_vi(name, shape_of[name], elem))
+
+    # output value infos via the symbol's own shape inference
+    try:
+        kw = dict(shape_of)
+        for k, v in params.items():
+            kw.setdefault(k, tuple(np.shape(
+                v.asnumpy() if hasattr(v, "asnumpy") else v)))
+        _, out_shapes, _ = sym.infer_shape(**kw)
+    except Exception:
+        out_shapes = [None] * len(graph_json["heads"])
+    for (hidx, hout), oshape in zip(graph_json["heads"], out_shapes):
+        g.output.append(_vi(ex.name_of(hidx, hout), oshape, elem))
+
+    m = P.ModelProto(ir_version=_IR_VERSION,
+                     producer_name="incubator-mxnet-tpu",
+                     producer_version="0.4", graph=g)
+    m.opset_import.add(domain="", version=opset)
+    data = m.SerializeToString()
+    if onnx_file_path:
+        with open(onnx_file_path, "wb") as f:
+            f.write(data)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Import (onnx2mx)
+# ---------------------------------------------------------------------------
+
+def _load_model_proto(model):
+    if isinstance(model, P.ModelProto):
+        return model
+    if isinstance(model, (bytes, bytearray)):
+        data = bytes(model)
+    else:
+        with open(model, "rb") as f:
+            data = f.read()
+    m = P.ModelProto()
+    m.ParseFromString(data)
+    return m
+
+
+class _Importer:
+    def __init__(self, m):
+        from ... import symbol as S
+        from ... import ndarray as nd
+        self.S, self.nd = S, nd
+        self.g = m.graph
+        self.inits = {t.name: _tensor_to_np(t) for t in self.g.initializer}
+        self.tensors = {}     # onnx tensor name -> Symbol
+        self.aux_names = set()
+
+    def sym_of(self, name):
+        if name not in self.tensors:
+            if name not in self.inits:
+                raise ValueError("ONNX import: undefined tensor %r" % name)
+            self.tensors[name] = self.S.Variable(name)
+        return self.tensors[name]
+
+    def run(self):
+        for v in self.g.input:
+            if v.name not in self.inits:
+                self.tensors[v.name] = self.S.Variable(v.name)
+        for node in self.g.node:
+            fn = _IMPORTERS.get(node.op_type)
+            if fn is None:
+                raise NotImplementedError(
+                    "ONNX import: unsupported op %r. Supported: %s"
+                    % (node.op_type, ", ".join(sorted(_IMPORTERS))))
+            fn(self, node, _attr_dict(node))
+        outs = [self.tensors[v.name] for v in self.g.output]
+        sym = outs[0] if len(outs) == 1 else self.S.Group(outs)
+        used = set(sym.list_arguments()) | set(sym.list_auxiliary_states())
+        arg_params, aux_params = {}, {}
+        for name, arr in self.inits.items():
+            if name not in used:
+                continue
+            dst = aux_params if name in self.aux_names else arg_params
+            dst[name] = self.nd.array(arr)
+        return sym, arg_params, aux_params
+
+
+def _attr_dict(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = tuple(a.ints)
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = tuple(a.floats)
+        else:
+            out[a.name] = a
+    return out
+
+
+_IMPORTERS = {}
+
+
+def _import(*ops):
+    def deco(fn):
+        for op in ops:
+            _IMPORTERS[op] = fn
+        return fn
+    return deco
+
+
+def _onnx_pads(attrs, ndim):
+    auto = attrs.get("auto_pad", "")
+    if auto not in ("", "NOTSET", "VALID"):
+        raise NotImplementedError(
+            "auto_pad=%s is unsupported; re-export the model with explicit "
+            "pads" % auto)
+    pads = attrs.get("pads")
+    if not pads:
+        return (0,) * ndim
+    begin, end = pads[:ndim], pads[ndim:]
+    if tuple(begin) != tuple(end):
+        raise NotImplementedError("asymmetric ONNX pads %r" % (pads,))
+    return tuple(begin)
+
+
+@_import("Conv")
+def _imp_conv(im, node, a):
+    k = tuple(a["kernel_shape"])
+    w = im.inits.get(node.input[1])
+    nf = a.get("num_filter") or (w.shape[0] if w is not None else None)
+    if nf is None:
+        raise ValueError("Conv %s: weight initializer required to recover "
+                         "num_filter" % node.name)
+    im.tensors[node.output[0]] = im.S.Convolution(
+        data=im.sym_of(node.input[0]), weight=im.sym_of(node.input[1]),
+        bias=im.sym_of(node.input[2]) if len(node.input) > 2 else None,
+        no_bias=len(node.input) <= 2, kernel=k,
+        stride=tuple(a.get("strides", (1,) * len(k))),
+        dilate=tuple(a.get("dilations", (1,) * len(k))),
+        pad=_onnx_pads(a, len(k)), num_filter=int(nf),
+        num_group=int(a.get("group", 1)), name=node.name or None)
+
+
+@_import("ConvTranspose")
+def _imp_deconv(im, node, a):
+    k = tuple(a["kernel_shape"])
+    w = im.inits.get(node.input[1])
+    if w is None:
+        raise ValueError("ConvTranspose %s: weight initializer required to "
+                         "recover num_filter" % node.name)
+    nf = w.shape[1] * int(a.get("group", 1))
+    kw = {}
+    if a.get("output_padding"):
+        kw["adj"] = tuple(a["output_padding"])
+    im.tensors[node.output[0]] = im.S.Deconvolution(
+        data=im.sym_of(node.input[0]), weight=im.sym_of(node.input[1]),
+        bias=im.sym_of(node.input[2]) if len(node.input) > 2 else None,
+        no_bias=len(node.input) <= 2, kernel=k,
+        stride=tuple(a.get("strides", (1,) * len(k))),
+        dilate=tuple(a.get("dilations", (1,) * len(k))),
+        pad=_onnx_pads(a, len(k)), num_filter=int(nf),
+        num_group=int(a.get("group", 1)), name=node.name or None)
+
+
+@_import("Gemm")
+def _imp_gemm(im, node, a):
+    if (a.get("alpha", 1.0), a.get("beta", 1.0)) != (1.0, 1.0) \
+            or a.get("transA", 0):
+        raise NotImplementedError("Gemm with alpha/beta/transA != defaults")
+    if not a.get("transB", 0):
+        raise NotImplementedError("Gemm transB=0 (use MatMul)")
+    w = im.inits.get(node.input[1])
+    if w is None:
+        raise ValueError("Gemm %s: weight initializer required" % node.name)
+    im.tensors[node.output[0]] = im.S.FullyConnected(
+        data=im.sym_of(node.input[0]), weight=im.sym_of(node.input[1]),
+        bias=im.sym_of(node.input[2]) if len(node.input) > 2 else None,
+        no_bias=len(node.input) <= 2, num_hidden=int(w.shape[0]),
+        flatten=False, name=node.name or None)
+
+
+@_import("MatMul")
+def _imp_matmul(im, node, a):
+    im.tensors[node.output[0]] = im.S.dot(
+        im.sym_of(node.input[0]), im.sym_of(node.input[1]),
+        name=node.name or None)
+
+
+@_import("BatchNormalization")
+def _imp_bn(im, node, a):
+    im.aux_names.update(node.input[3:5])
+    im.tensors[node.output[0]] = im.S.BatchNorm(
+        data=im.sym_of(node.input[0]), gamma=im.sym_of(node.input[1]),
+        beta=im.sym_of(node.input[2]), moving_mean=im.sym_of(node.input[3]),
+        moving_var=im.sym_of(node.input[4]),
+        eps=float(a.get("epsilon", 1e-5)),
+        momentum=float(a.get("momentum", 0.9)),
+        use_global_stats=True, name=node.name or None)
+
+
+_ONNX2ACT = {v: k for k, v in _ACT2ONNX.items()}
+
+
+@_import("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign")
+def _imp_act(im, node, a):
+    im.tensors[node.output[0]] = im.S.Activation(
+        im.sym_of(node.input[0]), act_type=_ONNX2ACT[node.op_type],
+        name=node.name or None)
+
+
+@_import("LeakyRelu")
+def _imp_leaky(im, node, a):
+    im.tensors[node.output[0]] = im.S.LeakyReLU(
+        im.sym_of(node.input[0]), act_type="leaky",
+        slope=float(a.get("alpha", 0.01)), name=node.name or None)
+
+
+@_import("Elu")
+def _imp_elu(im, node, a):
+    im.tensors[node.output[0]] = im.S.LeakyReLU(
+        im.sym_of(node.input[0]), act_type="elu",
+        slope=float(a.get("alpha", 1.0)), name=node.name or None)
+
+
+@_import("Selu")
+def _imp_selu(im, node, a):
+    im.tensors[node.output[0]] = im.S.LeakyReLU(
+        im.sym_of(node.input[0]), act_type="selu", name=node.name or None)
+
+
+@_import("MaxPool", "AveragePool", "GlobalMaxPool", "GlobalAveragePool")
+def _imp_pool(im, node, a):
+    is_global = node.op_type.startswith("Global")
+    ptype = "max" if "Max" in node.op_type else "avg"
+    kw = dict(pool_type=ptype, global_pool=is_global,
+              name=node.name or None)
+    if not is_global:
+        k = tuple(a["kernel_shape"])
+        kw.update(kernel=k, stride=tuple(a.get("strides", k)),
+                  pad=_onnx_pads(a, len(k)),
+                  ceil_mode=bool(a.get("ceil_mode", 0)))
+        if ptype == "avg":
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 1))
+    else:
+        kw["kernel"] = (1, 1)
+    im.tensors[node.output[0]] = im.S.Pooling(im.sym_of(node.input[0]), **kw)
+
+
+@_import("Flatten")
+def _imp_flatten(im, node, a):
+    if a.get("axis", 1) != 1:
+        raise NotImplementedError("Flatten axis != 1")
+    im.tensors[node.output[0]] = im.S.Flatten(im.sym_of(node.input[0]),
+                                              name=node.name or None)
+
+
+@_import("Reshape")
+def _imp_reshape(im, node, a):
+    # NOTE (here and below): constant inputs (shape/axes/bounds) are READ,
+    # never popped — legal ONNX graphs share one initializer between nodes.
+    # Unconsumed initializers are pruned from params by the `used` filter
+    # in _Importer.run.
+    shape = im.inits.get(node.input[1])
+    if shape is None:
+        raise NotImplementedError("Reshape with dynamic shape input")
+    im.tensors[node.output[0]] = im.S.Reshape(
+        im.sym_of(node.input[0]), shape=tuple(int(s) for s in shape),
+        name=node.name or None)
+
+
+@_import("Transpose")
+def _imp_transpose(im, node, a):
+    im.tensors[node.output[0]] = im.S.transpose(
+        im.sym_of(node.input[0]), axes=tuple(a["perm"]) if "perm" in a
+        else None, name=node.name or None)
+
+
+@_import("Unsqueeze")
+def _imp_unsqueeze(im, node, a):
+    axes = (tuple(a["axes"]) if "axes" in a
+            else tuple(int(x) for x in im.inits[node.input[1]]))
+    s = im.sym_of(node.input[0])
+    for ax in axes:
+        s = im.S.expand_dims(s, axis=int(ax))
+    im.tensors[node.output[0]] = s
+
+
+@_import("Squeeze")
+def _imp_squeeze(im, node, a):
+    axes = (tuple(a["axes"]) if "axes" in a
+            else tuple(int(x) for x in im.inits[node.input[1]])
+            if len(node.input) > 1 else None)
+    im.tensors[node.output[0]] = im.S.squeeze(
+        im.sym_of(node.input[0]),
+        axis=axes if axes is None or len(axes) > 1 else axes[0])
+
+
+@_import("Slice")
+def _imp_slice(im, node, a):
+    if len(node.input) < 4:
+        raise NotImplementedError("Slice without explicit axes input")
+    starts = [int(x) for x in im.inits[node.input[1]]]
+    ends = [int(x) for x in im.inits[node.input[2]]]
+    axes = [int(x) for x in im.inits[node.input[3]]]
+    s = im.sym_of(node.input[0])
+    imax = np.iinfo(np.int64).max
+    for b, e, ax in zip(starts, ends, axes):
+        s = im.S.slice_axis(s, axis=ax, begin=b,
+                            end=None if e >= imax else e)
+    im.tensors[node.output[0]] = s
+
+
+@_import("Concat")
+def _imp_concat(im, node, a):
+    im.tensors[node.output[0]] = im.S.Concat(
+        *[im.sym_of(i) for i in node.input], dim=int(a.get("axis", 0)),
+        name=node.name or None)
+
+
+@_import("Sum")
+def _imp_sum(im, node, a):
+    syms = [im.sym_of(i) for i in node.input]
+    im.tensors[node.output[0]] = (syms[0] if len(syms) == 1
+                                  else im.S.add_n(*syms,
+                                                  name=node.name or None))
+
+
+@_import("Cast")
+def _imp_cast(im, node, a):
+    im.tensors[node.output[0]] = im.S.cast(
+        im.sym_of(node.input[0]),
+        dtype=_ONNX2NP[a["to"]].name) if hasattr(im.S, "cast") \
+        else im.sym_of(node.input[0])
+
+
+@_import("Gather")
+def _imp_gather(im, node, a):
+    if int(a.get("axis", 0)) != 0:
+        raise NotImplementedError("Gather axis != 0")
+    w = im.inits.get(node.input[0])
+    if w is None:
+        raise NotImplementedError("Gather from non-initializer")
+    im.tensors[node.output[0]] = im.S.Embedding(
+        data=im.sym_of(node.input[1]), weight=im.sym_of(node.input[0]),
+        input_dim=int(w.shape[0]), output_dim=int(w.shape[1]),
+        name=node.name or None)
+
+
+@_import("Softmax")
+def _imp_softmax(im, node, a):
+    im.tensors[node.output[0]] = im.S.softmax(
+        im.sym_of(node.input[0]), axis=int(a.get("axis", -1)),
+        name=node.name or None)
+
+
+@_import("LogSoftmax")
+def _imp_log_softmax(im, node, a):
+    im.tensors[node.output[0]] = im.S.log_softmax(
+        im.sym_of(node.input[0]), axis=int(a.get("axis", -1)),
+        name=node.name or None)
+
+
+@_import("Identity", "Dropout")
+def _imp_identity(im, node, a):
+    im.tensors[node.output[0]] = im.sym_of(node.input[0])
+
+
+def _scalar_init(im, name):
+    arr = im.inits.get(name)
+    if arr is not None and arr.size == 1:
+        return float(arr.reshape(()))
+    return None
+
+
+_ONNX_BIN = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+             "Mul": "broadcast_mul", "Div": "broadcast_div",
+             "Pow": "broadcast_power", "Max": "broadcast_maximum",
+             "Min": "broadcast_minimum"}
+_SCALAR_FWD = {"Add": "_plus_scalar", "Sub": "_minus_scalar",
+               "Mul": "_mul_scalar", "Div": "_div_scalar",
+               "Pow": "_power_scalar"}
+_SCALAR_REV = {"Add": "_plus_scalar", "Sub": "_rminus_scalar",
+               "Mul": "_mul_scalar", "Div": "_rdiv_scalar"}
+
+
+@_import(*_ONNX_BIN)
+def _imp_binop(im, node, a):
+    # scalar initializer operand -> scalar op (keeps round-trip exact and
+    # the constant out of arg_params)
+    op = node.op_type
+    s1 = _scalar_init(im, node.input[1])
+    if s1 is not None and op in _SCALAR_FWD and node.input[1] not in im.tensors:
+        from ...symbol import _register as _R
+        im.tensors[node.output[0]] = _R._make_op(
+            _SCALAR_FWD[op], [im.sym_of(node.input[0])], {"scalar": s1},
+            node.name or None)
+        return
+    s0 = _scalar_init(im, node.input[0])
+    if s0 is not None and op in _SCALAR_REV and node.input[0] not in im.tensors:
+        from ...symbol import _register as _R
+        im.tensors[node.output[0]] = _R._make_op(
+            _SCALAR_REV[op], [im.sym_of(node.input[1])], {"scalar": s0},
+            node.name or None)
+        return
+    if op in ("Max", "Min") and len(node.input) != 2:
+        raise NotImplementedError("%s with != 2 inputs" % op)
+    im.tensors[node.output[0]] = getattr(im.S, _ONNX_BIN[op])(
+        im.sym_of(node.input[0]), im.sym_of(node.input[1]),
+        name=node.name or None)
+
+
+@_import("Clip")
+def _imp_clip(im, node, a):
+    if len(node.input) > 1:
+        amin = (_scalar_init(im, node.input[1]) if node.input[1]
+                else -np.inf)
+        amax = (_scalar_init(im, node.input[2])
+                if len(node.input) > 2 and node.input[2] else np.inf)
+        if amin is None or amax is None:
+            raise NotImplementedError(
+                "Clip %s: min/max must be scalar initializers (computed "
+                "bounds are unsupported)" % node.name)
+    else:
+        amin, amax = a.get("min", -np.inf), a.get("max", np.inf)
+    im.tensors[node.output[0]] = im.S.clip(
+        im.sym_of(node.input[0]), a_min=float(amin), a_max=float(amax),
+        name=node.name or None)
+
+
+@_import("ReduceMean", "ReduceMax", "ReduceMin", "ReduceProd")
+def _imp_reduce(im, node, a):
+    mxop = {"ReduceMean": "mean", "ReduceMax": "max", "ReduceMin": "min",
+            "ReduceProd": "prod"}[node.op_type]
+    axes = a.get("axes")
+    im.tensors[node.output[0]] = getattr(im.S, mxop)(
+        im.sym_of(node.input[0]),
+        axis=tuple(axes) if axes is not None else None,
+        keepdims=bool(a.get("keepdims", 1)))
+
+
+@_import("ReduceSum")
+def _imp_reduce_sum(im, node, a):
+    axes = a.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = tuple(int(x) for x in im.inits[node.input[1]])
+    im.tensors[node.output[0]] = im.S.sum(
+        im.sym_of(node.input[0]),
+        axis=tuple(axes) if axes is not None else None,
+        keepdims=bool(a.get("keepdims", 1)))
+
+
+_ONNX_UNARY = {v: k for k, v in _UNARY.items()}
+
+
+@_import(*_ONNX_UNARY)
+def _imp_unary(im, node, a):
+    im.tensors[node.output[0]] = getattr(im.S, _ONNX_UNARY[node.op_type])(
+        im.sym_of(node.input[0]))
+
+
+def import_model(model):
+    """ONNX file path / bytes / ModelProto -> (sym, arg_params, aux_params).
+
+    Mirrors the reference ``onnx_mxnet.import_model``
+    (onnx2mx/import_model.py). BatchNormalization running stats land in
+    ``aux_params``; every other initializer consumed by the graph lands in
+    ``arg_params`` as NDArray.
+    """
+    return _Importer(_load_model_proto(model)).run()
+
+
+def import_to_gluon(model, ctx=None):
+    """ONNX model -> gluon SymbolBlock with parameters set (reference
+    onnx2mx/import_to_gluon.py)."""
+    from ...gluon import SymbolBlock
+    sym, arg_params, aux_params = import_model(model)
+    inputs = [n for n in sym.list_inputs()
+              if n not in arg_params and n not in aux_params]
+    from ... import symbol as S
+    net = SymbolBlock(sym, [S.Variable(n) for n in inputs])
+    params = dict(arg_params)
+    params.update(aux_params)
+    net.load_dict(params, ctx=ctx) if hasattr(net, "load_dict") else \
+        net.collect_params().load_dict(params, ctx=ctx)
+    return net
+
+
+def get_model_metadata(model):
+    """Input/output names+shapes of an ONNX model (reference
+    onnx2mx/import_model.py:get_model_metadata)."""
+    m = _load_model_proto(model)
+    inits = {t.name for t in m.graph.initializer}
+
+    def shape_of(v):
+        return tuple(d.dim_value if d.dim_value else d.dim_param
+                     for d in v.type.tensor_type.shape.dim)
+    return {
+        "input_tensor_data": [(v.name, shape_of(v)) for v in m.graph.input
+                              if v.name not in inits],
+        "output_tensor_data": [(v.name, shape_of(v))
+                               for v in m.graph.output],
+    }

@@ -370,9 +370,21 @@ class HybridBlock(Block):
                            out_info["aux_params"], out_info["tree"])
 
     def export(self, path, epoch=0):
-        """Parity: HybridBlock.export — here saves params (graph is re-derived
-        from code; the compiled artifact lives in XLA's compilation cache)."""
-        self.save_parameters(f"{path}-{epoch:04d}.params")
+        """Parity: HybridBlock.export (python/mxnet/gluon/block.py:export) —
+        writes `path-symbol.json` + `path-{epoch:04d}.params` (checkpoint
+        format, `arg:`/`aux:` prefixes) loadable by SymbolBlock.imports or
+        Module. The graph comes from symbol tracing the eager forward
+        (gluon/symbolize.py); blocks whose forward uses raw jax closures
+        (custom `_apply` fns) cannot be traced and raise
+        NotImplementedError — for those, save_parameters still works."""
+        from .symbolize import trace_symbol
+        from .. import ndarray as nd_mod
+        sym, arg_params, aux_params = trace_symbol(self)
+        sym.save(f"{path}-symbol.json")
+        save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+        save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+        nd_mod.save(f"{path}-{epoch:04d}.params", save_dict)
+        return sym, arg_params, aux_params
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

@@ -274,6 +274,16 @@ class BatchNorm(HybridBlock):
             p.shape = (c,)
 
     def forward(self, x):
+        from ..symbolize import is_symbol
+        if is_symbol(x):  # symbol trace (gluon/symbolize.py)
+            from ..symbolize import sym_call
+            return sym_call(
+                "BatchNorm", out_index=0, data=x, gamma=self.gamma.data(),
+                beta=self.beta.data(), moving_mean=self.running_mean.data(),
+                moving_var=self.running_var.data(), axis=self._axis,
+                eps=self._eps, momentum=self._momentum,
+                fix_gamma=not self._scale,
+                use_global_stats=self._use_global_stats)
         training = autograd.is_training() and not self._use_global_stats
         axis, eps, mom = self._axis, self._eps, self._momentum
         fix_gamma = not self._scale

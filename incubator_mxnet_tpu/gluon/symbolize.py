@@ -1,0 +1,143 @@
+"""Gluon block -> Symbol graph tracing (the F-dispatch of the reference).
+
+Reference parity: in upstream MXNet a HybridBlock's ``hybrid_forward(F, x)``
+runs once with ``F = mx.sym`` to produce the serializable symbol graph that
+``HybridBlock.export`` writes (python/mxnet/gluon/block.py:_build_cache /
+export). This framework's Gluon layers are written eager-first (they call
+``ops.*`` / ``nd.*`` directly — the TPU CachedOp jits that same code), so
+the symbol graph is recovered differently: call the block with a *Symbol*
+input under a :class:`SymbolizeScope`, and every mirrored operator
+dispatches to its symbol builder instead of executing. Parameter NDArrays
+encountered as operator arguments become ``Variable`` nodes named after the
+parameter, so the traced graph binds directly against
+``block.collect_params()`` values.
+
+Used by :func:`trace_symbol` (public), ``HybridBlock.export`` (writes
+``-symbol.json`` + params loadable by ``SymbolBlock.imports``), and the
+ONNX exporter (``contrib/onnx``) for Gluon models.
+"""
+from .parameter import DeferredInitializationError
+
+__all__ = ["SymbolizeScope", "trace_symbol", "active_scope", "sym_call",
+           "to_input"]
+
+_SCOPE = [None]  # innermost active scope (plain stack: tracing is sync)
+
+
+def active_scope():
+    return _SCOPE[-1]
+
+
+class SymbolizeScope:
+    """Maps parameter NDArrays (by object identity) to named Variables for
+    the duration of a symbol trace."""
+
+    def __init__(self, id2name, values=None):
+        self.id2name = dict(id2name)   # id(NDArray) -> parameter name
+        self.values = values or {}     # parameter name -> NDArray
+        self.vars = {}                 # parameter name -> Variable (cached)
+        self.used = []                 # parameter names in first-use order
+
+    def variable(self, name):
+        from ..symbol import Variable
+        if name not in self.vars:
+            self.vars[name] = Variable(name)
+            self.used.append(name)
+        return self.vars[name]
+
+    def __enter__(self):
+        _SCOPE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _SCOPE.pop()
+
+
+def is_symbol(x):
+    from ..symbol import Symbol
+    return isinstance(x, Symbol)
+
+
+def to_input(x):
+    """Convert one operator argument for symbol building: Symbols pass
+    through, parameter NDArrays become named Variables, None stays None."""
+    from ..ndarray import NDArray
+    from ..symbol import Symbol
+    if x is None or isinstance(x, Symbol):
+        return x
+    if isinstance(x, NDArray):
+        scope = active_scope()
+        name = scope.id2name.get(id(x)) if scope is not None else None
+        if name is None:
+            raise NotImplementedError(
+                "symbol tracing hit an NDArray that is not a registered "
+                "parameter (a constant created inside forward). Precompute "
+                "it as a Parameter or use symbol ops directly.")
+        return scope.variable(name)
+    return x
+
+
+def sym_call(builder_name, out_index=None, **kwargs):
+    """Invoke symbol builder `builder_name` with operator arguments given as
+    kwargs; tensor-valued kwargs are converted via to_input. `out_index`
+    selects one output of a multi-output node (e.g. BatchNorm's y)."""
+    from .. import symbol as S
+    builder = getattr(S, builder_name, None)
+    if builder is None:
+        raise NotImplementedError(
+            "no symbol builder for %r; this operator cannot be traced to a "
+            "symbol graph" % builder_name)
+    conv = {k: (tuple(to_input(x) for x in v)
+                if isinstance(v, (list, tuple)) and any(is_symbol(x)
+                                                        for x in v)
+                else to_input(v))
+            for k, v in kwargs.items()}
+    out = builder(**conv)
+    return out[out_index] if out_index is not None else out
+
+
+def trace_symbol(net, *input_names):
+    """Trace an initialized Gluon block into (symbol, arg_params, aux_params).
+
+    ``input_names`` default to ``("data",)``. The block's forward runs once
+    with Variable inputs; the returned params are the block's parameter
+    NDArrays keyed by the names the graph references (aux = names the
+    symbol reports as auxiliary states, i.e. BatchNorm running stats).
+
+    Reference parity: the _cached_graph / export path of
+    python/mxnet/gluon/block.py — there via hybrid_forward(F=symbol), here
+    via operator-level symbol dispatch.
+    """
+    from ..symbol import Variable, Group, Symbol
+
+    if not input_names:
+        input_names = ("data",)
+    id2name, values = {}, {}
+    for name, p in net.collect_params().items():
+        try:
+            nd_val = p.data()
+        except DeferredInitializationError:
+            raise DeferredInitializationError(
+                "trace_symbol needs initialized parameters with known "
+                "shapes; run the block on a real batch once (deferred "
+                "init), then trace")
+        id2name[id(nd_val)] = name
+        values[name] = nd_val
+
+    with SymbolizeScope(id2name, values):
+        out = net(*[Variable(n) for n in input_names])
+
+    if isinstance(out, Symbol):
+        sym = out
+    elif isinstance(out, (list, tuple)):
+        sym = Group(list(out))
+    else:
+        raise TypeError("block returned %r under symbol tracing" % type(out))
+
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name in set(sym.list_arguments()) | aux_names:
+        if name in values:
+            (aux_params if name in aux_names else arg_params)[name] = \
+                values[name]
+    return sym, arg_params, aux_params

@@ -78,6 +78,17 @@ def _unary(jfn, x, name=None, **kw):
     return _apply(jfn, [x], name=name)
 
 
+def _symbolic(x):
+    """True while a Gluon forward runs under symbol tracing and `x` is a
+    Symbol (see gluon/symbolize.py); routes nd.* helpers to builders."""
+    return not isinstance(x, NDArray) and type(x).__name__ == "Symbol"
+
+
+def _sym_call(name, out_index=None, **kw):
+    from ..gluon.symbolize import sym_call
+    return sym_call(name, out_index=out_index, **kw)
+
+
 class NDArray:
     """An n-dimensional array on a device (TPU-first)."""
 
@@ -556,22 +567,32 @@ def softrelu(x):
 
 
 def gelu(x, approximate=True):
+    if _symbolic(x):
+        return _sym_call("gelu", data=x, approximate=approximate)
     return _unary(lambda a: jax.nn.gelu(a, approximate=approximate), _as_nd(x), "gelu")
 
 
 def leaky_relu(x, slope=0.25):
+    if _symbolic(x):
+        return _sym_call("LeakyReLU", data=x, act_type="leaky", slope=slope)
     return _unary(lambda a: jax.nn.leaky_relu(a, slope), _as_nd(x), "leaky_relu")
 
 
 def elu(x, alpha=1.0):
+    if _symbolic(x):
+        return _sym_call("LeakyReLU", data=x, act_type="elu", slope=alpha)
     return _unary(lambda a: jax.nn.elu(a, alpha), _as_nd(x), "elu")
 
 
 def selu(x):
+    if _symbolic(x):
+        return _sym_call("LeakyReLU", data=x, act_type="selu")
     return _unary(jax.nn.selu, _as_nd(x), "selu")
 
 
 def silu(x):
+    if _symbolic(x):
+        return _sym_call("silu", data=x)
     return _unary(jax.nn.silu, _as_nd(x), "silu")
 
 
@@ -579,12 +600,18 @@ swish = silu
 
 
 def softmax(x, axis=-1, temperature=None):
+    if _symbolic(x):
+        if temperature is not None and temperature != 1.0:
+            x = x / float(temperature)  # Symbol.__truediv__ -> _div_scalar
+        return _sym_call("softmax", data=x, axis=axis)
     if temperature is not None and temperature != 1.0:
         return _unary(lambda a: jax.nn.softmax(a / temperature, axis=axis), x, "softmax")
     return _unary(lambda a: jax.nn.softmax(a, axis=axis), x, "softmax")
 
 
 def log_softmax(x, axis=-1):
+    if _symbolic(x):
+        return _sym_call("log_softmax", data=x, axis=axis)
     return _unary(lambda a: jax.nn.log_softmax(a, axis=axis), x, "log_softmax")
 
 
@@ -803,6 +830,11 @@ def concat(*args, dim=1, axis=None):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     ax = axis if axis is not None else dim
+    for a in args:  # builtins.any is shadowed by nd.any in this module
+        if _symbolic(a):
+            from ..symbol import Concat as _SymConcat
+            from ..gluon.symbolize import to_input
+            return _SymConcat(*[to_input(s) for s in args], dim=ax)
     return _apply(lambda *xs: jnp.concatenate(xs, axis=ax), list(args), name="concat")
 
 
@@ -821,6 +853,11 @@ def add_n(*args):
     src/operator/tensor/elemwise_sum.cc)."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
+    for a in args:
+        if _symbolic(a):
+            from ..symbol import add_n as _sym_add_n
+            from ..gluon.symbolize import to_input
+            return _sym_add_n(*[to_input(s) for s in args])
 
     def f(*xs):
         total = xs[0]
@@ -871,6 +908,9 @@ def khatri_rao(*args):
 
 
 def split(x, num_outputs, axis=0, squeeze_axis=False):
+    if _symbolic(x):
+        return _sym_call("SliceChannel", data=x, num_outputs=num_outputs,
+                         axis=axis, squeeze_axis=squeeze_axis)
     if num_outputs == 1:
         # parity: mx.nd.split with one output returns the array itself
         return _apply(lambda a: jnp.squeeze(a, axis) if squeeze_axis else a,
@@ -1029,6 +1069,13 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_
     RowSparse grad, python/mxnet/ndarray/sparse.py). Eager-mode feature;
     inside a traced/hybridized graph it falls back to dense (XLA needs
     static shapes, and the fused step's scatter-add is already optimal)."""
+    if _symbolic(data):
+        in_dim = input_dim or (weight.shape[0] if hasattr(weight, "shape")
+                               else None)
+        out_dim = output_dim or (weight.shape[1] if hasattr(weight, "shape")
+                                 else None)
+        return _sym_call("Embedding", data=data, weight=weight,
+                         input_dim=in_dim, output_dim=out_dim)
     data = _as_nd(data)
     if sparse_grad and not isinstance(data._data, jax.core.Tracer):
         return _sparse_embedding(data, weight)

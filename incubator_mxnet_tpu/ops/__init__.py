@@ -30,8 +30,24 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "Correlation", "foreach", "while_loop", "cond"]
 
 
+def _symbolic(x):
+    """True when a Gluon forward is being traced to a Symbol graph (the
+    block was called with a Symbol input — see gluon/symbolize.py)."""
+    return not isinstance(x, NDArray) and type(x).__name__ == "Symbol"
+
+
+def _sym_call(name, out_index=None, **kw):
+    from ..gluon.symbolize import sym_call
+    return sym_call(name, out_index=out_index, **kw)
+
+
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
                    flatten=True):
+    if _symbolic(data):
+        return _sym_call("FullyConnected", data=data, weight=weight,
+                         bias=None if no_bias else bias,
+                         no_bias=no_bias or bias is None,
+                         num_hidden=num_hidden, flatten=flatten)
     if no_bias or bias is None:
         return _apply(lambda x, w: _raw.dense(x, w, None, flatten),
                       [data, weight], name="FullyConnected")
@@ -42,6 +58,16 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
 def Convolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
                 dilate=None, num_filter=None, num_group=1, no_bias=False,
                 layout="NCHW"):
+    if _symbolic(data):
+        if num_filter is None and hasattr(weight, "shape"):
+            num_filter = (weight.shape[-1] if layout == "NHWC"
+                          else weight.shape[0])
+        return _sym_call("Convolution", data=data, weight=weight,
+                         bias=None if no_bias else bias,
+                         no_bias=no_bias or bias is None, kernel=kernel,
+                         stride=stride, pad=pad, dilate=dilate,
+                         num_filter=num_filter, num_group=num_group,
+                         layout=layout)
     kw = dict(kernel=kernel, stride=stride, pad=pad, dilate=dilate,
               num_group=num_group, layout=layout)
     if no_bias or bias is None:
@@ -54,6 +80,20 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
 def Deconvolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
                   dilate=None, adj=None, num_filter=None, num_group=1,
                   no_bias=False, layout="NCHW"):
+    if _symbolic(data):
+        if hasattr(weight, "shape"):
+            if kernel is None:
+                kernel = (weight.shape[:-2] if layout == "NHWC"
+                          else weight.shape[2:])
+            if num_filter is None:
+                num_filter = num_group * (weight.shape[-2] if layout == "NHWC"
+                                          else weight.shape[1])
+        return _sym_call("Deconvolution", data=data, weight=weight,
+                         bias=None if no_bias else bias,
+                         no_bias=no_bias or bias is None, kernel=kernel,
+                         stride=stride, pad=pad, dilate=dilate, adj=adj,
+                         num_filter=num_filter, num_group=num_group,
+                         layout=layout)
     kw = dict(stride=stride, pad=pad, dilate=dilate, adj=adj,
               num_group=num_group, layout=layout)
     if no_bias or bias is None:
@@ -66,6 +106,12 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
 def Pooling(data, pool_type="max", kernel=(2, 2), stride=None, pad=None,
             global_pool=False, count_include_pad=True, layout="NCHW",
             ceil_mode=False):
+    if _symbolic(data):
+        return _sym_call("Pooling", data=data, pool_type=pool_type,
+                         kernel=kernel, stride=stride, pad=pad,
+                         global_pool=global_pool,
+                         count_include_pad=count_include_pad, layout=layout,
+                         ceil_mode=ceil_mode)
     return _apply(lambda x: _raw.pooling(x, pool_type, kernel, stride, pad,
                                          global_pool, count_include_pad, layout,
                                          ceil_mode),
@@ -96,11 +142,17 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
 
 
 def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5):
+    if _symbolic(data):
+        return _sym_call("LayerNorm", data=data, gamma=gamma, beta=beta,
+                         axis=axis, eps=eps)
     return _apply(lambda x, g, b: _raw.layer_norm(x, g, b, axis, eps),
                   [data, gamma, beta], name="LayerNorm")
 
 
 def InstanceNorm(data, gamma, beta, eps=1e-5):
+    if _symbolic(data):
+        return _sym_call("InstanceNorm", data=data, gamma=gamma, beta=beta,
+                         eps=eps)
     return _apply(lambda x, g, b: _raw.instance_norm(x, g, b, eps),
                   [data, gamma, beta], name="InstanceNorm")
 
@@ -111,10 +163,14 @@ def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5):
 
 
 def Activation(data, act_type="relu"):
+    if _symbolic(data):
+        return _sym_call("Activation", data=data, act_type=act_type)
     return _apply(lambda x: _raw.activation(x, act_type), [data], name="Activation")
 
 
 def Dropout(data, p=0.5, mode="training", axes=()):
+    if _symbolic(data):
+        return _sym_call("Dropout", data=data, p=p, mode=mode, axes=axes)
     training = autograd.is_training() or mode == "always"
     if not training or p == 0.0:
         return data
@@ -143,6 +199,10 @@ def UpSampling(data, scale=2, sample_type="nearest", num_filter=None,
     """Parity: mx.nd.UpSampling (src/operator/nn/upsampling.cc); `bilinear`
     is the reference's fixed-weight Deconvolution path (num_filter accepted
     for API parity; channels are inferred)."""
+    if _symbolic(data):
+        return _sym_call("UpSampling", data=data, scale=scale,
+                         sample_type=sample_type, num_filter=num_filter,
+                         layout=layout)
     return _apply(lambda x: _raw.upsampling(x, scale, sample_type, layout),
                   [data], name="UpSampling")
 

@@ -673,6 +673,13 @@ def _make_op(op, inputs, attrs=None, name=None):
 def _elemwise(op, lhs, rhs):
     if isinstance(rhs, Symbol):
         return _make_op(op, [lhs, rhs])
+    from ..gluon.symbolize import active_scope, to_input
+    if active_scope() is not None and hasattr(rhs, "_data"):
+        # NDArray operand during Gluon symbol tracing: registered params
+        # become named Variables (even 1-element ones — float() would bake
+        # the current value into the graph as a constant, detaching the
+        # parameter from checkpoints); in-forward constants raise clearly.
+        return _make_op(op, [lhs, to_input(rhs)])
     return _make_op(op + "_scalar", [lhs], attrs={"scalar": float(rhs)})
 
 

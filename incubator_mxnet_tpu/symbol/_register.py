@@ -87,6 +87,20 @@ for _name, _fn in {
 }.items():
     _reg_unary(_name, _fn)
 
+register_op("gelu",
+            lambda rt, a, x: jax.nn.gelu(x, approximate=a.get("approximate",
+                                                              True)),
+            ("data",))
+register_op("silu", lambda rt, a, x: jax.nn.silu(x), ("data",))
+def _add_n_fn(rt, a, *xs):
+    total = xs[0]  # builtins.sum is shadowed by the reduce builder below
+    for x in xs[1:]:
+        total = total + x
+    return total
+
+
+register_op("add_n", _add_n_fn, ())
+
 def _arange_fn(rt, a):
     start, stop = a["start"], a.get("stop")
     if stop is None:                      # mx.arange(N) == [0, N)
@@ -209,13 +223,32 @@ register_op(
         num_group=a.get("num_group", 1), layout=a.get("layout") or "NCHW"),
     ("data", "weight", "bias"), infer_hint=_conv_hint)
 
+def _deconv_hint(in_shapes, attrs):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    layout = attrs.get("layout") or "NCHW"
+    c_in = d[1] if layout.startswith("NC") else d[-1]
+    k = tuple(attrs["kernel"])
+    nf = attrs["num_filter"]
+    g = attrs.get("num_group", 1)
+    fills = {}
+    if len(in_shapes) > 1 and in_shapes[1] is None:
+        # IOHW for NCHW (lax IOHW spec), HWIO for NHWC — see _raw.conv_transpose
+        fills[1] = (k + (nf // g, c_in) if layout == "NHWC"
+                    else (c_in, nf // g) + k)
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        fills[2] = (nf,)
+    return fills
+
+
 register_op(
     "Deconvolution",
     lambda rt, a, x, w, *b: _raw.conv_transpose(
         x, w, b[0] if b else None, stride=a.get("stride"), pad=a.get("pad"),
         dilate=a.get("dilate"), adj=a.get("adj"),
         num_group=a.get("num_group", 1), layout=a.get("layout") or "NCHW"),
-    ("data", "weight", "bias"))
+    ("data", "weight", "bias"), infer_hint=_deconv_hint)
 
 register_op(
     "Pooling",
@@ -635,17 +668,71 @@ for _n in ["broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
     globals()[_n] = broadcast_op_builder(_n)
 
 
+def gelu(data=None, approximate=True, name=None):
+    return _make_op("gelu", [data], {"approximate": approximate}, name)
+
+
+def silu(data=None, name=None):
+    return _make_op("silu", [data], {}, name)
+
+
+def add_n(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _make_op("add_n", list(args), {}, name)
+
+
 # Export the builders onto the `symbol` module namespace.
 _EXPORTS = [n for n in list(globals()) if n[0].isupper() or n in (
     "concat", "split", "softmax", "log_softmax", "clip", "dot", "batch_dot",
     "smooth_l1", "softmax_cross_entropy", "transpose", "expand_dims",
-    "squeeze", "slice_axis", "stack",
+    "squeeze", "slice_axis", "stack", "gelu", "silu", "add_n",
 ) or n in _UNARY_BUILDERS or n in ("sum", "mean", "max", "min", "prod",
                                    "argmax")
     or n.startswith("broadcast_")]
 for _n in _EXPORTS:
     if not _n.startswith("_"):
         setattr(_sym_mod, _n, globals()[_n])
+
+
+# NDArray-method mirrors on Symbol: eager-written Gluon forwards call
+# x.relu()/x.flatten()/... on their tensors; under symbol tracing
+# (gluon/symbolize.py) those tensors are Symbols, so the same spelling must
+# build graph nodes.
+def _attach_symbol_methods():
+    def _method(builder):
+        def m(self, *args, **kwargs):
+            return builder(self, *args, **kwargs)
+        m.__name__ = builder.__name__
+        return m
+
+    for _n in ("relu", "sigmoid", "tanh", "exp", "log", "sqrt", "square",
+               "abs", "erf", "sum", "mean", "max", "min", "prod"):
+        if not hasattr(Symbol, _n):
+            setattr(Symbol, _n, _method(globals()[_n]))
+    if not hasattr(Symbol, "flatten"):
+        Symbol.flatten = lambda self: globals()["Flatten"](self)
+    if not hasattr(Symbol, "softmax"):
+        Symbol.softmax = lambda self, axis=-1: globals()["softmax"](
+            self, axis=axis)
+
+    def _sym_reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return globals()["Reshape"](self, shape=shape)
+    if not hasattr(Symbol, "reshape"):
+        Symbol.reshape = _sym_reshape
+    def _sym_transpose(self, *axes):
+        # accept both NDArray spellings: x.transpose((0,2,1)) and
+        # x.transpose(0, 2, 1); bare x.transpose() reverses dims
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return globals()["transpose"](self, axes=(axes if axes else None))
+    if not hasattr(Symbol, "transpose"):
+        Symbol.transpose = _sym_transpose
+
+
+_attach_symbol_methods()
 
 
 # ---------------------------------------------------------------------------
@@ -661,7 +748,8 @@ register_op(
 register_op(
     "UpSampling",
     lambda rt, a, x: _raw.upsampling(x, a.get("scale", 2),
-                                     a.get("sample_type", "nearest")),
+                                     a.get("sample_type", "nearest"),
+                                     a.get("layout") or "NCHW"),
     ("data",))
 
 
@@ -754,9 +842,10 @@ def InstanceNorm(data=None, gamma=None, beta=None, eps=1e-3, name=None):
 
 
 def UpSampling(data=None, scale=2, sample_type="nearest", num_filter=None,
-               name=None):
+               layout=None, name=None):
     return _make_op("UpSampling", [data],
-                    _attrs(scale=scale, sample_type=sample_type), name)
+                    _attrs(scale=scale, sample_type=sample_type,
+                           layout=layout), name)
 
 
 def RNN(data=None, parameters=None, state=None, state_cell=None, mode="lstm",

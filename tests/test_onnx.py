@@ -1,0 +1,266 @@
+"""ONNX export/import round-trip tests (reference python/mxnet/contrib/onnx/
+tests: tests/python-pytest/onnx/test_models.py, test_node.py).
+
+The pip `onnx` package is absent in this image, so validation is structural
+(parse the emitted proto with independently generated bindings, check the
+graph invariants the onnx checker enforces) plus numerical round-trip parity
+through import_model.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import onnx as onnx_mxnet
+from incubator_mxnet_tpu.contrib.onnx import P
+
+
+def _params_for(net, data_shapes, skip=("data", "softmax_label", "label")):
+    rng = np.random.RandomState(0)
+    args, _, auxs = net.infer_shape(**data_shapes)
+    params = {}
+    for n, s in zip(net.list_arguments() + net.list_auxiliary_states(),
+                    args + auxs):
+        if n in skip or s is None:
+            continue
+        params[n] = mx.nd.array(rng.uniform(-0.5, 0.5, s).astype("float32"))
+    return params
+
+
+def _forward(net, feed, params):
+    args = {k: v for k, v in params.items() if k in net.list_arguments()}
+    args.update(feed)
+    for n in net.list_arguments():
+        if n not in args:  # unused labels etc.
+            args[n] = mx.nd.array(np.zeros((1,), np.float32))
+    auxs = {k: v for k, v in params.items()
+            if k in net.list_auxiliary_states()}
+    return net.bind(args=args, aux_states=auxs).forward(
+        is_train=False)[0].asnumpy()
+
+
+def _roundtrip(net, data_shape, atol=1e-5):
+    params = _params_for(net, {"data": data_shape})
+    buf = onnx_mxnet.export_model(net, params, [data_shape])
+    sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.uniform(-1, 1, data_shape).astype("float32"))
+    y1 = _forward(net, {"data": x}, params)
+    p2 = dict(arg2)
+    p2.update(aux2)
+    y2 = _forward(sym2, {"data": x}, p2)
+    assert y1.shape == y2.shape
+    np.testing.assert_allclose(y1, y2, atol=atol, rtol=1e-4)
+    return buf
+
+
+class TestProtoWire:
+    def test_model_parses_and_validates(self):
+        sym = mx.sym
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                                 name="fc")
+        params = _params_for(net, {"data": (2, 8)})
+        buf = onnx_mxnet.export_model(net, params, [(2, 8)])
+        m = P.ModelProto()
+        m.ParseFromString(buf)
+        assert m.ir_version == 8
+        assert m.opset_import[0].version == 13
+        # onnx-checker invariants: every node input is produced before use
+        produced = {t.name for t in m.graph.initializer}
+        produced |= {v.name for v in m.graph.input}
+        for node in m.graph.node:
+            for i in node.input:
+                assert i in produced, i
+            produced |= set(node.output)
+        out_names = {v.name for v in m.graph.output}
+        assert out_names <= produced
+
+    def test_initializer_raw_data_little_endian(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        t = onnx_mxnet._np_to_tensor("w", arr)
+        assert tuple(t.dims) == (2, 3)
+        assert t.data_type == P.TensorProto.FLOAT
+        back = np.frombuffer(t.raw_data, "<f4").reshape(2, 3)
+        np.testing.assert_array_equal(back, arr)
+        np.testing.assert_array_equal(onnx_mxnet._tensor_to_np(t), arr)
+
+    def test_get_model_metadata(self):
+        sym = mx.sym
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                                 name="fc")
+        buf = onnx_mxnet.export_model(net, _params_for(net, {"data": (2, 8)}),
+                                      [(2, 8)])
+        meta = onnx_mxnet.get_model_metadata(buf)
+        assert meta["input_tensor_data"] == [("data", (2, 8))]
+        assert meta["output_tensor_data"][0][0] == "fc"
+        assert meta["output_tensor_data"][0][1] == (2, 4)
+
+
+class TestRoundTrip:
+    def test_lenet_style_cnn(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                              name="c1")
+        net = sym.BatchNorm(net, name="bn1")
+        net = sym.Activation(net, act_type="relu", name="r1")
+        net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="p1")
+        net = sym.Convolution(net, num_filter=16, kernel=(3, 3),
+                              no_bias=True, name="c2")
+        net = sym.Activation(net, act_type="tanh", name="r2")
+        net = sym.Pooling(net, global_pool=True, pool_type="avg", name="gap")
+        net = sym.FullyConnected(sym.Flatten(net), num_hidden=10, name="fc1")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        _roundtrip(net, (2, 3, 16, 16))
+
+    def test_mlp_dropout_elemwise(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = sym.Activation(h, act_type="sigmoid", name="a1")
+        h = sym.Dropout(h, p=0.5, name="drop1")
+        h2 = sym.FullyConnected(data, num_hidden=16, name="fc2",
+                                flatten=False)
+        net = (h + h2) * 2.0 - 1.5
+        net = sym.clip(net, a_min=-1.0, a_max=1.0, name="clipped")
+        _roundtrip(net, (4, 8))
+
+    def test_resnet_style_block(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        body = sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                               pad=(1, 1), no_bias=True, name="c1")
+        body = sym.BatchNorm(body, fix_gamma=True, name="bn1")
+        body = sym.Activation(body, act_type="relu", name="r1")
+        body = sym.Convolution(body, num_filter=4, kernel=(3, 3),
+                               pad=(1, 1), no_bias=True, name="c2")
+        net = sym.broadcast_add(body, data, name="res")
+        net = sym.LeakyReLU(net, slope=0.1, name="lr")
+        _roundtrip(net, (2, 4, 8, 8))
+
+    def test_deconv_concat_reshape(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        up = sym.Deconvolution(data, num_filter=4, kernel=(2, 2),
+                               stride=(2, 2), name="up")
+        a = sym.slice_axis(up, axis=1, begin=0, end=2, name="sl")
+        b = sym.slice_axis(up, axis=1, begin=2, end=None, name="sr")
+        net = sym.Concat(a, b, dim=1, name="cat")
+        net = sym.Reshape(net, shape=(0, -1), name="rs")
+        _roundtrip(net, (2, 3, 4, 4))
+
+    def test_add_n_sum_roundtrip(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        a = sym.FullyConnected(data, num_hidden=4, name="fa")
+        b = sym.FullyConnected(data, num_hidden=4, name="fb")
+        net = sym.add_n(a, b, data, name="s3")
+        _roundtrip(net, (2, 4))
+
+    def test_shared_initializer_not_destroyed(self):
+        # two Unsqueeze nodes sharing one axes initializer (legal ONNX,
+        # common after constant dedup) must both import
+        sym = mx.sym
+        data = sym.Variable("data")
+        net = sym.expand_dims(data, axis=1, name="u1") \
+            + sym.expand_dims(data, axis=1, name="u2")
+        buf = onnx_mxnet.export_model(net, {}, [(2, 3)])
+        m = P.ModelProto()
+        m.ParseFromString(buf)
+        # force both Unsqueeze nodes onto ONE shared axes initializer
+        axes_names = [n.input[1] for n in m.graph.node
+                      if n.op_type == "Unsqueeze"]
+        assert len(axes_names) == 2
+        shared = axes_names[0]
+        for n in m.graph.node:
+            if n.op_type == "Unsqueeze":
+                n.input[1] = shared
+        keep = [t for t in m.graph.initializer
+                if t.name != axes_names[1]]
+        del m.graph.initializer[:]
+        m.graph.initializer.extend(keep)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(m.SerializeToString())
+        x = mx.nd.array(np.random.RandomState(0).uniform(
+            -1, 1, (2, 3)).astype("float32"))
+        y1 = _forward(net, {"data": x}, {})
+        y2 = _forward(sym2, {"data": x}, {})
+        np.testing.assert_allclose(y1, y2, atol=1e-6)
+
+    def test_reductions_and_unary(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        net = sym.exp(data) + sym.sqrt(sym.abs(data))
+        net = sym.sum(net, axis=2, keepdims=True)
+        net = sym.mean(net, axis=1)
+        _roundtrip(net, (2, 3, 5))
+
+    def test_embedding_softmax(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        emb = sym.Embedding(data, input_dim=11, output_dim=6, name="emb")
+        net = sym.softmax(sym.FullyConnected(emb, num_hidden=5, name="fc"),
+                          axis=-1, name="sm")
+        params = _params_for(net, {"data": (3, 4)})
+        buf = onnx_mxnet.export_model(net, params, [(3, 4)])
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        idx = mx.nd.array(np.array([[1, 2, 3, 10], [0, 5, 6, 7],
+                                    [9, 9, 1, 0]], np.float32))
+        y1 = _forward(net, {"data": idx}, params)
+        p2 = dict(arg2)
+        p2.update(aux2)
+        y2 = _forward(sym2, {"data": idx}, p2)
+        np.testing.assert_allclose(y1, y2, atol=1e-5, rtol=1e-4)
+
+    def test_multi_output_group(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        a = sym.FullyConnected(data, num_hidden=3, name="heada")
+        b = sym.FullyConnected(data, num_hidden=5, name="headb")
+        net = mx.sym.Group([a, b])
+        params = _params_for(net, {"data": (2, 4)})
+        buf = onnx_mxnet.export_model(net, params, [(2, 4)])
+        meta = onnx_mxnet.get_model_metadata(buf)
+        assert [n for n, _ in meta["output_tensor_data"]] == ["heada",
+                                                              "headb"]
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        assert len(sym2.list_outputs()) == 2
+
+    def test_model_zoo_resnet18_exports(self):
+        # the representative model-zoo CNN (NCHW build for ONNX), via the
+        # Gluon->Symbol tracer (gluon/symbolize.py)
+        from incubator_mxnet_tpu.models import get_model
+        from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
+        net = get_model("resnet18_v1", classes=10, layout="NCHW")
+        x = mx.nd.array(np.random.RandomState(0).uniform(
+            0, 1, (1, 3, 32, 32)).astype("float32"))
+        net.initialize()
+        y_ref = net(x).asnumpy()
+        ysym, arg_p, aux_p = trace_symbol(net)
+        params = dict(arg_p)
+        params.update(aux_p)
+        buf = onnx_mxnet.export_model(ysym, params, [(1, 3, 32, 32)])
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        assert set(aux2) == set(aux_p)  # BN stats classified as aux
+        p2 = dict(arg2)
+        p2.update(aux2)
+        y2 = _forward(sym2, {"data": x}, p2)
+        np.testing.assert_allclose(y_ref, y2, atol=1e-4, rtol=1e-3)
+
+
+class TestErrors:
+    def test_nhwc_rejected(self):
+        sym = mx.sym
+        net = sym.Convolution(sym.Variable("data"), num_filter=4,
+                              kernel=(3, 3), layout="NHWC", name="c")
+        params = _params_for(net, {"data": (1, 8, 8, 3)})
+        with pytest.raises(NotImplementedError, match="NCHW"):
+            onnx_mxnet.export_model(net, params, [(1, 8, 8, 3)])
+
+    def test_unsupported_op_message_lists_supported(self):
+        sym = mx.sym
+        net = sym.SequenceMask(sym.Variable("data")) \
+            if hasattr(sym, "SequenceMask") else None
+        if net is None:
+            pytest.skip("no handy unsupported op")
+        with pytest.raises(NotImplementedError, match="Supported"):
+            onnx_mxnet.export_model(net, {}, [(2, 2)])

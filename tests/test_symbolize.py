@@ -1,0 +1,192 @@
+"""Gluon -> Symbol tracing tests (gluon/symbolize.py).
+
+Reference parity: upstream MXNet recovers a serializable graph from a
+HybridBlock via hybrid_forward(F=mx.sym) inside _build_cache
+(python/mxnet/gluon/block.py); here the same recovery happens by operator
+dispatch when a block is called with Symbol inputs. These tests pin the
+contract: traced graph == eager numerics, JSON round-trips, export/imports
+interoperate, BatchNorm stats classify as aux.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
+
+
+def _trace_parity(net, shape, atol=1e-5):
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).uniform(
+        -1, 1, shape).astype("float32"))
+    y_ref = net(x).asnumpy()
+    sym, arg_p, aux_p = trace_symbol(net)
+    y2 = sym.bind(args={"data": x, **arg_p},
+                  aux_states=aux_p).forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y_ref, y2, atol=atol, rtol=1e-5)
+    return sym, arg_p, aux_p
+
+
+class TestTraceParity:
+    def test_mlp(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.5),
+                nn.Dense(4))
+        sym, arg_p, aux_p = _trace_parity(net, (2, 8))
+        assert not aux_p
+        assert len(arg_p) == 4
+
+    def test_conv_bn_pool(self):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, layout="NCHW"),
+                nn.BatchNorm(axis=1), nn.Activation("relu"),
+                nn.MaxPool2D(2, layout="NCHW"),
+                nn.GlobalAvgPool2D(layout="NCHW"), nn.Flatten(),
+                nn.Dense(5))
+        sym, arg_p, aux_p = _trace_parity(net, (2, 3, 8, 8))
+        # running stats must be auxiliary states, not trainable args
+        assert sorted(aux_p) == sorted(k for k in aux_p
+                                       if k.endswith(("running_mean",
+                                                      "running_var")))
+        assert len(aux_p) == 2
+
+    def test_activation_layers(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8), nn.LeakyReLU(0.1), nn.Dense(8), nn.ELU(0.9),
+                nn.Dense(8), nn.SELU(), nn.Dense(8), nn.GELU(),
+                nn.Dense(8), nn.Swish(), nn.Dense(2))
+        _trace_parity(net, (3, 6))
+
+    def test_resnet18_traces_and_serializes(self):
+        from incubator_mxnet_tpu.models import get_model
+        net = get_model("resnet18_v1", classes=10, layout="NCHW")
+        sym, arg_p, aux_p = _trace_parity(net, (1, 3, 32, 32))
+        # serializable: round-trip through JSON preserves numerics
+        x = mx.nd.array(np.random.RandomState(1).uniform(
+            0, 1, (1, 3, 32, 32)).astype("float32"))
+        sym2 = mx.sym.load_json(sym.tojson())
+        y1 = sym.bind(args={"data": x, **arg_p},
+                      aux_states=aux_p).forward(is_train=False)[0].asnumpy()
+        y2 = sym2.bind(args={"data": x, **arg_p},
+                       aux_states=aux_p).forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(y1, y2, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_densenet_squeezenet_mobilenet(self):
+        from incubator_mxnet_tpu.models import get_model
+        for name in ("densenet121", "squeezenet1_0", "mobilenet1_0"):
+            net = get_model(name, classes=10, layout="NCHW")
+            _trace_parity(net, (1, 3, 64, 64))
+
+
+class TestExportImports:
+    def test_export_then_symbolblock_imports(self, tmp_path):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, padding=1, layout="NCHW"),
+                nn.BatchNorm(axis=1), nn.Activation("relu"), nn.Flatten(),
+                nn.Dense(3))
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(0).uniform(
+            0, 1, (2, 3, 8, 8)).astype("float32"))
+        y_ref = net(x).asnumpy()
+
+        path = os.path.join(str(tmp_path), "model")
+        net.export(path, epoch=7)
+        assert os.path.exists(path + "-symbol.json")
+        assert os.path.exists(path + "-0007.params")
+
+        block = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                          path + "-0007.params")
+        y2 = block(x).asnumpy()
+        np.testing.assert_allclose(y_ref, y2, atol=1e-5, rtol=1e-5)
+
+    def test_export_to_onnx_chain(self, tmp_path):
+        # gluon -> symbol -> onnx -> import: the full interchange chain
+        from incubator_mxnet_tpu.contrib import onnx as onnx_mxnet
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, padding=1, layout="NCHW"),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(layout="NCHW"),
+                nn.Flatten(), nn.Dense(3))
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(0).uniform(
+            0, 1, (2, 3, 8, 8)).astype("float32"))
+        y_ref = net(x).asnumpy()
+        sym, arg_p, aux_p = trace_symbol(net)
+        params = dict(arg_p)
+        params.update(aux_p)
+        fn = os.path.join(str(tmp_path), "m.onnx")
+        onnx_mxnet.export_model(sym, params, [(2, 3, 8, 8)],
+                                onnx_file_path=fn)
+        sym2, arg2, aux2 = onnx_mxnet.import_model(fn)
+        args = {"data": x}
+        args.update(arg2)
+        y2 = sym2.bind(args=args,
+                       aux_states=aux2).forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(y_ref, y2, atol=1e-5, rtol=1e-4)
+
+
+class TestRegressions:
+    def test_scalar_parameter_stays_variable(self):
+        # a 1-element Parameter used via `sym * p.data()` must become a
+        # named Variable, NOT get baked into the graph as a constant
+        # (float() coercion would freeze the checkpointed value)
+        class Scaled(nn.HybridSequential):
+            def __init__(self):
+                super().__init__()
+                self.scale = self.params.get("scale", shape=(1,),
+                                             init="ones")
+
+            def forward(self, x):
+                return super().forward(x) * self.scale.data()
+
+        net = Scaled()
+        net.add(nn.Dense(3))
+        net.initialize()
+        net(mx.nd.array(np.zeros((1, 4), np.float32)))
+        sym, arg_p, aux_p = trace_symbol(net)
+        scale_name = [n for n in arg_p if n.endswith("scale")]
+        assert scale_name, "scale parameter was baked in, not a Variable"
+        # swap in a different value: output must track the new parameter
+        x = mx.nd.array(np.ones((1, 4), np.float32))
+        args = {"data": x}
+        args.update(arg_p)
+        y1 = sym.bind(args=args).forward(is_train=False)[0].asnumpy()
+        args[scale_name[0]] = mx.nd.array(np.array([3.0], np.float32))
+        y3 = sym.bind(args=args).forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(y3, 3.0 * y1, rtol=1e-6)
+
+    def test_add_n_traces(self):
+        class Three(nn.HybridSequential):
+            def forward(self, x):
+                from incubator_mxnet_tpu import ndarray as nd
+                y = super().forward(x)
+                return nd.add_n(y, y, y)
+
+        net = Three()
+        net.add(nn.Dense(4))
+        _trace_parity(net, (2, 3))
+
+
+class TestErrors:
+    def test_uninitialized_raises(self):
+        from incubator_mxnet_tpu.gluon.parameter import \
+            DeferredInitializationError
+        net = nn.Dense(4)
+        with pytest.raises((DeferredInitializationError, RuntimeError)):
+            trace_symbol(net)
+
+    def test_constant_ndarray_in_forward_raises(self):
+        class Weird(nn.HybridSequential):
+            def forward(self, x):
+                y = super().forward(x)
+                return y + mx.nd.array(np.arange(2, dtype=np.float32))
+
+        net = Weird()
+        net.add(nn.Dense(2))
+        net.initialize()
+        net(mx.nd.array(np.zeros((1, 3), np.float32)))
+        with pytest.raises(NotImplementedError, match="parameter"):
+            trace_symbol(net)

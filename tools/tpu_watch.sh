@@ -1,0 +1,21 @@
+#!/bin/bash
+# TPU relay health watcher (round 4). Probes the axon tunnel every 15 min
+# with a tiny bf16 matmul + host fetch (a host fetch is the only real
+# barrier through the relay). Appends one line per probe to the log.
+# Never launches anything big: a wedged tunnel queues all clients behind
+# the stuck compile, so the probe must stay tiny.
+LOG=${1:-/root/repo/docs/bench_channel_r04.log}
+while true; do
+  ts=$(date -u +%H:%M)
+  timeout 300 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+print(float((x @ x).sum()))
+" >/dev/null 2>&1
+  rc=$?
+  echo "$ts rc=$rc" >> "$LOG"
+  if [ "$rc" = "0" ]; then
+    echo "$ts TUNNEL HEALTHY" >> "$LOG"
+  fi
+  sleep 900
+done
