@@ -15,12 +15,17 @@ IS an XLA collective over the device mesh. Two surfaces:
   axis inside the compiled train step — the highest-performance route that
   bench/dryrun use.
 
-`dist_async` semantics: the reference's async mode lets each worker push
-updates without a global barrier (`src/kvstore/kvstore_dist_server.h`,
-updates applied in arrival order, no staleness bound). Single-process JAX
-has one update stream, so arrival order IS program order and `dist_async`
-is exactly equivalent to `dist_sync`; the flag is preserved so multi-host
-deployments can relax the cross-process allgather into per-process updates.
+`dist_async` semantics (parity: `src/kvstore/kvstore_dist_server.h`): each
+worker's push applies as its OWN optimizer update in arrival order — no
+cross-worker aggregation barrier, so the server performs num_workers
+updates per round and a worker's pull may miss other workers' in-flight
+pushes. Here each device slot of a pushed value acts as one virtual
+worker. Because a single process has a deterministic arrival order, the
+multi-host race is reproduced explicitly: `set_async_staleness(max_delay,
+seed)` holds a random subset of pushes back up to `max_delay` rounds
+before applying them in shuffled order — the bounded-staleness model of
+async PS. `barrier()` drains every pending push (the reference's
+Wait/Barrier on the server queue).
 
 Gradient compression (parity: src/kvstore/gradient_compression.cc): `2bit`
 quantizes each pushed value to {-threshold, 0, +threshold} with
@@ -165,6 +170,54 @@ def _compress_2bit(grad, residual, threshold):
     return q, acc - q
 
 
+class _AsyncQueue:
+    """Arrival-order update queue with induced bounded staleness.
+
+    Models the reference async server (`kvstore_dist_server.h`): pushes
+    apply independently, possibly delayed and reordered relative to other
+    workers. `max_delay=0` = deterministic arrival order (still per-worker
+    updates, the async/sync semantic difference); `max_delay=k` holds a
+    random subset of pushes up to k rounds and releases them shuffled,
+    reproducing multi-host arrival races reproducibly (seeded).
+    """
+
+    def __init__(self, apply_fn, max_delay=0, seed=0):
+        self._apply = apply_fn
+        self._pending = []      # [age, key, grad]
+        self._rng = np.random.RandomState(seed)
+        self.max_delay = max_delay
+        self.delayed_total = 0  # pushes that were held back at least once
+        self.applied_total = 0
+
+    def push(self, key, grad):
+        self._pending.append([0, key, grad])
+        self._drain(force=False)
+
+    def _drain(self, force):
+        now, keep = [], []
+        for item in self._pending:
+            overdue = item[0] >= self.max_delay
+            if force or overdue or self._rng.rand() < 0.5:
+                now.append(item)
+            else:
+                if item[0] == 0:
+                    self.delayed_total += 1  # distinct pushes held back
+                item[0] += 1
+                keep.append(item)
+        self._rng.shuffle(now)
+        for _, k, g in now:
+            self._apply(k, g)
+            self.applied_total += 1
+        self._pending = keep
+
+    def flush(self):
+        self._drain(force=True)
+
+    @property
+    def pending_count(self):
+        return len(self._pending)
+
+
 class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
@@ -176,6 +229,8 @@ class KVStore:
         self._compression = None
         self._residuals = {}
         self._allreduce = _BucketedAllReduce()
+        self._async_queue = (_AsyncQueue(self._apply_one_update)
+                             if self._is_async else None)
 
     # -- topology ---------------------------------------------------------
     @property
@@ -248,12 +303,47 @@ class KVStore:
         return self._batch_aggregate([key], [values])[0]
 
     def push(self, key, value, priority=0):
+        if self._is_async:
+            keys = key if isinstance(key, (list, tuple)) else [key]
+            vals = value if isinstance(key, (list, tuple)) else [value]
+            for k, v in zip(keys, vals):
+                slots = list(v) if isinstance(v, (list, tuple)) else [v]
+                slots = self._compress_slots(k, slots)
+                for g in slots:  # each device slot = one virtual worker
+                    self._async_queue.push(k, g)
+            return
         if isinstance(key, (list, tuple)):
             aggs = self._batch_aggregate(key, value)
             for k, a in zip(key, aggs):
                 self._apply_push(k, a)
             return
         self._apply_push(key, self._aggregate(value, key))
+
+    def set_async_staleness(self, max_delay, seed=0):
+        """Configure the induced-staleness simulation for `dist_async`
+        (see module docstring). max_delay=0 restores deterministic
+        arrival order."""
+        if not self._is_async:
+            raise ValueError("set_async_staleness requires a dist_async "
+                             "store, got %r" % self.type)
+        self._async_queue.flush()  # don't drop in-flight delayed pushes
+        self._async_queue = _AsyncQueue(self._apply_one_update,
+                                        max_delay=max_delay, seed=seed)
+
+    def _apply_one_update(self, key, grad):
+        """One worker's push = one server-side update (async semantics)."""
+        self._apply_push(key, grad if isinstance(grad, NDArray)
+                         else NDArray(grad))
+
+    def _compress_slots(self, key, slots):
+        """Wire-stage compression for async per-worker pushes. Single-slot
+        pushes skip compression, matching the sync path's n_dev > 1 guard
+        (no wire between worker and server)."""
+        raws = [s._data if isinstance(s, NDArray) else jnp.asarray(s)
+                for s in slots]
+        if self._compression is None or len(raws) <= 1:
+            return raws
+        return self._compress([(key, raws)])[0]
 
     def _apply_push(self, key, agg):
         if self._optimizer is not None:
@@ -282,7 +372,18 @@ class KVStore:
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (parity: kv.pushpull in dist_sync_device).
         List-form calls aggregate ALL keys in one compiled bucketed
-        collective — the efficient path Trainer uses."""
+        collective — the efficient path Trainer uses. In dist_async the
+        push applies per-worker server updates and the pull returns the
+        CURRENT server weights (which may not yet include delayed
+        workers' pushes — the async contract)."""
+        if self._is_async and self._optimizer is not None:
+            self.push(key, value)
+            if out is not None:
+                self.pull(key, out=out)
+                return None
+            if isinstance(key, (list, tuple)):
+                return [self._store[k].copy() for k in key]
+            return self._store[key].copy()
         if isinstance(key, (list, tuple)):
             aggs = self._batch_aggregate(key, value)
             if out is None:
@@ -357,6 +458,8 @@ class KVStore:
                         for k, v in blob.items()}
 
     def barrier(self):
+        if self._async_queue is not None:
+            self._async_queue.flush()  # drain in-flight async pushes
         from ..ndarray import waitall
         waitall()
 

@@ -132,8 +132,9 @@ def test_row_sparse_pull_selected_rows():
 
 
 def test_dist_async_equals_sync_single_host():
-    """Single-process: dist_async update stream is program order, so results
-    are bit-identical to dist_sync (see kvstore module docstring)."""
+    """Single-slot pushes with plain SGD: async per-push updates coincide
+    with sync aggregated updates (one push = one update either way), so
+    results are bit-identical — the degenerate case of the async model."""
     results = {}
     for mode in ("dist_sync", "dist_async"):
         kv = mx.kv.create(mode)
@@ -174,3 +175,124 @@ def test_trainer_batched_allreduce_matches_manual(monkeypatch):
                         property(lambda self: 2), raising=False)
     tr.step(2)
     assert len(calls) == 1 and isinstance(calls[0], list)
+
+
+# ---------------------------------------------------------------------------
+# dist_async semantics (parity: src/kvstore/kvstore_dist_server.h — per-worker
+# arrival-order updates, no aggregation barrier, bounded induced staleness)
+# ---------------------------------------------------------------------------
+
+class _CountingSGD(mx.optimizer.Optimizer):
+    """SGD that counts server-side update calls."""
+
+    def __init__(self, learning_rate=0.1):
+        super().__init__(learning_rate=learning_rate)
+        self.calls = 0
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self.calls += 1
+        weight._data = weight._data - self.lr * grad._data
+        return state
+
+
+def test_async_applies_per_worker_updates():
+    """A push of N device slots is N server updates in async mode (the
+    defining difference from sync's aggregate-then-update)."""
+    n_workers = 4
+    kv_sync = mx.kv.create("dist_sync")
+    kv_async = mx.kv.create("dist_async")
+    opt_s, opt_a = _CountingSGD(), _CountingSGD()
+    for kv, opt in ((kv_sync, opt_s), (kv_async, opt_a)):
+        kv.init("w", _nd(np.zeros((3,))))
+        kv.set_optimizer(opt)
+        kv.push("w", [_nd(np.full((3,), i + 1.0)) for i in range(n_workers)])
+        kv.barrier()
+    assert opt_s.calls == 1
+    assert opt_a.calls == n_workers
+    # plain SGD is linear, so the final weights still agree: sum of
+    # per-worker steps == one aggregated step
+    ws, wa = _nd(np.zeros((3,))), _nd(np.zeros((3,)))
+    kv_sync.pull("w", out=ws)
+    kv_async.pull("w", out=wa)
+    np.testing.assert_allclose(ws.asnumpy(), wa.asnumpy(), rtol=1e-6)
+
+
+def test_async_staleness_reorders_but_loses_nothing():
+    """With induced staleness, pushes apply late and out of order, but a
+    barrier() drains everything: for linear SGD the final weight equals
+    the deterministic result regardless of order (sum of all steps)."""
+    kv = mx.kv.create("dist_async")
+    kv.init("w", _nd(np.zeros((2,))))
+    kv.set_optimizer(_CountingSGD(learning_rate=1.0))
+    kv.set_async_staleness(max_delay=3, seed=7)
+    total = np.zeros((2,), np.float32)
+    rng = np.random.RandomState(0)
+    saw_pending = False
+    for step in range(20):
+        grads = [rng.randn(2).astype(np.float32) for _ in range(4)]
+        total += np.sum(grads, axis=0)
+        kv.push("w", [_nd(g) for g in grads])
+        saw_pending = saw_pending or kv._async_queue.pending_count > 0
+    assert saw_pending, "staleness simulation never delayed a push"
+    assert kv._async_queue.delayed_total > 0
+    kv.barrier()
+    assert kv._async_queue.pending_count == 0
+    out = _nd(np.zeros((2,)))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -total, rtol=1e-5, atol=1e-5)
+
+
+def test_async_pull_sees_stale_weights():
+    """Between pushes, delayed updates are genuinely invisible to pull —
+    the staleness the reference's async mode exposes to workers."""
+    kv = mx.kv.create("dist_async")
+    kv.init("w", _nd(np.zeros((1,))))
+    kv.set_optimizer(_CountingSGD(learning_rate=1.0))
+    kv.set_async_staleness(max_delay=50, seed=3)
+    applied = []
+    for step in range(30):
+        kv.push("w", [_nd(np.ones((1,))) for _ in range(2)])
+        out = _nd(np.zeros((1,)))
+        kv.pull("w", out=out)
+        applied.append(-float(out.asnumpy()[0]))
+    pushed = [(i + 1) * 2.0 for i in range(30)]
+    assert any(a < p for a, p in zip(applied, pushed)), \
+        "pull never observed stale weights under max_delay=50"
+    kv.barrier()
+    out = _nd(np.zeros((1,)))
+    kv.pull("w", out=out)
+    assert -float(out.asnumpy()[0]) == pushed[-1]
+
+
+def test_async_sgd_converges_despite_staleness():
+    """Asynchronous SGD on a least-squares problem: 4 virtual workers
+    compute gradients from the (possibly stale) pulled weights; training
+    still converges (the classic async-PS robustness result)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 5).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 0.5, 3.0, -1.0], np.float32)
+    y = X @ w_true
+
+    kv = mx.kv.create("dist_async")
+    kv.init("w", _nd(np.zeros((5,))))
+    kv.set_optimizer(_CountingSGD(learning_rate=0.02))
+    kv.set_async_staleness(max_delay=2, seed=1)
+
+    shards = np.split(np.arange(64), 4)
+    w_pull = _nd(np.zeros((5,)))
+    for step in range(200):
+        kv.pull("w", out=w_pull)          # workers read possibly-stale w
+        w_cur = w_pull.asnumpy()
+        grads = []
+        for s in shards:
+            err = X[s] @ w_cur - y[s]
+            grads.append(_nd(X[s].T @ err / len(s)))
+        kv.push("w", grads)
+    kv.barrier()
+    kv.pull("w", out=w_pull)
+    final_loss = float(np.mean((X @ w_pull.asnumpy() - y) ** 2))
+    assert final_loss < 1e-3, final_loss
+    assert kv._async_queue.delayed_total > 0  # staleness actually happened
