@@ -65,6 +65,11 @@ def _as_nd(x, ref: Optional["NDArray"] = None):
 
 
 def _binary(jfn, x, y, name=None):
+    for operand in (x, y):
+        # sparse operand (RowSparse/CSR): defer to the sparse class's
+        # reflected operator instead of crashing inside jnp coercion
+        if hasattr(operand, "stype") and not isinstance(operand, NDArray):
+            return NotImplemented
     if isinstance(x, NDArray) and isinstance(y, NDArray):
         return _apply(jfn, [x, y], name=name)
     if isinstance(x, NDArray):

@@ -19,6 +19,8 @@ never sits in a jitted hot loop.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import jax
@@ -29,8 +31,36 @@ from . import NDArray, _as_nd
 __all__ = [
     "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
     "row_sparse_array", "csr_matrix", "cast_storage", "retain", "dot",
-    "zeros", "array",
+    "zeros", "array", "add", "subtract", "multiply", "divide",
+    "elemwise_add", "elemwise_sub", "elemwise_mul",
 ]
+
+
+class StorageFallbackWarning(UserWarning):
+    """An operation on sparse inputs fell back to dense compute (parity:
+    the reference's FComputeFallback log warning,
+    src/operator/operator_common.h LogStorageFallback)."""
+
+
+_FALLBACK_WARNED = set()
+
+
+def _stype_of(x):
+    return x.stype if isinstance(x, BaseSparseNDArray) else "default"
+
+
+def _warn_fallback(op, *operands):
+    key = (op,) + tuple(_stype_of(o) for o in operands)
+    if key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(key)
+        warnings.warn(
+            "sparse storage fallback: %s(%s) has no sparse kernel and is "
+            "computed via dense temporaries" % (op, ", ".join(key[1:])),
+            StorageFallbackWarning, stacklevel=3)
+
+
+def _to_dense_nd(x):
+    return x.todense() if isinstance(x, BaseSparseNDArray) else _as_nd(x)
 
 
 class BaseSparseNDArray:
@@ -113,24 +143,15 @@ class RowSparseNDArray(BaseSparseNDArray):
                                 mine[sel], self._shape)
 
     def __add__(self, other):
-        if isinstance(other, RowSparseNDArray):
-            if other._shape != self._shape:
-                raise ValueError("shape mismatch in row_sparse add")
-            ids = np.concatenate([np.asarray(self.indices),
-                                  np.asarray(other.indices)])
-            uids, pos = np.unique(ids, return_inverse=True)
-            vals = jnp.concatenate([self._data, other._data], axis=0)
-            merged = jax.ops.segment_sum(vals, jnp.asarray(pos),
-                                         num_segments=len(uids))
-            return RowSparseNDArray(merged, uids, self._shape)
-        if isinstance(other, NDArray):
-            return self.todense() + other
-        return NotImplemented
+        return add(self, other)
 
     __radd__ = __add__
 
-    def __mul__(self, scalar):
-        return RowSparseNDArray(self._data * scalar, self.indices, self._shape)
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
 
     __rmul__ = __mul__
 
@@ -173,6 +194,19 @@ class CSRNDArray(BaseSparseNDArray):
 
     def copy(self):
         return CSRNDArray(self._data, self.indices, self.indptr, self._shape)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    __rmul__ = __mul__
 
 
 # ---------------------------------------------------------------------------
@@ -257,20 +291,216 @@ def retain(rsp: RowSparseNDArray, row_ids):
     return rsp.retain(row_ids)
 
 
-def dot(lhs, rhs, transpose_a=False) -> NDArray:
-    """sparse.dot: csr @ dense (and csr.T @ dense), the reference's two
-    supported layouts. Static-nnz segment-sum → jit/MXU friendly."""
+# ---------------------------------------------------------------------------
+# elementwise algebra (parity: python/mxnet/ndarray/sparse.py elemwise_add/
+# sub/mul and the arithmetic operators on sparse arrays). Sparse-sparse
+# kernels keep the result sparse: index merging happens on the host (data-
+# dependent nnz, like cast_storage), value arithmetic on device. Mixed
+# sparse/dense combinations fall back to dense with a StorageFallbackWarning
+# — the reference's LogStorageFallback behavior.
+# ---------------------------------------------------------------------------
+
+def _csr_keys(csr):
+    # host-only: indptr/indices are the layout metadata; no device traffic
+    counts = np.diff(np.asarray(csr.indptr))
+    rows = np.repeat(np.arange(csr._shape[0], dtype=np.int64), counts)
+    cols = np.asarray(csr.indices, np.int64)
+    return rows * csr._shape[1] + cols
+
+
+def _csr_from_keys(keys, values, shape):
+    rows = keys // shape[1]
+    cols = keys % shape[1]
+    indptr = np.zeros(shape[0] + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    return CSRNDArray(values, cols, np.cumsum(indptr), shape)
+
+
+def _csr_union(a, b, negate_b=False):
+    ka, kb = _csr_keys(a), _csr_keys(b)
+    uk, inv = np.unique(np.concatenate([ka, kb]), return_inverse=True)
+    vb = -b._data if negate_b else b._data
+    vals = jax.ops.segment_sum(jnp.concatenate([a._data, vb]),
+                               jnp.asarray(inv), num_segments=len(uk))
+    return _csr_from_keys(uk, vals, a._shape)
+
+
+def _csr_intersect_mul(a, b):
+    ka, kb = _csr_keys(a), _csr_keys(b)
+    common, ia, ib = np.intersect1d(ka, kb, return_indices=True)
+    vals = (jnp.take(a._data, jnp.asarray(ia))
+            * jnp.take(b._data, jnp.asarray(ib)))
+    return _csr_from_keys(common, vals, a._shape)
+
+
+def _rsp_union(a, b, negate_b=False):
+    ids = np.concatenate([np.asarray(a.indices, np.int64),
+                          np.asarray(b.indices, np.int64)])
+    uids, inv = np.unique(ids, return_inverse=True)
+    vb = -b._data if negate_b else b._data
+    vals = jax.ops.segment_sum(jnp.concatenate([a._data, vb], axis=0),
+                               jnp.asarray(inv), num_segments=len(uids))
+    return RowSparseNDArray(vals, uids, a._shape)
+
+
+def _rsp_intersect_mul(a, b):
+    common, ia, ib = np.intersect1d(np.asarray(a.indices, np.int64),
+                                    np.asarray(b.indices, np.int64),
+                                    return_indices=True)
+    vals = (jnp.take(a._data, jnp.asarray(ia), axis=0)
+            * jnp.take(b._data, jnp.asarray(ib), axis=0))
+    return RowSparseNDArray(vals, common, a._shape)
+
+
+def _check_same_shape(op, lhs, rhs):
+    if tuple(lhs.shape) != tuple(rhs.shape):
+        raise ValueError("%s: shape mismatch %s vs %s"
+                         % (op, lhs.shape, rhs.shape))
+
+
+def _is_scalar(x):
+    if np.isscalar(x):
+        return True
+    if isinstance(x, NDArray):
+        return x.shape == ()
+    return (isinstance(x, (np.ndarray, jnp.ndarray))
+            and getattr(x, "ndim", 1) == 0)
+
+
+def _scalar_raw(x):
+    """Value usable in device arithmetic (keeps 0-d NDArrays on device)."""
+    return x._data if isinstance(x, NDArray) else x
+
+
+def add(lhs, rhs):
+    """Storage-aware add: csr+csr -> csr, rsp+rsp -> rsp, anything mixed
+    with dense -> dense (with fallback warning)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        _check_same_shape("add", lhs, rhs)
+        return _csr_union(lhs, rhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        _check_same_shape("add", lhs, rhs)
+        return _rsp_union(lhs, rhs)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        _warn_fallback("elemwise_add", lhs, rhs)
+        return _to_dense_nd(lhs) + _to_dense_nd(rhs)
+    return _as_nd(lhs) + _as_nd(rhs)
+
+
+def subtract(lhs, rhs):
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        _check_same_shape("subtract", lhs, rhs)
+        return _csr_union(lhs, rhs, negate_b=True)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        _check_same_shape("subtract", lhs, rhs)
+        return _rsp_union(lhs, rhs, negate_b=True)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        _warn_fallback("elemwise_sub", lhs, rhs)
+        return _to_dense_nd(lhs) - _to_dense_nd(rhs)
+    return _as_nd(lhs) - _as_nd(rhs)
+
+
+def multiply(lhs, rhs):
+    """Storage-aware multiply. Sparse*scalar and sparse*sparse stay sparse
+    (intersection of patterns); sparse*dense keeps the SPARSE pattern
+    (zeros absorb), matching the reference's elemwise_mul(csr, default) ->
+    csr kernel."""
+    if _is_scalar(rhs):
+        lhs, rhs = rhs, lhs
+    if _is_scalar(lhs):
+        s = _scalar_raw(lhs)
+        if isinstance(rhs, CSRNDArray):
+            return CSRNDArray(rhs._data * s, rhs.indices, rhs.indptr,
+                              rhs._shape)
+        if isinstance(rhs, RowSparseNDArray):
+            return RowSparseNDArray(rhs._data * s, rhs.indices,
+                                    rhs._shape)
+        return _as_nd(rhs) * s
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        _check_same_shape("multiply", lhs, rhs)
+        return _csr_intersect_mul(lhs, rhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        _check_same_shape("multiply", lhs, rhs)
+        return _rsp_intersect_mul(lhs, rhs)
+    # sparse * dense: gather dense values at the sparse pattern
+    for a, b in ((lhs, rhs), (rhs, lhs)):
+        if isinstance(a, CSRNDArray) and isinstance(b, NDArray):
+            _check_same_shape("multiply", a, b)
+            rows = a._row_of_nnz()
+            dvals = b._data[rows, a.indices]
+            return CSRNDArray(a._data * dvals, a.indices, a.indptr,
+                              a._shape)
+        if isinstance(a, RowSparseNDArray) and isinstance(b, NDArray):
+            _check_same_shape("multiply", a, b)
+            dvals = jnp.take(b._data, a.indices, axis=0)
+            return RowSparseNDArray(a._data * dvals, a.indices, a._shape)
+    return _as_nd(lhs) * _as_nd(rhs)
+
+
+def divide(lhs, rhs):
+    if _is_scalar(rhs):
+        # direct division: array semantics for /0 (inf/nan, no Python
+        # ZeroDivisionError) and full precision for large divisors
+        s = _scalar_raw(rhs)
+        if isinstance(lhs, CSRNDArray):
+            return CSRNDArray(lhs._data / s, lhs.indices, lhs.indptr,
+                              lhs._shape)
+        if isinstance(lhs, RowSparseNDArray):
+            return RowSparseNDArray(lhs._data / s, lhs.indices, lhs._shape)
+        return _as_nd(lhs) / s
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        # no sparse division kernel in the reference either (0/0 hazards)
+        _warn_fallback("elemwise_div", lhs, rhs)
+        return _to_dense_nd(lhs) / _to_dense_nd(rhs)
+    return _as_nd(lhs) / _as_nd(rhs)
+
+
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
+    """sparse.dot (parity: mx.nd.sparse.dot / src/operator/tensor/dot-inl.h).
+
+    Supported layouts, all static-nnz segment-sums (jit/MXU friendly):
+      dot(csr, dense)       dot(csr.T, dense)      [the reference's core two]
+      dot(csr, row_sparse)  dot(csr.T, row_sparse) [rhs rows materialized]
+      dot(dense, csr)   = (csr.T @ dense.T).T      [transpose identity]
+      dot(dense, csr.T) = (csr  @ dense.T).T
+    """
+    if isinstance(lhs, NDArray) and isinstance(rhs, CSRNDArray):
+        if transpose_a:
+            raise NotImplementedError("dot(dense.T, csr) is unsupported "
+                                      "(as in the reference)")
+        out = dot(rhs, NDArray(jnp.swapaxes(lhs._data, -1, -2)),
+                  transpose_a=not transpose_b)
+        return NDArray(jnp.swapaxes(out._data, -1, -2))
     if not isinstance(lhs, CSRNDArray):
-        raise TypeError("sparse.dot expects a CSRNDArray lhs")
+        raise TypeError("sparse.dot expects a CSRNDArray operand")
+    if transpose_b:
+        raise NotImplementedError("dot(csr, rhs.T) is unsupported (as in "
+                                  "the reference)")
+    if isinstance(rhs, RowSparseNDArray):
+        rhs = rhs.todense()  # device scatter; pattern is lost in the output
     rhs = _as_nd(rhs)
     rows = lhs._row_of_nnz()
-    gathered = jnp.take(rhs._data, lhs.indices, axis=0)  # (nnz, K)
-    contrib = lhs._data[:, None] * gathered
     if transpose_a:
-        out = jax.ops.segment_sum(contrib, lhs.indices,
+        # (A.T @ Y)[c] = sum_r A[r, c] * Y[r]: gather Y by nnz row ids,
+        # scatter-add into the column segments
+        gathered = jnp.take(rhs._data, rows, axis=0)          # (nnz, K)
+        out = jax.ops.segment_sum(lhs._data[:, None] * gathered,
+                                  lhs.indices,
                                   num_segments=lhs._shape[1])
     else:
-        out = jax.ops.segment_sum(contrib, rows,
+        gathered = jnp.take(rhs._data, lhs.indices, axis=0)   # (nnz, K)
+        out = jax.ops.segment_sum(lhs._data[:, None] * gathered, rows,
                                   num_segments=lhs._shape[0])
     return NDArray(out)
 

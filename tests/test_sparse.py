@@ -5,6 +5,7 @@ Parity model: python/mxnet/ndarray/sparse.py +
 src/operator/tensor/cast_storage-inl.h + sgd lazy_update.
 """
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd
@@ -163,3 +164,159 @@ def test_kvstore_row_sparse_pull_returns_sparse():
     np.testing.assert_array_equal(np.asarray(rsp.indices), [1, 4])
     np.testing.assert_allclose(rsp.asnumpy()[[1, 4]], w[[1, 4]])
     np.testing.assert_allclose(rsp.asnumpy()[[0, 2, 3]], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# elementwise sparse algebra (parity: python/mxnet/ndarray/sparse.py
+# elemwise_add/sub/mul, operator overloads, storage fallback warnings)
+# ---------------------------------------------------------------------------
+
+def _rand_csr(rng, shape, density=0.3):
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) > density] = 0.0
+    return sparse.csr_matrix(nd.array(dense)), dense
+
+
+def _rand_rsp(rng, shape, density=0.5):
+    dense = rng.randn(*shape).astype(np.float32)
+    dead = rng.rand(shape[0]) > density
+    dense[dead] = 0.0
+    return sparse.row_sparse_array(nd.array(dense)), dense
+
+
+def test_csr_add_sub_union():
+    rng = np.random.RandomState(0)
+    a, da = _rand_csr(rng, (5, 7))
+    b, db = _rand_csr(rng, (5, 7))
+    s = sparse.add(a, b)
+    assert s.stype == "csr"
+    np.testing.assert_allclose(s.asnumpy(), da + db, rtol=1e-6)
+    d = sparse.subtract(a, b)
+    assert d.stype == "csr"
+    np.testing.assert_allclose(d.asnumpy(), da - db, rtol=1e-6)
+    # operator overloads route the same kernels
+    np.testing.assert_allclose((a + b).asnumpy(), da + db, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), da - db, rtol=1e-6)
+
+
+def test_csr_mul_intersection_stays_sparse():
+    rng = np.random.RandomState(1)
+    a, da = _rand_csr(rng, (4, 6))
+    b, db = _rand_csr(rng, (4, 6))
+    m = sparse.multiply(a, b)
+    assert m.stype == "csr"
+    np.testing.assert_allclose(m.asnumpy(), da * db, rtol=1e-6)
+    # nnz of the product is at most the smaller pattern
+    assert m.nnz <= min(a.nnz, b.nnz)
+
+
+def test_csr_mul_dense_keeps_pattern():
+    rng = np.random.RandomState(2)
+    a, da = _rand_csr(rng, (4, 6))
+    dense = rng.randn(4, 6).astype(np.float32)
+    m = sparse.multiply(a, nd.array(dense))
+    assert m.stype == "csr" and m.nnz == a.nnz
+    np.testing.assert_allclose(m.asnumpy(), da * dense, rtol=1e-6)
+
+
+def test_rsp_add_sub_mul():
+    rng = np.random.RandomState(3)
+    a, da = _rand_rsp(rng, (6, 3))
+    b, db = _rand_rsp(rng, (6, 3))
+    np.testing.assert_allclose(sparse.add(a, b).asnumpy(), da + db,
+                               rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), da - db, rtol=1e-6)
+    m = sparse.multiply(a, b)
+    assert m.stype == "row_sparse"
+    np.testing.assert_allclose(m.asnumpy(), da * db, rtol=1e-6)
+
+
+def test_scalar_ops_stay_sparse():
+    rng = np.random.RandomState(4)
+    a, da = _rand_csr(rng, (3, 5))
+    r, dr = _rand_rsp(rng, (5, 2))
+    m = sparse.multiply(a, 2.5)
+    assert m.stype == "csr"
+    np.testing.assert_allclose(m.asnumpy(), da * 2.5, rtol=1e-6)
+    d = sparse.divide(r, 2.0)
+    assert d.stype == "row_sparse"
+    np.testing.assert_allclose(d.asnumpy(), dr / 2.0, rtol=1e-6)
+    np.testing.assert_allclose((2.5 * a).asnumpy(), da * 2.5, rtol=1e-6)
+
+
+def test_storage_fallback_warns_once():
+    import warnings as w
+    rng = np.random.RandomState(5)
+    a, da = _rand_csr(rng, (3, 4))
+    dense = nd.array(rng.randn(3, 4).astype(np.float32))
+    sparse._FALLBACK_WARNED.clear()
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        out = sparse.add(a, dense)
+        out2 = sparse.add(a, dense)
+    fb = [x for x in rec if issubclass(x.category,
+                                       sparse.StorageFallbackWarning)]
+    assert len(fb) == 1  # warned once per op/storage signature
+    assert isinstance(out, nd.NDArray) and not isinstance(
+        out, sparse.BaseSparseNDArray)
+    np.testing.assert_allclose(out.asnumpy(), da + dense.asnumpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy())
+
+
+def test_sparse_div_fallback():
+    import warnings as w
+    rng = np.random.RandomState(6)
+    a, da = _rand_csr(rng, (3, 4))
+    dense = nd.array(np.full((3, 4), 2.0, np.float32))
+    sparse._FALLBACK_WARNED.clear()
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        out = sparse.divide(a, dense)
+    assert any(issubclass(x.category, sparse.StorageFallbackWarning)
+               for x in rec)
+    np.testing.assert_allclose(out.asnumpy(), da / 2.0, rtol=1e-6)
+
+
+def test_elemwise_shape_mismatch_raises():
+    rng = np.random.RandomState(7)
+    a, _ = _rand_csr(rng, (3, 4))
+    b, _ = _rand_csr(rng, (4, 3))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        sparse.add(a, b)
+
+
+def test_dot_csr_rsp():
+    rng = np.random.RandomState(8)
+    a, da = _rand_csr(rng, (4, 6))
+    r, dr = _rand_rsp(rng, (6, 3))
+    out = sparse.dot(a, r)
+    np.testing.assert_allclose(out.asnumpy(), da @ dr, rtol=1e-5,
+                               atol=1e-5)
+    x = rng.randn(4, 2).astype(np.float32)
+    outT = sparse.dot(a, nd.array(x), transpose_a=True)
+    assert outT.shape == (6, 2)
+    # regression: transpose_a must gather rhs by nnz ROW ids, not column
+    # indices (a silent-NaN bug when shape[1] > shape[0])
+    np.testing.assert_allclose(outT.asnumpy(), da.T @ x, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_dot_dense_csr_transpose_identity():
+    rng = np.random.RandomState(9)
+    a, da = _rand_csr(rng, (4, 6))
+    x = rng.randn(3, 4).astype(np.float32)
+    out = sparse.dot(nd.array(x), a)
+    np.testing.assert_allclose(out.asnumpy(), x @ da, rtol=1e-5, atol=1e-5)
+    x2 = rng.randn(3, 6).astype(np.float32)
+    out2 = sparse.dot(nd.array(x2), a, transpose_b=True)
+    np.testing.assert_allclose(out2.asnumpy(), x2 @ da.T, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_dot_csr_transpose_b_unsupported():
+    rng = np.random.RandomState(10)
+    a, _ = _rand_csr(rng, (4, 6))
+    with pytest.raises(NotImplementedError):
+        sparse.dot(a, nd.array(np.ones((2, 6), np.float32)),
+                   transpose_b=True)
