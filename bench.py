@@ -191,13 +191,13 @@ def _build_lenet(batch, dtype):
 
 def _build_ssd(batch, dtype):
     """BASELINE config 4: SSD-512 VOC-shape training step (example/ssd).
-    Synthetic boxes; matching targets precomputed ONCE — anchor matching
-    depends only on the fixed anchors + labels, but the hard-negative set
-    is mined against the INITIAL predictions and then frozen, which is
-    fine for a throughput bench (constant per-step work) but not for a
-    convergence run (train against fresh targets there)."""
+    Synthetic boxes; hard negatives are re-mined against the CURRENT
+    predictions every step, inside the compiled step (MultiBoxTarget is
+    pure lax, so the mining compiles into the same XLA program — the
+    reference's per-iteration MultiBoxTarget, minus its CPU round trip).
+    Mining inputs are stop-gradiented: targets are labels, not a
+    differentiable path."""
     from incubator_mxnet_tpu.models.ssd import ssd_512_resnet50_v1, SSDLoss
-    from incubator_mxnet_tpu import autograd as ag
     classes = 20
     net = ssd_512_resnet50_v1(classes=classes, layout="NHWC")
     net.initialize(init=mx.init.Xavier())
@@ -213,13 +213,14 @@ def _build_ssd(batch, dtype):
             x0, y0 = rng.rand(2) * 0.5
             label[b, j] = [rng.randint(0, classes), x0, y0,
                            x0 + 0.3, y0 + 0.3]
-    with ag.pause():
-        anchor, cls_pred, _ = net(x)
-        bt, bm, ct = net.targets(anchor, cls_pred, nd.array(label))
+    label_nd = nd.array(label)
     ssd_l = SSDLoss()
 
     def loss_fn(out, _y):
-        return ssd_l(out[1], out[2], ct, bt, bm)
+        anchor, cls_pred, box_pred = out
+        bt, bm, ct = net.targets(nd.stop_gradient(anchor),
+                                 nd.stop_gradient(cls_pred), label_nd)
+        return ssd_l(cls_pred, box_pred, ct, bt, bm)
 
     y = nd.array(np.zeros(batch, np.float32))     # unused placeholder
     return net, loss_fn, x, y, 3 * 30e9, "ssd512_voc"

@@ -64,12 +64,28 @@ def _as_nd(x, ref: Optional["NDArray"] = None):
     return NDArray(jnp.asarray(x, dtype=dtype))
 
 
+def _is_sparse_operand(x):
+    return hasattr(x, "stype") and not isinstance(x, NDArray)
+
+
+# dunder/function short-name -> storage-aware kernel in ndarray.sparse
+_SPARSE_BINARY = {"add": "add", "sub": "subtract", "subtract": "subtract",
+                  "mul": "multiply", "multiply": "multiply",
+                  "div": "divide", "divide": "divide"}
+
+
 def _binary(jfn, x, y, name=None):
-    for operand in (x, y):
-        # sparse operand (RowSparse/CSR): defer to the sparse class's
-        # reflected operator instead of crashing inside jnp coercion
-        if hasattr(operand, "stype") and not isinstance(operand, NDArray):
-            return NotImplemented
+    if _is_sparse_operand(x) or _is_sparse_operand(y):
+        # route through the storage-aware sparse kernels (pattern-keeping
+        # where one exists, dense fallback with warning where not) instead
+        # of crashing inside jnp coercion
+        from . import sparse as _sp
+        opname = _SPARSE_BINARY.get(name)
+        if opname is not None:
+            return getattr(_sp, opname)(x, y)
+        _sp._warn_fallback(name or "binary", x, y)
+        x = x.todense() if _is_sparse_operand(x) else x
+        y = y.todense() if _is_sparse_operand(y) else y
     if isinstance(x, NDArray) and isinstance(y, NDArray):
         return _apply(jfn, [x, y], name=name)
     if isinstance(x, NDArray):

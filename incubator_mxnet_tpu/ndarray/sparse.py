@@ -95,6 +95,29 @@ class BaseSparseNDArray:
         return (f"<{type(self).__name__} {self._shape} "
                 f"dtype={self.dtype} nnz={self.nnz}>")
 
+    # arithmetic routes through the storage-aware module functions below
+    def __add__(self, other):
+        return add(self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __rsub__(self, other):
+        return subtract(other, self)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __rtruediv__(self, other):
+        return divide(other, self)
+
 
 class RowSparseNDArray(BaseSparseNDArray):
     """Rows `indices` of an abstract dense (N, ...) array, stacked in `data`
@@ -142,19 +165,6 @@ class RowSparseNDArray(BaseSparseNDArray):
         return RowSparseNDArray(jnp.take(self._data, jnp.asarray(sel), axis=0),
                                 mine[sel], self._shape)
 
-    def __add__(self, other):
-        return add(self, other)
-
-    __radd__ = __add__
-
-    def __sub__(self, other):
-        return subtract(self, other)
-
-    def __mul__(self, other):
-        return multiply(self, other)
-
-    __rmul__ = __mul__
-
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix (parity: mx.nd.sparse.CSRNDArray)."""
@@ -194,19 +204,6 @@ class CSRNDArray(BaseSparseNDArray):
 
     def copy(self):
         return CSRNDArray(self._data, self.indices, self.indptr, self._shape)
-
-    def __add__(self, other):
-        return add(self, other)
-
-    __radd__ = __add__
-
-    def __sub__(self, other):
-        return subtract(self, other)
-
-    def __mul__(self, other):
-        return multiply(self, other)
-
-    __rmul__ = __mul__
 
 
 # ---------------------------------------------------------------------------
@@ -489,6 +486,9 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
                                   "the reference)")
     if isinstance(rhs, RowSparseNDArray):
         rhs = rhs.todense()  # device scatter; pattern is lost in the output
+    elif isinstance(rhs, CSRNDArray):
+        raise NotImplementedError("dot(csr, csr) is unsupported (as in the "
+                                  "reference); densify one operand")
     rhs = _as_nd(rhs)
     rows = lhs._row_of_nnz()
     if transpose_a:
