@@ -45,6 +45,17 @@ def probe(timeout=60):
         return False
 
 
+def _last_json_line(stdout):
+    for ln in reversed(stdout.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def run_bench(env_overrides, timeout):
     env = dict(os.environ)
     env.update({k: str(v) for k, v in env_overrides.items()})
@@ -58,16 +69,11 @@ def run_bench(env_overrides, timeout):
         log(f"bench TIMED OUT after {timeout}s: {desc}")
         return None
     wall = time.time() - t0
-    line = None
-    for ln in r.stdout.splitlines():
-        ln = ln.strip()
-        if ln.startswith("{"):
-            line = ln
-    if not line:
+    out = _last_json_line(r.stdout)
+    if out is None:
         log(f"bench produced no JSON (rc={r.returncode}); stderr tail: "
             f"{r.stderr[-300:]}")
         return None
-    out = json.loads(line)
     out["_wall_s"] = round(wall, 1)
     out["_config"] = desc
     if out.get("error"):
@@ -78,10 +84,42 @@ def run_bench(env_overrides, timeout):
     return out
 
 
+PALLAS_TAG = os.environ.get("PALLAS_TAG", "r04")
+
+
+def run_pallas_validation(timeout=1800):
+    """Stage 0: compiled pallas kernels vs XLA on the chip (VERDICT r3
+    weak #3) — parity must hold BEFORE the protected bench risks the
+    tunnel on a Mosaic bug. Writes docs/pallas_onchip_<PALLAS_TAG>.md."""
+    log("stage 0: pallas on-chip validation")
+    try:
+        r = subprocess.run([sys.executable, "tools/pallas_onchip.py"],
+                           timeout=timeout, capture_output=True, text=True,
+                           cwd=ROOT)
+    except subprocess.TimeoutExpired:
+        log("pallas validation TIMED OUT — treating tunnel as unhealthy")
+        return None
+    log(f"pallas validation rc={r.returncode}")
+    out = _last_json_line(r.stdout)
+    if out is None:
+        log(f"no JSON from pallas validation; stderr: {r.stderr[-300:]}")
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     if not probe():
         sys.exit(2)
+
+    pallas_res = None
+    if "--skip-pallas" not in sys.argv:
+        pallas_res = run_pallas_validation()
+        if pallas_res is None:
+            log("aborting: pallas validation did not complete (tunnel?)")
+            sys.exit(2)
+        if not pallas_res.get("all_ok"):
+            log("pallas kernels FAILED parity on chip — sweep continues "
+                "(bench uses the XLA path), but fix before enabling pallas")
 
     results = []
 
@@ -93,11 +131,13 @@ def main():
 
     steps = 20
     base = {"BENCH_STEPS": steps}
+    aborted = False
     # 1) dispatch-vs-compute: K sweep at the round-2 config (b128, already
     #    the cheapest compile; K=1 first so the base step compiles alone)
     for k in ([1, 8] if quick else [1, 5, 20]):
         if record({**base, "BENCH_K": k}) is None:
             log("aborting sweep (unhealthy run)")
+            aborted = True
             break
     else:
         # 2) stem + batch sweep, gradual; 256 ONLY with remat (hard rule).
@@ -117,33 +157,63 @@ def main():
                         and not cfg.get("BENCH_REMAT")), "banned config"
             if record({**base, **cfg}) is None:
                 log("aborting batch sweep (unhealthy run)")
+                aborted = True
+                break
+
+    # 3) BERT (BASELINE config 2; first-ever chip number for this model —
+    #    VERDICT r3 next-step #4). Flash attention pays here; default
+    #    batch from bench.py, one K variant. HARD RULE: any earlier
+    #    timeout means the tunnel is presumed unhealthy — a fresh BERT
+    #    compile on a sick tunnel is exactly the round-2 wedge; the tiny
+    #    probe is not sufficient clearance after an abort.
+    if results and not aborted and probe():
+        for cfg in ([{"BENCH_MODEL": "bert"}] if quick else
+                    [{"BENCH_MODEL": "bert"},
+                     {"BENCH_MODEL": "bert", "BENCH_K": 8}]):
+            if record({**base, **cfg}) is None:
+                log("aborting BERT stage (unhealthy run)")
                 break
 
     if not results:
         log("no successful runs")
         sys.exit(1)
 
-    best = max(results, key=lambda r: r["value"])
+    resnet = [r for r in results if "BENCH_MODEL" not in r["_config"]]
+    bert = [r for r in results if "bert" in r["_config"]]
+    best = max(resnet, key=lambda r: r["value"]) if resnet else results[0]
     lines = [
-        "# PERF — round-3 TPU sweep (one v5e chip via axon tunnel)",
+        "# PERF — TPU sweep (one v5e chip via axon tunnel)",
         "",
-        f"Sweep of {time.strftime('%Y-%m-%d %H:%M')} — ResNet-50",
-        "ImageNet-shape fused train step, bf16, numbers from `bench.py`",
-        "subprocess runs (the driver's exact path; compiles cached in",
-        "`.jax_cache`). `k` = micro-steps dispatched as ONE XLA program",
-        "(`FusedTrainStep.run_k`); wall includes per-run process startup.",
+        f"Sweep of {time.strftime('%Y-%m-%d %H:%M')} — fused train steps,",
+        "bf16, numbers from `bench.py` subprocess runs (the driver's exact",
+        "path; compiles cached in `.jax_cache`). `k` = micro-steps",
+        "dispatched as ONE XLA program (`FusedTrainStep.run_k`); wall",
+        "includes per-run process startup.",
         "",
-        "| config | img/s | MFU | wall (s) |",
-        "|---|---|---|---|",
+        "| config | value | unit | MFU | wall (s) |",
+        "|---|---|---|---|---|",
     ]
     for r in results:
         e = r.get("extra", {})
-        lines.append(f"| {r['_config']} | {r['value']} | "
+        lines.append(f"| {r['_config']} | {r['value']} | {r['unit']} | "
                      f"{e.get('mfu', '?')} | {r['_wall_s']} |")
     lines += [
         "",
-        f"**Best: {best['_config']} → {best['value']} img/s "
+        f"**Best ResNet-50: {best['_config']} → {best['value']} img/s "
         f"(MFU {best.get('extra', {}).get('mfu')})**",
+    ]
+    if bert:
+        bb = max(bert, key=lambda r: r["value"])
+        lines.append(f"**BERT: {bb['_config']} → {bb['value']} "
+                     f"{bb['unit']} (MFU "
+                     f"{bb.get('extra', {}).get('mfu')})**")
+    if pallas_res is not None:
+        lines += ["",
+                  "Pallas on-chip validation: "
+                  + ("ALL OK" if pallas_res.get("all_ok") else "FAILURES")
+                  + f" — see docs/pallas_onchip_{PALLAS_TAG}.md for the "
+                  "parity and kernel-vs-XLA timing table."]
+    lines += [
         "",
         "Protocol notes: tunnel probed with a 60 s matmul+fetch before the",
         "sweep; batch 256 runs only with remat (a 256-no-remat compile",
