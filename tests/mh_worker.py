@@ -1,0 +1,82 @@
+"""Worker program for tests/test_multihost_real.py — runs as one process
+of a REAL 2-process jax cluster (gloo collectives over loopback).
+
+Each process owns half the global batch (2 local CPU devices -> 4-device
+global dp mesh) and trains a linear model for N steps; the final weights
+are printed and must match the single-process result bit-for-bit-ish
+(same global batch, same seed). Exercises the exact API surface of the
+multi-host runbook in distributed.py: init -> global_mesh ->
+make_array_from_process_local_data -> jitted step with replicated
+out_shardings -> barrier -> shutdown.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    steps = int(sys.argv[4])
+
+    mx.distributed.init(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=nproc, process_id=pid)
+    assert mx.distributed.rank() == pid
+    assert mx.distributed.num_workers() == nproc
+    assert len(mx.distributed.global_devices()) == 2 * nproc
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == nproc, kv.num_workers
+
+    mesh = mx.distributed.global_mesh({"dp": -1})
+    # deterministic global problem, identical on every process
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 5).astype(np.float32)
+    w_true = np.arange(5, dtype=np.float32)
+    y = X @ w_true
+    # each process contributes ITS OWN shard of the global batch
+    per = 16 // nproc
+    X_local, y_local = X[pid * per:(pid + 1) * per], \
+        y[pid * per:(pid + 1) * per]
+    xs = NamedSharding(mesh, P("dp"))
+    rs = NamedSharding(mesh, P())
+    Xg = jax.make_array_from_process_local_data(xs, X_local)
+    yg = jax.make_array_from_process_local_data(xs, y_local)
+
+    @jax.jit
+    def step(w, Xg, yg):
+        # mean over the GLOBAL batch: GSPMD inserts the cross-process
+        # all-reduce for the contraction over the dp-sharded axis
+        def loss(w):
+            return jnp.mean((Xg @ w - yg) ** 2)
+        g = jax.grad(loss)(w)
+        return w - 0.05 * g
+
+    w = jax.device_put(jnp.zeros((5,), jnp.float32), rs)
+    for _ in range(steps):
+        w = step(w, Xg, yg)
+    final = np.asarray(jax.device_get(w))
+    print("FINAL_W", " ".join(f"{v:.6f}" for v in final), flush=True)
+    loss = float(np.mean((X @ final - y) ** 2))
+    print("FINAL_LOSS", f"{loss:.6f}", flush=True)
+    mx.distributed.barrier()
+    print("BARRIER_OK", flush=True)
+    mx.distributed.shutdown()
+    print("SHUTDOWN_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
