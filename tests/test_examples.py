@@ -67,3 +67,9 @@ def test_example_rnn_bucketing():
 def test_example_quantize_inference():
     out = _run("quantize_inference.py")
     assert "agreement" in out
+
+
+@pytest.mark.slow
+def test_example_onnx():
+    out = _run("onnx_export_import.py", "--steps", "5")
+    assert "OK: ONNX round trip preserves predictions" in out

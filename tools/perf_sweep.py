@@ -98,11 +98,12 @@ def run_pallas_validation(timeout=1800):
                            cwd=ROOT)
     except subprocess.TimeoutExpired:
         log("pallas validation TIMED OUT — treating tunnel as unhealthy")
-        return None
+        return "timeout"
     log(f"pallas validation rc={r.returncode}")
     out = _last_json_line(r.stdout)
     if out is None:
-        log(f"no JSON from pallas validation; stderr: {r.stderr[-300:]}")
+        log(f"no JSON from pallas validation (crash); stderr: "
+            f"{r.stderr[-300:]}")
     return out
 
 
@@ -114,22 +115,29 @@ def main():
     pallas_res = None
     if "--skip-pallas" not in sys.argv:
         pallas_res = run_pallas_validation()
+        if pallas_res == "timeout":
+            # a timeout IS the wedge signature (round-2 postmortem); the
+            # tiny probe is not sufficient clearance after one
+            log("aborting: pallas validation timed out (tunnel presumed "
+                "wedged)")
+            sys.exit(2)
         if pallas_res is None:
-            # timeout vs crash: only a TIMEOUT implies a wedged tunnel.
-            # A crash (Mosaic lowering bug etc.) is exactly what stage 0
-            # exists to surface — re-probe and continue the sweep on the
-            # XLA path rather than killing the long-awaited bench run.
+            # clean crash (Mosaic lowering bug etc.) — exactly what stage
+            # 0 exists to surface; re-probe and continue on the XLA path
+            # rather than killing the long-awaited bench run
             if not probe():
                 log("aborting: tunnel unhealthy after pallas validation")
                 sys.exit(2)
             log("pallas validation crashed but tunnel is healthy — "
                 "continuing sweep on the XLA path; fix the kernels")
         elif not pallas_res.get("is_tpu"):
-            # jax silently fell back to CPU: the kernels ran interpret=True
-            # and the 'on-chip' claim would be vacuous
-            log("pallas validation ran on CPU (is_tpu=false) — result is "
-                "NOT an on-chip validation; treating as not-run")
-            pallas_res = None
+            # jax silently fell back to CPU: the TPU is unreachable for
+            # this environment, and every bench subprocess would fall back
+            # the same way — PERF.md would publish CPU numbers as TPU
+            log("aborting: pallas validation ran on CPU (is_tpu=false) — "
+                "the TPU backend is not reachable; refusing to publish "
+                "CPU throughput as a TPU sweep")
+            sys.exit(2)
         elif not pallas_res.get("all_ok"):
             log("pallas kernels FAILED parity on chip — sweep continues "
                 "(bench uses the XLA path), but fix before enabling pallas")
