@@ -555,6 +555,24 @@ def _exp_unary(ex, idx, node):
     ex.names[(idx, 0)] = node["name"]
 
 
+@_export("Pad")
+def _exp_pad(ex, idx, node):
+    a = node["attrs"]
+    pw = tuple(a["pad_width"])
+    ndim = len(pw) // 2
+    # ONNX pads layout: all begins then all ends
+    pads = [pw[2 * i] for i in range(ndim)] + [pw[2 * i + 1]
+                                               for i in range(ndim)]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[a.get("mode", "constant")]
+    ins = ex.resolve(node) + [
+        ex.add_init(node["name"] + "_pads", np.asarray(pads, np.int64)),
+        ex.add_init(node["name"] + "_cval",
+                    np.asarray(a.get("constant_value", 0), np.float32))]
+    ex.add_node("Pad", ins, [node["name"]], node["name"], mode=mode)
+    ex.names[(idx, 0)] = node["name"]
+
+
 @_export("gelu")
 def _exp_gelu(ex, idx, node):
     # opset 13 has no Gelu; emit the exact erf form
@@ -732,7 +750,9 @@ def _import(*ops):
     return deco
 
 
-def _onnx_pads(attrs, ndim):
+def _onnx_pads(attrs, ndim, allow_asymmetric=False):
+    """Symmetric pads -> per-dim tuple; asymmetric -> (begin, end) pair
+    when the caller can emit an explicit Pad node, else a clear error."""
     auto = attrs.get("auto_pad", "")
     if auto not in ("", "NOTSET", "VALID"):
         raise NotImplementedError(
@@ -741,10 +761,32 @@ def _onnx_pads(attrs, ndim):
     pads = attrs.get("pads")
     if not pads:
         return (0,) * ndim
-    begin, end = pads[:ndim], pads[ndim:]
-    if tuple(begin) != tuple(end):
+    begin, end = tuple(pads[:ndim]), tuple(pads[ndim:])
+    if begin != end:
+        if allow_asymmetric:
+            return (begin, end)
         raise NotImplementedError("asymmetric ONNX pads %r" % (pads,))
-    return tuple(begin)
+    return begin
+
+
+def _maybe_prepad(im, node, data_sym, a, ndim):
+    """Asymmetric Conv pads: insert an explicit zero Pad on the spatial
+    dims and zero out the op's own padding. Conv ONLY — ConvTranspose
+    pads crop the OUTPUT in ONNX semantics, so pre-padding the input
+    would be wrong there (Deconvolution keeps its symmetric-only
+    error)."""
+    pads = _onnx_pads(a, ndim, allow_asymmetric=True)
+    if not (pads and isinstance(pads[0], tuple)):
+        return data_sym, pads
+    begin, end = pads
+    # NCHW: batch and channel dims unpadded, then per-spatial begin/end
+    pw = [0, 0, 0, 0]
+    for b, e in zip(begin, end):
+        pw += [int(b), int(e)]
+    padded = im.S.Pad(data_sym, mode="constant", pad_width=tuple(pw),
+                      constant_value=0,
+                      name=(node.name + "_prepad") if node.name else None)
+    return padded, (0,) * ndim
 
 
 @_import("Conv")
@@ -755,13 +797,15 @@ def _imp_conv(im, node, a):
     if nf is None:
         raise ValueError("Conv %s: weight initializer required to recover "
                          "num_filter" % node.name)
+    data, pad = _maybe_prepad(im, node, im.sym_of(node.input[0]), a,
+                              len(k))
     im.tensors[node.output[0]] = im.S.Convolution(
-        data=im.sym_of(node.input[0]), weight=im.sym_of(node.input[1]),
+        data=data, weight=im.sym_of(node.input[1]),
         bias=im.sym_of(node.input[2]) if len(node.input) > 2 else None,
         no_bias=len(node.input) <= 2, kernel=k,
         stride=tuple(a.get("strides", (1,) * len(k))),
         dilate=tuple(a.get("dilations", (1,) * len(k))),
-        pad=_onnx_pads(a, len(k)), num_filter=int(nf),
+        pad=pad, num_filter=int(nf),
         num_group=int(a.get("group", 1)), name=node.name or None)
 
 
@@ -987,6 +1031,35 @@ def _imp_log_softmax(im, node, a):
 @_import("Identity", "Dropout")
 def _imp_identity(im, node, a):
     im.tensors[node.output[0]] = im.sym_of(node.input[0])
+
+
+@_import("Pad")
+def _imp_pad(im, node, a):
+    mode = a.get("mode", "constant")
+    if mode not in ("constant", "edge", "reflect"):
+        raise NotImplementedError("Pad mode=%r is unsupported" % mode)
+    if "pads" in a:  # opset < 11: attribute form
+        pads = [int(p) for p in a["pads"]]
+        cval = float(a.get("value", 0.0))
+    else:
+        pads = [int(p) for p in im.inits[node.input[1]]]
+        if len(node.input) > 2 and node.input[2]:
+            cval = _scalar_init(im, node.input[2])
+            if cval is None:
+                raise NotImplementedError(
+                    "Pad %s: constant_value must be a scalar initializer "
+                    "(computed values are unsupported)" % node.name)
+        else:
+            cval = 0.0
+    if any(p < 0 for p in pads):
+        raise NotImplementedError("negative ONNX pads (crop) %r" % (pads,))
+    ndim = len(pads) // 2
+    pw = []
+    for i in range(ndim):
+        pw += [pads[i], pads[ndim + i]]
+    im.tensors[node.output[0]] = im.S.Pad(
+        im.sym_of(node.input[0]), mode=mode, pad_width=tuple(pw),
+        constant_value=cval, name=node.name or None)
 
 
 def _scalar_init(im, name):

@@ -982,6 +982,11 @@ def slice_like(x, shape_like, axes=None):
 
 def pad(x, mode="constant", pad_width=None, constant_value=0):
     """MXNet pad: pad_width is a flat tuple (before0, after0, before1, ...)."""
+    if _symbolic(x):
+        return _sym_call("Pad", data=x, mode=mode,
+                         pad_width=tuple(pad_width),
+                         constant_value=constant_value)
+
     def f(a):
         pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(a.ndim)]
         jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]

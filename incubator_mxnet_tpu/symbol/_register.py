@@ -101,6 +101,20 @@ def _add_n_fn(rt, a, *xs):
 
 register_op("add_n", _add_n_fn, ())
 
+
+def _pad_fn(rt, a, x):
+    pw = tuple(a["pad_width"])
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+    mode = a.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant",
+                       constant_values=a.get("constant_value", 0))
+    return jnp.pad(x, pairs, mode={"edge": "edge",
+                                   "reflect": "reflect"}[mode])
+
+
+register_op("Pad", _pad_fn, ("data",))
+
 def _arange_fn(rt, a):
     start, stop = a["start"], a.get("stop")
     if stop is None:                      # mx.arange(N) == [0, N)
@@ -680,6 +694,15 @@ def add_n(*args, name=None):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return _make_op("add_n", list(args), {}, name)
+
+
+def Pad(data=None, mode="constant", pad_width=None, constant_value=0,
+        name=None):
+    """Parity: mx.sym.Pad (src/operator/pad.cc); pad_width is the flat
+    (before0, after0, before1, ...) tuple."""
+    return _make_op("Pad", [data],
+                    _attrs(mode=mode, pad_width=tuple(pad_width),
+                           constant_value=constant_value), name)
 
 
 # Export the builders onto the `symbol` module namespace.

@@ -186,6 +186,47 @@ class TestRoundTrip:
         y2 = _forward(sym2, {"data": x}, {})
         np.testing.assert_allclose(y1, y2, atol=1e-6)
 
+    def test_pad_roundtrip(self):
+        sym = mx.sym
+        data = sym.Variable("data")
+        net = sym.Pad(data, mode="constant",
+                      pad_width=(0, 0, 0, 0, 1, 2, 3, 0),
+                      constant_value=1.5, name="pd")
+        net = sym.Pad(net, mode="edge",
+                      pad_width=(0, 0, 0, 0, 1, 1, 1, 1), name="pe")
+        _roundtrip(net, (2, 3, 4, 5))
+
+    def test_asymmetric_conv_pads_import(self):
+        # a TF/Keras-style ONNX Conv with pads=[1,1,2,2] (begin != end)
+        # must import via an inserted Pad node, numerically exact
+        sym = mx.sym
+        data = sym.Variable("data")
+        net = sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                              pad=(1, 1), name="c")
+        params = _params_for(net, {"data": (1, 3, 8, 8)})
+        buf = onnx_mxnet.export_model(net, params, [(1, 3, 8, 8)])
+        m = P.ModelProto()
+        m.ParseFromString(buf)
+        conv = next(n for n in m.graph.node if n.op_type == "Conv")
+        for att in conv.attribute:
+            if att.name == "pads":
+                del att.ints[:]
+                att.ints.extend([1, 1, 2, 2])  # asymmetric
+        sym2, arg2, aux2 = onnx_mxnet.import_model(m.SerializeToString())
+        x = mx.nd.array(np.random.RandomState(0).uniform(
+            -1, 1, (1, 3, 8, 8)).astype("float32"))
+        y2 = _forward(sym2, {"data": x}, arg2)
+        # ground truth: jax conv with the exact asymmetric padding
+        import jax
+        w = params["c_weight"].asnumpy()
+        b = params["c_bias"].asnumpy()
+        ref = jax.lax.conv_general_dilated(
+            x.asnumpy(), w, (1, 1), [(1, 2), (1, 2)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ref = ref + b.reshape(1, -1, 1, 1)
+        np.testing.assert_allclose(y2, np.asarray(ref), atol=1e-5,
+                                   rtol=1e-4)
+
     def test_reductions_and_unary(self):
         sym = mx.sym
         data = sym.Variable("data")
