@@ -103,11 +103,12 @@ def _vi(name, shape, elem_type=P.TensorProto.FLOAT):
 # ---------------------------------------------------------------------------
 
 class _Exporter:
-    def __init__(self, graph_json, params, opset):
+    def __init__(self, graph_json, params, opset, np_dtype=np.float32):
         self.nodes = graph_json["nodes"]
         self.heads = graph_json["heads"]
         self.params = params
         self.opset = opset
+        self.np_dtype = np.dtype(np_dtype)  # the graph's tensor dtype
         self.g = P.GraphProto()
         self.names = {}          # (node_idx, out_idx) -> tensor name
         self.emitted_inits = set()
@@ -555,7 +556,7 @@ def _exp_unary(ex, idx, node):
     ex.names[(idx, 0)] = node["name"]
 
 
-@_export("Pad")
+@_export("Pad", "pad")
 def _exp_pad(ex, idx, node):
     a = node["attrs"]
     pw = tuple(a["pad_width"])
@@ -567,8 +568,9 @@ def _exp_pad(ex, idx, node):
             "reflect": "reflect"}[a.get("mode", "constant")]
     ins = ex.resolve(node) + [
         ex.add_init(node["name"] + "_pads", np.asarray(pads, np.int64)),
+        # constant_value must share the data tensor's type T (ONNX spec)
         ex.add_init(node["name"] + "_cval",
-                    np.asarray(a.get("constant_value", 0), np.float32))]
+                    np.asarray(a.get("constant_value", 0), ex.np_dtype))]
     ex.add_node("Pad", ins, [node["name"]], node["name"], mode=mode)
     ex.names[(idx, 0)] = node["name"]
 
@@ -626,13 +628,15 @@ def export_model(sym, params, input_shape, input_type="float32",
            for n in graph_json["nodes"]):
         raise NotImplementedError("control-flow subgraphs cannot be "
                                   "exported to ONNX")
-    ex = _Exporter(graph_json, params, opset)
+    in_np = np.dtype(input_type)
+    ex = _Exporter(graph_json, params, opset,
+                   np_dtype=in_np if in_np in _NP2ONNX else np.float32)
     g = ex.run()
     g.name = model_name
 
     if isinstance(input_shape, tuple):
         input_shape = [input_shape]
-    elem = _NP2ONNX.get(np.dtype(input_type), P.TensorProto.FLOAT)
+    elem = _NP2ONNX.get(in_np, P.TensorProto.FLOAT)
     data_inputs = ex.used_inputs
     if len(input_shape) < len(data_inputs):
         raise ValueError("model has %d data inputs %r but input_shape has %d"

@@ -968,6 +968,42 @@ def slice(x, begin, end, step=None):
 from builtins import slice as builtins_slice  # noqa: E402
 
 
+def crop(x, begin=None, end=None, step=None, **kwargs):
+    """Legacy alias of nd.slice (parity: mx.nd.crop / src/operator/crop.cc
+    deprecation path)."""
+    if kwargs:
+        raise TypeError("crop: unsupported kwargs %s (the center_crop/"
+                        "offset form is not implemented; use nd.slice or "
+                        "image.CenterCropAug)" % sorted(kwargs))
+    return slice(x, begin, end, step)
+
+
+def moments(x, axes=None, keepdims=False):
+    """Mean and variance in one pass (parity: mx.nd.moments /
+    src/operator/nn/moments.cc). Returns (mean, var)."""
+    if _symbolic(x):
+        return _sym_call("moments", data=x, axes=axes, keepdims=keepdims)
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+
+    def f(a):
+        m = jnp.mean(a, axis=ax, keepdims=True)
+        v = jnp.mean((a - m) ** 2, axis=ax, keepdims=keepdims)
+        if keepdims:
+            return m, v
+        # reuse the computed mean instead of reducing twice
+        sq = tuple(range(a.ndim)) if ax is None else \
+            (ax if isinstance(ax, tuple) else (ax,))
+        return jnp.squeeze(m, axis=sq), v
+    return _apply(f, [x], n_out=2, name="moments")
+
+
+def softmin(x, axis=-1):
+    """Parity: mx.nd.softmin — softmax of the negated input."""
+    if _symbolic(x):
+        return _sym_call("softmin", data=x, axis=axis)
+    return _unary(lambda a: jax.nn.softmax(-a, axis=axis), x, "softmin")
+
+
 def slice_like(x, shape_like, axes=None):
     def f(a, b):
         idx = []

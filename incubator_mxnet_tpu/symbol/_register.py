@@ -102,18 +102,8 @@ def _add_n_fn(rt, a, *xs):
 register_op("add_n", _add_n_fn, ())
 
 
-def _pad_fn(rt, a, x):
-    pw = tuple(a["pad_width"])
-    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
-    mode = a.get("mode", "constant")
-    if mode == "constant":
-        return jnp.pad(x, pairs, mode="constant",
-                       constant_values=a.get("constant_value", 0))
-    return jnp.pad(x, pairs, mode={"edge": "edge",
-                                   "reflect": "reflect"}[mode])
-
-
-register_op("Pad", _pad_fn, ("data",))
+# "Pad" (capitalized classic name) registers in the nd-mirror section at
+# the bottom of this file so it shares nd.pad's single implementation.
 
 def _arange_fn(rt, a):
     start, stop = a["start"], a.get("stop")
@@ -1175,6 +1165,29 @@ for _n in ["take", "pick", "gather_nd", "batch_take"]:
 _reg_nd_mirror("where", ("condition", "x", "y"))
 _reg_nd_mirror("topk", ("data",),
                n_out=lambda a: 2 if a.get("ret_typ") == "both" else 1)
+
+for _n in ["broadcast_to", "cumsum", "nanprod", "radians", "degrees",
+           "unravel_index", "ravel_multi_index", "softmin"]:
+    _reg_nd_mirror(_n, ("data",))
+_reg_nd_mirror("moments", ("data",), n_out=2)
+for _n in ["maximum", "minimum", "mod"]:
+    _reg_nd_mirror(_n, ("lhs", "rhs"))
+_reg_nd_mirror("slice_like", ("data", "shape_like"))
+_reg_nd_mirror("broadcast_like", ("data", "other"))
+_reg_nd_mirror("scatter_nd", ("data", "indices"))
+# generator ops: no tensor inputs, everything rides in attrs
+_reg_nd_mirror("linspace", ())
+_reg_nd_mirror("full", ())
+_reg_nd_mirror("crop", ("data",))
+
+
+def _pad_runtime(rt, a, x):
+    # same single implementation as graph op "pad" (nd.pad) — the classic
+    # capitalized name must not drift from the nd mirror
+    return _nd_mod.pad(_NDW(x), **a)._data
+
+
+register_op("Pad", _pad_runtime, ("data",))
 
 
 # ---------------------------------------------------------------------------

@@ -276,3 +276,71 @@ def test_parity_sweep_round3_ops():
 
     with mx.engine.bulk(30):
         np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+
+
+# ---------------------------------------------------------------------------
+# round-4 op-surface completions: moments/softmin/crop + symbol mirror
+# long-tail (reference: mx.nd.moments src/operator/nn/moments.cc, softmin,
+# legacy crop, and the nd-mirror rule "every nd op has a sym mirror")
+# ---------------------------------------------------------------------------
+
+def test_moments_matches_numpy():
+    x = nd.array(np.random.RandomState(0).randn(3, 4, 5).astype(np.float32))
+    m, v = nd.moments(x, axes=(1, 2))
+    np.testing.assert_allclose(m.asnumpy(), x.asnumpy().mean((1, 2)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.asnumpy().var((1, 2)),
+                               rtol=1e-4, atol=1e-6)
+    mk, vk = nd.moments(x, axes=1, keepdims=True)
+    assert mk.shape == (3, 1, 5) and vk.shape == (3, 1, 5)
+
+
+def test_softmin_is_softmax_of_negation():
+    x = nd.array(np.random.RandomState(1).randn(2, 6).astype(np.float32))
+    np.testing.assert_allclose(nd.softmin(x, axis=1).asnumpy(),
+                               nd.softmax(-x, axis=1).asnumpy(), rtol=1e-6)
+
+
+def test_crop_aliases_slice():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(4, 6))
+    np.testing.assert_array_equal(
+        nd.crop(x, begin=(1, 2), end=(3, 5)).asnumpy(),
+        x.asnumpy()[1:3, 2:5])
+
+
+def test_symbol_mirror_long_tail():
+    import incubator_mxnet_tpu.symbol as S
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    d, e = S.Variable("d"), S.Variable("e")
+    cases = [
+        (S.broadcast_to(d, shape=(3, 4)),
+         {"d": nd.array(np.ones((1, 4), np.float32))},
+         np.ones((3, 4), np.float32)),
+        (S.cumsum(d, axis=1), {"d": x}, np.cumsum(x.asnumpy(), axis=1)),
+        (S.maximum(d, e),
+         {"d": x, "e": nd.array(np.full((3, 4), 5.0, np.float32))},
+         np.maximum(x.asnumpy(), 5.0)),
+        (S.mod(d, e),
+         {"d": x, "e": nd.array(np.full((3, 4), 3.0, np.float32))},
+         np.mod(x.asnumpy(), 3.0)),
+        (S.slice_like(d, e),
+         {"d": x, "e": nd.array(np.ones((2, 2), np.float32))},
+         x.asnumpy()[:2, :2]),
+        (S.linspace(start=0.0, stop=1.0, num=5), {},
+         np.linspace(0, 1, 5, dtype=np.float32)),
+        (S.full(shape=(2, 3), val=7.0), {},
+         np.full((2, 3), 7.0, np.float32)),
+        (S.softmin(d, axis=1), {"d": x},
+         np.exp(-x.asnumpy()) / np.exp(-x.asnumpy()).sum(1, keepdims=True)),
+        (S.crop(d, begin=(0, 1), end=(2, 3)), {"d": x},
+         x.asnumpy()[0:2, 1:3]),
+    ]
+    for sym, args, expect in cases:
+        out = sym.bind(args=args).forward(is_train=False)[0].asnumpy()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+    # moments mirror: two outputs
+    ms = S.moments(d, axes=1)
+    ex = ms.bind(args={"d": x})
+    mo, vo = [o.asnumpy() for o in ex.forward(is_train=False)]
+    np.testing.assert_allclose(mo, x.asnumpy().mean(1), rtol=1e-5)
+    np.testing.assert_allclose(vo, x.asnumpy().var(1), rtol=1e-5)
