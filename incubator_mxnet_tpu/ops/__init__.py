@@ -24,7 +24,8 @@ __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
            "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
            "UpSampling", "multihead_attention", "box_iou", "box_nms",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
-           "ROIPooling", "im2col", "SliceChannel",
+           "ROIPooling", "ROIAlign", "BilinearResize2D",
+           "AdaptiveAvgPooling2D", "im2col", "SliceChannel",
            "SequenceMask", "SequenceLast", "SequenceReverse",
            "GridGenerator", "BilinearSampler", "SpatialTransformer",
            "Correlation", "foreach", "while_loop", "cond"]
@@ -210,9 +211,54 @@ def UpSampling(data, scale=2, sample_type="nearest", num_filter=None,
 def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
     """ROI max pooling (reference: mx.nd.ROIPooling). data NCHW; rois (R,5)
     rows [batch_idx, x0, y0, x1, y1] image coords."""
+    if _symbolic(data):
+        return _sym_call("ROIPooling", data=data, rois=rois,
+                         pooled_size=pooled_size,
+                         spatial_scale=spatial_scale)
     return _apply(lambda x, r: _raw.roi_pooling(x, r, pooled_size,
                                                 spatial_scale),
                   [data, _as_nd(rois)], name="ROIPooling")
+
+
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=-1):
+    """ROIAlign (reference: mx.nd.contrib.ROIAlign,
+    src/operator/contrib/roi_align.cc). data NCHW; rois (R,5)
+    [batch_idx, x0, y0, x1, y1] image coords."""
+    if _symbolic(data):
+        return _sym_call("ROIAlign", data=data, rois=rois,
+                         pooled_size=pooled_size,
+                         spatial_scale=spatial_scale,
+                         sample_ratio=sample_ratio)
+    return _apply(lambda x, r: _raw.roi_align(x, r, pooled_size,
+                                              spatial_scale, sample_ratio),
+                  [data, _as_nd(rois)], name="ROIAlign")
+
+
+def BilinearResize2D(data, height=None, width=None):
+    """Bilinear resize, align-corners (reference:
+    mx.nd.contrib.BilinearResize2D, src/operator/contrib/
+    bilinear_resize.cc). Two MXU matrix contractions, no gathers."""
+    if not (isinstance(height, int) and isinstance(width, int)
+            and height > 0 and width > 0):
+        raise ValueError("BilinearResize2D requires explicit positive "
+                         "integer height= and width= (got height=%r, "
+                         "width=%r)" % (height, width))
+    if _symbolic(data):
+        return _sym_call("BilinearResize2D", data=data, height=height,
+                         width=width)
+    return _apply(lambda x: _raw.bilinear_resize(x, height, width),
+                  [data], name="BilinearResize2D")
+
+
+def AdaptiveAvgPooling2D(data, output_size=1):
+    """Adaptive average pooling (reference:
+    mx.nd.contrib.AdaptiveAvgPooling2D)."""
+    if _symbolic(data):
+        return _sym_call("AdaptiveAvgPooling2D", data=data,
+                         output_size=output_size)
+    return _apply(lambda x: _raw.adaptive_avg_pool(x, output_size),
+                  [data], name="AdaptiveAvgPooling2D")
 
 
 def im2col(data, kernel, stride=None, dilate=None, pad=None):
@@ -580,7 +626,8 @@ def _mirror_into_nd():
     for name in ["box_iou", "box_nms", "MultiBoxPrior", "MultiBoxTarget",
                  "MultiBoxDetection", "multihead_attention",
                  "foreach", "while_loop", "cond",
-                 "arange_like", "fft", "ifft"]:
+                 "arange_like", "fft", "ifft",
+                 "ROIAlign", "BilinearResize2D", "AdaptiveAvgPooling2D"]:
         setattr(contrib, name, globals()[name])
 
     def _contrib_getattr(name):

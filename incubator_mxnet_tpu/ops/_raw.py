@@ -660,3 +660,102 @@ def im2col(x, kernel, stride=None, dilate=None, pad=None):
     patches = xp[:, :, rows][:, :, :, :, cols]
     patches = patches.transpose(0, 1, 3, 5, 2, 4)            # N,C,kh,kw,oh,ow
     return patches.reshape(n, c * kh * kw, out_h * out_w)
+
+
+# ---------------------------------------------------------------------------
+# contrib vision ops (reference src/operator/contrib/: roi_align.cc,
+# bilinear_resize.cc, adaptive_avg_pooling.cc)
+# ---------------------------------------------------------------------------
+
+def _interp_matrix(out_len, in_len):
+    """(out_len, in_len) bilinear row-sampling matrix, align-corners
+    semantics (the reference BilinearResize2D kernel). Interpolation as a
+    dense matmul keeps the op on the MXU instead of gather units."""
+    if in_len == 1:
+        return jnp.ones((out_len, 1), jnp.float32)
+    pos = jnp.linspace(0.0, in_len - 1.0, out_len)
+    i0 = jnp.floor(pos).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, in_len - 1)
+    f = (pos - i0).astype(jnp.float32)
+    rows = jnp.arange(out_len)
+    a = jnp.zeros((out_len, in_len), jnp.float32)
+    return a.at[rows, i0].add(1.0 - f).at[rows, i1].add(f)
+
+
+def bilinear_resize(x, height, width):
+    """BilinearResize2D, NCHW (reference contrib op). out = A_h @ x @ A_w.T
+    per channel — two MXU contractions, no dynamic gathers."""
+    a_h = _interp_matrix(height, x.shape[2]).astype(x.dtype)
+    a_w = _interp_matrix(width, x.shape[3]).astype(x.dtype)
+    return jnp.einsum("ij,ncjk,lk->ncil", a_h, x, a_w)
+
+
+def adaptive_avg_pool(x, output_size):
+    """AdaptiveAvgPooling2D, NCHW (reference contrib op). Torch-style
+    bins: cell i averages rows [floor(i*H/oh), ceil((i+1)*H/oh)). The
+    (static) bin structure becomes averaging matrices -> MXU einsum."""
+    import numpy as _np
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def avg_matrix(out_len, in_len):
+        m = _np.zeros((out_len, in_len), _np.float32)
+        for i in range(out_len):
+            s = (i * in_len) // out_len
+            e = -(-((i + 1) * in_len) // out_len)  # ceil div
+            m[i, s:e] = 1.0 / (e - s)
+        return jnp.asarray(m)
+
+    a_h = avg_matrix(oh, x.shape[2]).astype(x.dtype)
+    a_w = avg_matrix(ow, x.shape[3]).astype(x.dtype)
+    return jnp.einsum("ij,ncjk,lk->ncil", a_h, x, a_w)
+
+
+def roi_align(x, rois, pooled_size, spatial_scale, sample_ratio=-1):
+    """ROIAlign, NCHW (reference src/operator/contrib/roi_align.cc —
+    the Mask R-CNN op: no coordinate rounding, bilinear sample points
+    averaged per cell). x (N,C,H,W); rois (R,5) [batch_idx, x0, y0,
+    x1, y1] image coords. sample_ratio<=0 uses 2 samples per bin axis
+    (static shapes; the reference's adaptive ceil(bin) is data-dependent
+    and would defeat jit)."""
+    n, c, h, w = x.shape
+    ph, pw = pooled_size
+    s = sample_ratio if sample_ratio and sample_ratio > 0 else 2
+
+    ky = (jnp.arange(ph)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s)  # (ph,s)
+    kx = (jnp.arange(pw)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s)  # (pw,s)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0, y0, x1, y1 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        rw = jnp.maximum(x1 - x0, 1.0)
+        ys = (y0 + ky * (rh / ph)).reshape(-1)                # (ph*s,)
+        xs = (x0 + kx * (rw / pw)).reshape(-1)                # (pw*s,)
+        # reference border rule (roi_align.cc): samples beyond one pixel
+        # outside the image contribute ZERO; the [-1, H] band clamps to
+        # the edge for the bilinear corners
+        vy = ((ys >= -1.0) & (ys <= h)).astype(x.dtype)
+        vx = ((xs >= -1.0) & (xs <= w)).astype(x.dtype)
+        ys = jnp.clip(ys, 0.0, h - 1.0)
+        xs = jnp.clip(xs, 0.0, w - 1.0)
+        yi0 = jnp.floor(ys).astype(jnp.int32)
+        xi0 = jnp.floor(xs).astype(jnp.int32)
+        yi1 = jnp.minimum(yi0 + 1, h - 1)
+        xi1 = jnp.minimum(xi0 + 1, w - 1)
+        fy = (ys - yi0).astype(x.dtype)
+        fx = (xs - xi0).astype(x.dtype)
+        img = x[b]                                            # (C,H,W)
+        # separable bilinear: gather rows then columns
+        gy0 = jnp.take(img, yi0, axis=1)                      # (C,PY,W)
+        gy1 = jnp.take(img, yi1, axis=1)
+        gy = gy0 * (1 - fy)[None, :, None] + gy1 * fy[None, :, None]
+        g00 = jnp.take(gy, xi0, axis=2)                       # (C,PY,PX)
+        g01 = jnp.take(gy, xi1, axis=2)
+        vals = g00 * (1 - fx)[None, None, :] + g01 * fx[None, None, :]
+        vals = vals * (vy[None, :, None] * vx[None, None, :])
+        vals = vals.reshape(c, ph, s, pw, s)
+        return vals.mean(axis=(2, 4))                         # (C,ph,pw)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))

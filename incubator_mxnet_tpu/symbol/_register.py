@@ -1077,6 +1077,59 @@ def _contrib_box_iou(lhs=None, rhs=None, format="corner", name=None):  # noqa: A
                     _attrs(format=format), name)
 
 
+# contrib vision ops (reference src/operator/contrib/roi_align.cc,
+# bilinear_resize.cc, adaptive_avg_pooling.cc)
+register_op(
+    "ROIAlign",
+    lambda rt, a, x, r: _raw.roi_align(x, r, tuple(a["pooled_size"]),
+                                       a.get("spatial_scale", 1.0),
+                                       a.get("sample_ratio", -1)),
+    ("data", "rois"))
+register_op(
+    "BilinearResize2D",
+    lambda rt, a, x: _raw.bilinear_resize(x, a["height"], a["width"]),
+    ("data",))
+register_op(
+    "AdaptiveAvgPooling2D",
+    lambda rt, a, x: _raw.adaptive_avg_pool(x, a.get("output_size", 1)),
+    ("data",))
+register_op(
+    "ROIPooling",
+    lambda rt, a, x, r: _raw.roi_pooling(x, r, tuple(a["pooled_size"]),
+                                         a.get("spatial_scale", 1.0)),
+    ("data", "rois"))
+
+
+def ROIAlign(data=None, rois=None, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=-1, name=None):
+    return _make_op("ROIAlign", [data, rois],
+                    _attrs(pooled_size=tuple(pooled_size),
+                           spatial_scale=spatial_scale,
+                           sample_ratio=sample_ratio), name)
+
+
+def BilinearResize2D(data=None, height=None, width=None, name=None):
+    return _make_op("BilinearResize2D", [data],
+                    _attrs(height=height, width=width), name)
+
+
+def AdaptiveAvgPooling2D(data=None, output_size=1, name=None):
+    return _make_op("AdaptiveAvgPooling2D", [data],
+                    _attrs(output_size=output_size), name)
+
+
+def ROIPooling(data=None, rois=None, pooled_size=(7, 7), spatial_scale=1.0,
+               name=None):
+    return _make_op("ROIPooling", [data, rois],
+                    _attrs(pooled_size=tuple(pooled_size),
+                           spatial_scale=spatial_scale), name)
+
+
+for _n in ("ROIAlign", "BilinearResize2D", "AdaptiveAvgPooling2D",
+           "ROIPooling"):
+    setattr(_sym_mod, _n, globals()[_n])
+
+
 def _install_sym_contrib():
     import sys
     import types
@@ -1086,6 +1139,9 @@ def _install_sym_contrib():
     contrib.MultiBoxDetection = _contrib_MultiBoxDetection
     contrib.box_nms = _contrib_box_nms
     contrib.box_iou = _contrib_box_iou
+    contrib.ROIAlign = ROIAlign
+    contrib.BilinearResize2D = BilinearResize2D
+    contrib.AdaptiveAvgPooling2D = AdaptiveAvgPooling2D
     _sym_mod.contrib = contrib
     sys.modules["incubator_mxnet_tpu.symbol.contrib"] = contrib
 

@@ -3,6 +3,7 @@ batch_take / reverse (parity: src/operator/sequence_*.cc,
 grid_generator.cc, bilinear_sampler.cc, spatial_transformer.cc,
 correlation.cc, tensor/indexing_op.cc)."""
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd
@@ -344,3 +345,106 @@ def test_symbol_mirror_long_tail():
     mo, vo = [o.asnumpy() for o in ex.forward(is_train=False)]
     np.testing.assert_allclose(mo, x.asnumpy().mean(1), rtol=1e-5)
     np.testing.assert_allclose(vo, x.asnumpy().var(1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# contrib vision ops (reference src/operator/contrib/: roi_align.cc,
+# bilinear_resize.cc, adaptive_avg_pooling.cc)
+# ---------------------------------------------------------------------------
+
+def test_bilinear_resize_2d():
+    # exact on a linear ramp (bilinear reproduces linear functions)
+    h, w = 4, 6
+    ramp = (np.arange(h)[:, None] * 2.0
+            + np.arange(w)[None, :]).astype(np.float32)
+    x = nd.array(ramp[None, None])
+    out = nd.contrib.BilinearResize2D(x, height=7, width=11).asnumpy()[0, 0]
+    yy = np.linspace(0, h - 1, 7)
+    xx = np.linspace(0, w - 1, 11)
+    expect = yy[:, None] * 2.0 + xx[None, :]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    # identity when the size is unchanged
+    same = nd.contrib.BilinearResize2D(x, height=h, width=w).asnumpy()[0, 0]
+    np.testing.assert_allclose(same, ramp, atol=1e-6)
+
+
+def test_adaptive_avg_pooling_2d():
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(2, 3, 7, 5).astype(np.float32)
+    x = nd.array(x_np)
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2)).asnumpy()
+    assert out.shape == (2, 3, 2, 2)
+    # torch-style bins: rows [0,4) and [3,7), cols [0,3) and [2,5)
+    for i, (rs, re) in enumerate([(0, 4), (3, 7)]):
+        for j, (cs, ce) in enumerate([(0, 3), (2, 5)]):
+            np.testing.assert_allclose(
+                out[:, :, i, j], x_np[:, :, rs:re, cs:ce].mean((2, 3)),
+                rtol=1e-5)
+    # output_size=1 == global average pooling
+    g = nd.contrib.AdaptiveAvgPooling2D(x, output_size=1).asnumpy()
+    np.testing.assert_allclose(g[:, :, 0, 0], x_np.mean((2, 3)), rtol=1e-5)
+
+
+def test_roi_align_constant_and_ramp():
+    # constant image: every pooled cell must be that constant, regardless
+    # of sub-pixel sampling
+    x = nd.array(np.full((1, 2, 8, 8), 3.5, np.float32))
+    rois = nd.array(np.array([[0, 1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = nd.contrib.ROIAlign(x, rois, pooled_size=(3, 3),
+                              spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(out, 3.5, rtol=1e-6)
+    # ramp image: bilinear sampling reproduces linear functions exactly,
+    # so each cell equals the ramp at the cell's center
+    ramp = (np.arange(8)[:, None] + 0.0 * np.arange(8)[None, :]
+            ).astype(np.float32)
+    xr = nd.array(ramp[None, None])
+    roi = np.array([[0, 0.0, 2.0, 8.0, 6.0]], np.float32)  # y in [2,6)
+    o = nd.contrib.ROIAlign(xr, nd.array(roi), pooled_size=(2, 2),
+                            spatial_scale=1.0).asnumpy()[0, 0]
+    # bin height 2: centers at y = 2+1 and 2+3 -> values 3 and 5
+    np.testing.assert_allclose(o[:, 0], [3.0, 5.0], rtol=1e-5)
+    np.testing.assert_allclose(o[:, 1], [3.0, 5.0], rtol=1e-5)
+
+
+def test_contrib_vision_symbol_mirrors():
+    import incubator_mxnet_tpu.symbol as S
+    x = nd.array(np.random.RandomState(0).rand(1, 2, 6, 6)
+                 .astype(np.float32))
+    rois = nd.array(np.array([[0, 0.0, 0.0, 5.0, 5.0]], np.float32))
+    d, r = S.Variable("d"), S.Variable("r")
+    s1 = S.contrib.BilinearResize2D(d, height=3, width=3)
+    np.testing.assert_allclose(
+        s1.bind(args={"d": x}).forward()[0].asnumpy(),
+        nd.contrib.BilinearResize2D(x, height=3, width=3).asnumpy(),
+        rtol=1e-6)
+    s2 = S.contrib.AdaptiveAvgPooling2D(d, output_size=2)
+    np.testing.assert_allclose(
+        s2.bind(args={"d": x}).forward()[0].asnumpy(),
+        nd.contrib.AdaptiveAvgPooling2D(x, output_size=2).asnumpy(),
+        rtol=1e-6)
+    s3 = S.contrib.ROIAlign(d, r, pooled_size=(2, 2))
+    np.testing.assert_allclose(
+        s3.bind(args={"d": x, "r": rois}).forward()[0].asnumpy(),
+        nd.contrib.ROIAlign(x, rois, pooled_size=(2, 2)).asnumpy(),
+        rtol=1e-6)
+
+
+def test_roi_align_border_zeroing():
+    # samples more than one pixel outside the image contribute zero
+    # (reference roi_align.cc border rule), not edge-replicated values
+    x = nd.array(np.full((1, 1, 4, 4), 2.0, np.float32))
+    far_out = nd.array(np.array([[0, -20.0, -20.0, -12.0, -12.0]],
+                                np.float32))
+    o = nd.contrib.ROIAlign(x, far_out, pooled_size=(2, 2)).asnumpy()
+    np.testing.assert_allclose(o, 0.0, atol=1e-7)
+    # interior ROI on the same constant image stays the constant
+    inside = nd.array(np.array([[0, 0.5, 0.5, 3.5, 3.5]], np.float32))
+    o2 = nd.contrib.ROIAlign(x, inside, pooled_size=(2, 2)).asnumpy()
+    np.testing.assert_allclose(o2, 2.0, rtol=1e-6)
+
+
+def test_bilinear_resize_requires_sizes():
+    x = nd.array(np.ones((1, 1, 4, 4), np.float32))
+    with pytest.raises(ValueError, match="height"):
+        nd.contrib.BilinearResize2D(x)
