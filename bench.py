@@ -430,6 +430,22 @@ def main():
     acquire_backend(attempts=_init_attempts,
                     per_attempt_timeout=_init_per)
     init_watchdog.cancel()
+    # Front-load the one-time pallas on-device self-test (tiny compiles)
+    # under its own deadline, so a Mosaic failure surfaces HERE as a logged
+    # fallback to the XLA path — not mid-way through the big model compile.
+    from incubator_mxnet_tpu.ops import pallas as _pallas
+    _pallas.register_selftest_passthrough(_PhaseTimeout)
+    try:
+        with _phase_deadline(int(os.environ.get("BENCH_PALLAS_TIMEOUT",
+                                                "600")),
+                             "pallas self-test"):
+            _log(f"pallas kernels enabled={_pallas.enabled()} "
+                 f"(on-device self-test verdict={_pallas._KERNELS_OK})")
+    except _PhaseTimeout as e:
+        # treat a hung self-test as a failed one: XLA path from here on
+        _pallas._KERNELS_OK = False
+        os.environ["MXTPU_NO_PALLAS"] = "1"
+        _log(f"pallas self-test timed out ({e}); using the XLA path")
     np.random.seed(0)
     mx.random.seed(0)
 

@@ -2,3 +2,4 @@
 reference ships outside the core layer set."""
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
+from . import estimator  # noqa: F401

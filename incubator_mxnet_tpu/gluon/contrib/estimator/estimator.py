@@ -1,0 +1,211 @@
+"""Gluon Estimator: high-level fit/evaluate loop over Block + Loss +
+Trainer (reference: python/mxnet/gluon/contrib/estimator/estimator.py).
+
+TPU-first notes: the inner batch step is the standard gluon tape step
+(record → backward → trainer.step), so a hybridized net runs as one XLA
+computation per forward/backward; data is split across the context list
+with ``split_and_load`` (single-chip by default). The event-handler
+protocol (and handler set) mirrors the reference so training scripts
+port unchanged.
+"""
+import logging
+
+from ... import utils as gluon_utils
+from .... import autograd
+from .... import context as context_mod
+from .... import metric as metric_mod
+from ....gluon import loss as gluon_loss
+from ....gluon.trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            GradientUpdateHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Estimator:
+    """Drives the train loop: ``fit`` iterates (data, label) batches from a
+    DataLoader, runs forward/loss under ``autograd.record``, backward, and
+    dispatches the event-handler protocol.
+
+    Parameters mirror the reference: net (Block), loss (gluon loss),
+    train_metrics/val_metrics (EvalMetric or list), trainer (created with
+    sgd lr=1e-3 if omitted), context (Context or list)."""
+
+    logger = logging.getLogger("incubator_mxnet_tpu.estimator")
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, batch_axis=0):
+        self.net = net
+        self.loss = loss
+        if not isinstance(loss, gluon_loss.Loss):
+            raise ValueError(f"loss must be a gluon Loss, got {type(loss)}")
+        self.train_metrics = _as_list(train_metrics)
+        self.val_metrics = _as_list(val_metrics)
+        for m in self.train_metrics + self.val_metrics:
+            if not isinstance(m, metric_mod.EvalMetric):
+                raise ValueError(f"metrics must be EvalMetric, got {type(m)}")
+        # loss metrics ride along with their own Loss-typed entries
+        self.train_loss_metric = metric_mod.Loss(
+            f"train {type(loss).__name__.lower()}")
+        self.val_loss_metric = metric_mod.Loss(
+            f"validation {type(loss).__name__.lower()}")
+        self.train_metrics.append(self.train_loss_metric)
+        self.val_metrics.append(self.val_loss_metric)
+        self.context = _as_list(context) or [context_mod.current_context()]
+        self.trainer = trainer if trainer is not None else Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 1e-3})
+        self.batch_axis = batch_axis
+        self.stop_training = False
+        self.max_epoch = None
+        self.max_batch = None
+
+    # -- data plumbing ----------------------------------------------------
+    def _get_data_and_label(self, batch):
+        data, label = batch[0], batch[1]
+        data = gluon_utils.split_and_load(data, self.context,
+                                          batch_axis=self.batch_axis)
+        label = gluon_utils.split_and_load(label, self.context,
+                                           batch_axis=self.batch_axis)
+        return data, label
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate_batch(self, batch):
+        data, label = self._get_data_and_label(batch)
+        pred = [self.net(x) for x in data]
+        loss = [self.loss(p, y) for p, y in zip(pred, label)]
+        return data, label, pred, loss
+
+    def evaluate(self, val_data, event_handlers=None):
+        """Run the val loop, updating ``self.val_metrics``. Optional
+        ``event_handlers`` observe the val pass: epoch_begin before it,
+        batch_end per batch (with batch/pred/label/loss), epoch_end
+        after."""
+        _, epoch_begin, batch_begin, batch_end, epoch_end, _ = \
+            self._categorize(_as_list(event_handlers))
+        for m in self.val_metrics:
+            m.reset()
+        for h in epoch_begin:
+            h.epoch_begin(self)
+        for batch in val_data:
+            for h in batch_begin:
+                h.batch_begin(self, batch=batch)
+            _, label, pred, loss = self.evaluate_batch(batch)
+            for m in self.val_metrics:
+                if isinstance(m, metric_mod.Loss):
+                    m.update(0, loss)
+                else:
+                    m.update(label, pred)
+            for h in batch_end:
+                h.batch_end(self, batch=batch, pred=pred, label=label,
+                            loss=loss)
+        for h in epoch_end:
+            h.epoch_end(self)
+        return {n: v for n, v in
+                (m.get_name_value()[0] for m in self.val_metrics)}
+
+    # -- training ---------------------------------------------------------
+    def fit_batch(self, batch):
+        data, label = self._get_data_and_label(batch)
+        with autograd.record():
+            pred = [self.net(x) for x in data]
+            loss = [self.loss(p, y) for p, y in zip(pred, label)]
+        for l in loss:
+            l.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        """Train for ``epochs`` epochs (or ``batches`` total batches —
+        exactly one of the two)."""
+        if (epochs is None) == (batches is None):
+            raise ValueError("pass exactly one of epochs / batches")
+        limit = epochs if epochs is not None else batches
+        if limit < 0:
+            raise ValueError(f"epochs/batches must be >= 0, got {limit}")
+        if limit == 0:
+            return  # zero training requested: touch nothing
+        self.max_epoch = epochs
+        self.max_batch = batches
+        self.stop_training = False
+
+        handlers = self._prepare_handlers(val_data, event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        for h in train_begin:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            n_batches = 0
+            for batch in train_data:
+                n_batches += 1
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                _, label, pred, loss = self.fit_batch(batch)
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred, label=label,
+                                loss=loss)
+                if self.stop_training:
+                    break
+            else:
+                if n_batches == 0:
+                    raise ValueError(
+                        "train_data yielded no batches — with batches=N "
+                        "this would loop forever")
+                for h in epoch_end:
+                    h.epoch_end(self)
+                continue
+            # batch-level stop: still fire epoch_end so epoch-scoped
+            # handlers (checkpoint, logging) observe the partial epoch
+            for h in epoch_end:
+                h.epoch_end(self)
+        for h in train_end:
+            h.train_end(self)
+
+    # -- handler plumbing -------------------------------------------------
+    def _prepare_handlers(self, val_data, event_handlers):
+        handlers = _as_list(event_handlers)
+        added = []
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            h = StoppingHandler(self.max_epoch, self.max_batch)
+            handlers.append(h)
+            added.append(h)
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            h = MetricHandler(self.train_metrics)
+            handlers.append(h)
+            added.append(h)
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            h = GradientUpdateHandler()
+            handlers.append(h)
+            added.append(h)
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            h = ValidationHandler(val_data, eval_fn=self.evaluate)
+            handlers.append(h)
+            added.append(h)
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            h = LoggingHandler()
+            handlers.append(h)
+            added.append(h)
+        if added:
+            self.logger.debug("added default handlers: %s",
+                              [type(h).__name__ for h in added])
+        return handlers
+
+    @staticmethod
+    def _categorize(handlers):
+        def of(kind):
+            hs = [h for h in handlers if isinstance(h, kind)]
+            return sorted(hs, key=lambda h: getattr(h, "priority", 0))
+
+        return (of(TrainBegin), of(EpochBegin), of(BatchBegin), of(BatchEnd),
+                of(EpochEnd), of(TrainEnd))

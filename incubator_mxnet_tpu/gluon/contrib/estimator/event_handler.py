@@ -1,0 +1,334 @@
+"""Event handlers for the Gluon Estimator (reference:
+python/mxnet/gluon/contrib/estimator/event_handler.py).
+
+Handlers mix in the stage marker classes (TrainBegin/…/BatchEnd); the
+Estimator sorts same-stage handlers by ``priority``, LOWER FIRST: at batch
+end the gradient update runs first (GradientUpdateHandler, -2000), then
+metric updates (MetricHandler, -1000), then observers like logging
+(+1000). A handler that must act on gradients BEFORE the optimizer step
+(e.g. clipping) needs priority < -2000.
+"""
+import logging
+import os
+import time
+
+import numpy as np
+
+from .... import metric as metric_mod
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "GradientUpdateHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after ``max_epoch`` epochs or ``max_batch`` total batches."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch is not None and self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics at epoch start; update them from each batch's
+    (label, pred) — and the loss metrics from the batch loss. Runs before
+    other batch-end handlers (priority -1000) so logging sees fresh
+    values."""
+
+    priority = -1000
+
+    def __init__(self, metrics):
+        self.metrics = list(metrics)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Apply the optimizer step (trainer.step) at batch end. Split out as a
+    handler (reference design) so users can reorder/replace it — e.g. for
+    gradient accumulation. Priority -2000: runs first."""
+
+    priority = -2000
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss")
+        batch_size = 0
+        if loss is not None:
+            losses = loss if isinstance(loss, (list, tuple)) else [loss]
+            batch_size = sum(l.shape[0] if getattr(l, "ndim", 0) else 1
+                             for l in losses)
+        estimator.trainer.step(batch_size or 1)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run ``eval_fn`` (usually ``estimator.evaluate``) every
+    ``epoch_period`` epochs and/or every ``batch_period`` batches."""
+
+    priority = -500
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 event_handlers=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.event_handlers = event_handlers
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if (self.batch_period is not None
+                and self.current_batch % self.batch_period == 0):
+            self.eval_fn(self.val_data, event_handlers=self.event_handlers)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if (self.epoch_period is not None
+                and self.current_epoch % self.epoch_period == 0):
+            self.eval_fn(self.val_data, event_handlers=self.event_handlers)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress: per-epoch always; per-batch every
+    ``log_interval`` batches when set."""
+
+    priority = 1000  # after metric updates
+
+    def __init__(self, log_interval=None, metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.logger = logging.getLogger("incubator_mxnet_tpu.estimator")
+
+    def _fmt(self, estimator):
+        ms = self.metrics if self.metrics is not None else (
+            estimator.train_metrics)
+        return ", ".join(f"{n}: {v:.4f}" if isinstance(v, float)
+                         else f"{n}: {v}"
+                         for n, v in (m.get_name_value()[0] for m in ms))
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training finished in %.2fs (%d epochs)",
+                         time.time() - self.train_start, self.current_epoch)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+        self.processed_samples = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.logger.info("Epoch %d finished in %.2fs: %s",
+                         self.current_epoch,
+                         time.time() - self.epoch_start, self._fmt(estimator))
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        batch = kwargs.get("batch")
+        if batch is not None:
+            try:
+                self.processed_samples += batch[0].shape[0]
+            except Exception:  # noqa: BLE001 — non-array batch payloads
+                pass
+        self.batch_index += 1
+        if self.log_interval and self.batch_index % self.log_interval == 0:
+            self.logger.info("Epoch %d batch %d: %s", self.current_epoch,
+                             self.batch_index, self._fmt(estimator))
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model (and trainer) state every ``epoch_period`` epochs /
+    ``batch_period`` batches to ``model_dir/model_prefix-epochN.params``;
+    optionally track the best value of ``monitor`` and keep
+    ``model_prefix-best.params`` (reference CheckpointHandler)."""
+
+    priority = 500
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="auto", epoch_period=1, batch_period=None,
+                 save_best=False, max_checkpoints=5):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.save_best = save_best
+        self.max_checkpoints = max_checkpoints
+        self.saved = []
+        self.current_epoch = 0
+        self.current_batch = 0
+        if mode == "auto":
+            name = monitor.get()[0] if monitor is not None else ""
+            mode = "max" if ("acc" in str(name).lower()
+                             or "f1" in str(name).lower()) else "min"
+        self.mode = mode
+        self.best = -np.inf if mode == "max" else np.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_epoch = 0
+        self.current_batch = 0
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
+        estimator.net.save_parameters(path + ".params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(path + ".states")
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for suffix in (".params", ".states"):
+                try:
+                    os.remove(old + suffix)
+                except OSError:
+                    pass
+        return path
+
+    def _maybe_save_best(self, estimator):
+        if not (self.save_best and self.monitor is not None):
+            return
+        _, value = self.monitor.get_name_value()[0]
+        better = (value > self.best if self.mode == "max"
+                  else value < self.best)
+        if better:
+            self.best = value
+            path = os.path.join(self.model_dir, f"{self.model_prefix}-best")
+            estimator.net.save_parameters(path + ".params")
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if (self.batch_period is not None
+                and self.current_batch % self.batch_period == 0):
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if (self.epoch_period is not None
+                and self.current_epoch % self.epoch_period == 0):
+            self._save(estimator, f"epoch{self.current_epoch - 1}")
+            self._maybe_save_best(estimator)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop training when ``monitor`` stops improving by ``min_delta`` for
+    ``patience`` consecutive epochs (reference EarlyStoppingHandler)."""
+
+    priority = 800
+
+    def __init__(self, monitor, min_delta=0.0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        name = monitor.get()[0] if monitor is not None else ""
+        if mode == "auto":
+            mode = "max" if ("acc" in str(name).lower()
+                             or "f1" in str(name).lower()) else "min"
+        self.mode = mode
+        self.stopped_epoch = None
+        self.logger = logging.getLogger("incubator_mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.current_epoch = 0
+        self.stopped_epoch = None
+        self.best = (self.baseline if self.baseline is not None
+                     else (-np.inf if self.mode == "max" else np.inf))
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, value = self.monitor.get_name_value()[0]
+        if isinstance(value, str) or value != value:  # non-numeric / nan
+            self.current_epoch += 1
+            return
+        improved = (value - self.min_delta > self.best
+                    if self.mode == "max"
+                    else value + self.min_delta < self.best)
+        if improved:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch is not None:
+            self.logger.info("Early stopping at epoch %d (%s best %.4f)",
+                             self.stopped_epoch, self.monitor.get()[0],
+                             self.best)

@@ -239,11 +239,7 @@ def BilinearResize2D(data, height=None, width=None):
     """Bilinear resize, align-corners (reference:
     mx.nd.contrib.BilinearResize2D, src/operator/contrib/
     bilinear_resize.cc). Two MXU matrix contractions, no gathers."""
-    if not (isinstance(height, int) and isinstance(width, int)
-            and height > 0 and width > 0):
-        raise ValueError("BilinearResize2D requires explicit positive "
-                         "integer height= and width= (got height=%r, "
-                         "width=%r)" % (height, width))
+    height, width = _raw.validate_resize_sizes(height, width)
     if _symbolic(data):
         return _sym_call("BilinearResize2D", data=data, height=height,
                          width=width)

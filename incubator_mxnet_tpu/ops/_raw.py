@@ -682,12 +682,47 @@ def _interp_matrix(out_len, in_len):
     return a.at[rows, i0].add(1.0 - f).at[rows, i1].add(f)
 
 
+def validate_resize_sizes(height, width, op="BilinearResize2D"):
+    """Shared nd/symbol-path validation: explicit positive integer sizes
+    (python ints or numpy integer scalars; bool rejected). Returns them as
+    python ints."""
+    import operator as _op
+    try:
+        if isinstance(height, bool) or isinstance(width, bool):
+            raise TypeError
+        height, width = _op.index(height), _op.index(width)
+        if height <= 0 or width <= 0:
+            raise TypeError
+    except TypeError:
+        raise ValueError(f"{op} requires explicit positive integer height= "
+                         f"and width= (got height={height!r}, "
+                         f"width={width!r})")
+    return height, width
+
+
+def _fractional_compute_dtype(x):
+    """Fractional-weight ops (resize/avg-pool/roi sampling) must not cast
+    weights in [0,1] to an integer input dtype — that truncates them to 0
+    and silently zeroes the output. Integer inputs compute in f32 and the
+    caller rounds back."""
+    return x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+
+
+def _cast_back(y, dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return y
+    return jnp.round(y).astype(dtype)
+
+
 def bilinear_resize(x, height, width):
     """BilinearResize2D, NCHW (reference contrib op). out = A_h @ x @ A_w.T
-    per channel — two MXU contractions, no dynamic gathers."""
-    a_h = _interp_matrix(height, x.shape[2]).astype(x.dtype)
-    a_w = _interp_matrix(width, x.shape[3]).astype(x.dtype)
-    return jnp.einsum("ij,ncjk,lk->ncil", a_h, x, a_w)
+    per channel — two MXU contractions, no dynamic gathers. Integer images
+    (e.g. uint8) compute in f32 and round back."""
+    cd = _fractional_compute_dtype(x)
+    a_h = _interp_matrix(height, x.shape[2]).astype(cd)
+    a_w = _interp_matrix(width, x.shape[3]).astype(cd)
+    y = jnp.einsum("ij,ncjk,lk->ncil", a_h, x.astype(cd), a_w)
+    return _cast_back(y, x.dtype)
 
 
 def adaptive_avg_pool(x, output_size):
@@ -706,9 +741,11 @@ def adaptive_avg_pool(x, output_size):
             m[i, s:e] = 1.0 / (e - s)
         return jnp.asarray(m)
 
-    a_h = avg_matrix(oh, x.shape[2]).astype(x.dtype)
-    a_w = avg_matrix(ow, x.shape[3]).astype(x.dtype)
-    return jnp.einsum("ij,ncjk,lk->ncil", a_h, x, a_w)
+    cd = _fractional_compute_dtype(x)
+    a_h = avg_matrix(oh, x.shape[2]).astype(cd)
+    a_w = avg_matrix(ow, x.shape[3]).astype(cd)
+    y = jnp.einsum("ij,ncjk,lk->ncil", a_h, x.astype(cd), a_w)
+    return _cast_back(y, x.dtype)
 
 
 def roi_align(x, rois, pooled_size, spatial_scale, sample_ratio=-1):
@@ -721,6 +758,8 @@ def roi_align(x, rois, pooled_size, spatial_scale, sample_ratio=-1):
     n, c, h, w = x.shape
     ph, pw = pooled_size
     s = sample_ratio if sample_ratio and sample_ratio > 0 else 2
+    out_dtype = x.dtype
+    x = x.astype(_fractional_compute_dtype(x))
 
     ky = (jnp.arange(ph)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s)  # (ph,s)
     kx = (jnp.arange(pw)[:, None] + (jnp.arange(s)[None, :] + 0.5) / s)  # (pw,s)
@@ -758,4 +797,5 @@ def roi_align(x, rois, pooled_size, spatial_scale, sample_ratio=-1):
         vals = vals.reshape(c, ph, s, pw, s)
         return vals.mean(axis=(2, 4))                         # (C,ph,pw)
 
-    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return _cast_back(jax.vmap(one_roi)(rois.astype(jnp.float32)),
+                      out_dtype)

@@ -3,29 +3,56 @@ CUDA/cuDNN kernels under src/operator/contrib/ and src/operator/nn/).
 
 On TPU these run as real Mosaic kernels; off-TPU they run with
 ``interpret=True`` (tests) or are bypassed in favor of the XLA path.
+
+Before the first real-hardware dispatch the kernels must pass a one-time
+on-device self-test (:func:`kernels_ok`): tiny-shape forward+backward of
+both kernels checked against the plain XLA formulation. Any compile
+failure, runtime error, or numeric mismatch permanently flips dispatch to
+the XLA path for the process (with a warning) instead of letting a Mosaic
+tiling bug take down a long training/bench run mid-compile.
 """
 from .flash_attention import flash_attention
 from .layer_norm import layer_norm
 
 import os
+import sys
+import time
+import warnings
 
 import jax
 
-__all__ = ["flash_attention", "layer_norm", "enabled"]
+__all__ = ["flash_attention", "layer_norm", "enabled", "kernels_ok",
+           "is_tpu"]
+
+# tri-state: None = not yet tested, True/False = verdict for this process
+_KERNELS_OK = None
+
+# exception types the self-test must NOT swallow (external watchdogs:
+# bench.py registers its SIGALRM deadline so a hang is reported as a
+# timeout, not misdiagnosed as a kernel numerics failure)
+_SELFTEST_PASSTHROUGH = ()
+
+
+def register_selftest_passthrough(*exc_types):
+    """Let callers' deadline exceptions propagate out of the self-test."""
+    global _SELFTEST_PASSTHROUGH
+    _SELFTEST_PASSTHROUGH = _SELFTEST_PASSTHROUGH + tuple(exc_types)
+
+
+def _truthy(name):
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
 
 
 def enabled() -> bool:
-    """Use pallas kernels for framework ops? On by default on TPU; set
-    MXTPU_FORCE_PALLAS=1 to exercise interpret-mode kernels off-TPU, or
-    MXTPU_NO_PALLAS=1 to force the plain XLA path everywhere."""
-    def _truthy(name):
-        return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
-
+    """Use pallas kernels for framework ops? On by default on TPU (gated by
+    the one-time on-device self-test); set MXTPU_FORCE_PALLAS=1 to exercise
+    interpret-mode kernels off-TPU, or MXTPU_NO_PALLAS=1 to force the plain
+    XLA path everywhere."""
     if _truthy("MXTPU_NO_PALLAS"):
         return False
     if _truthy("MXTPU_FORCE_PALLAS"):
         return True
-    return is_tpu()
+    return is_tpu() and kernels_ok()
 
 
 def is_tpu() -> bool:
@@ -40,3 +67,107 @@ def is_tpu() -> bool:
         return any("tpu" in d.device_kind.lower() for d in jax.devices())
     except Exception:  # noqa: BLE001  (no backend reachable)
         return False
+
+
+def kernels_ok() -> bool:
+    """One-time on-device validation of the Mosaic kernels.
+
+    The pallas kernels are numerically verified in interpret mode by the
+    test suite, but Mosaic lowering on real hardware has failure modes
+    interpret mode can't see (tiling/layout constraints, VMEM limits).
+    First call on a TPU runs both kernels forward+backward on tiny shapes
+    and compares against the XLA formulation; any exception or mismatch
+    disables the pallas fast path for the rest of the process and warns,
+    so a kernel bug degrades perf instead of crashing the run.
+
+    Off-TPU this returns True without running anything (interpret mode is
+    covered by tests/test_pallas.py). MXTPU_PALLAS_SELFTEST=0 skips the
+    check (trust the kernels; saves two tiny compiles at startup).
+    """
+    global _KERNELS_OK
+    if _KERNELS_OK is None:
+        skip = (os.environ.get("MXTPU_PALLAS_SELFTEST", "1")
+                .strip().lower() in ("0", "false"))
+        if skip or not is_tpu():
+            _KERNELS_OK = True
+        else:
+            _KERNELS_OK = _selftest()
+    return _KERNELS_OK
+
+
+def _selftest() -> bool:
+    import numpy as np
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    try:
+        rng = np.random.RandomState(0)
+
+        # -- fused layer norm, fwd + bwd ---------------------------------
+        x = jnp.asarray(rng.randn(16, 256).astype(np.float32))
+        g = jnp.asarray(rng.rand(256).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(256).astype(np.float32))
+
+        def ln_ref(x, g, b):
+            m = jnp.mean(x, -1, keepdims=True)
+            v = jnp.var(x, -1, keepdims=True)
+            return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+        def check(fn_got, fn_ref, args, what, atol, grad_names):
+            got, vjp_g = jax.vjp(fn_got, *args)
+            ref, vjp_r = jax.vjp(fn_ref, *args)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=atol, rtol=atol,
+                                       err_msg=f"{what} forward")
+            ct = jnp.ones_like(ref)
+            for gg, gr, nm in zip(vjp_g(ct), vjp_r(ct), grad_names):
+                np.testing.assert_allclose(np.asarray(gg), np.asarray(gr),
+                                           atol=atol * 4, rtol=atol * 4,
+                                           err_msg=f"{what} grad {nm}")
+
+        check(lambda x, g, b: layer_norm(x, g, b, 1e-5), ln_ref,
+              (x, g, b), "layer_norm", 2e-3, ("x", "gamma", "beta"))
+
+        # -- flash attention, fwd + bwd ----------------------------------
+        q = jnp.asarray(rng.randn(1, 2, 256, 64).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(1, 2, 256, 64).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(1, 2, 256, 64).astype(np.float32))
+
+        def attn_ref(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+            return jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(s, axis=-1), v)
+
+        check(lambda q, k, v: flash_attention(q, k, v), attn_ref,
+              (q, k, v), "flash_attention", 5e-3, ("q", "k", "v"))
+        check(lambda q, k, v: flash_attention(q, k, v, causal=True),
+              lambda q, k, v: _causal_ref(q, k, v),
+              (q, k, v), "flash_attention(causal)", 5e-3, ("q", "k", "v"))
+
+        print(f"pallas: on-device kernel self-test PASSED "
+              f"({time.time() - t0:.1f}s)", file=sys.stderr, flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001 — any failure means fall back
+        if isinstance(e, _SELFTEST_PASSTHROUGH):
+            raise
+        warnings.warn(
+            f"pallas kernels failed the on-device self-test after "
+            f"{time.time() - t0:.1f}s — falling back to the XLA path for "
+            f"this process ({type(e).__name__}: {str(e)[:300]})",
+            RuntimeWarning, stacklevel=2)
+        return False
+
+
+def _causal_ref(q, k, v):
+    import jax.numpy as jnp
+    lq, lk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+    tri = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+    s = jnp.where(tri, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def _reset_selftest_for_tests():
+    """Test hook: clear the cached self-test verdict."""
+    global _KERNELS_OK
+    _KERNELS_OK = None

@@ -1109,6 +1109,7 @@ def ROIAlign(data=None, rois=None, pooled_size=(7, 7), spatial_scale=1.0,
 
 
 def BilinearResize2D(data=None, height=None, width=None, name=None):
+    height, width = _raw.validate_resize_sizes(height, width)
     return _make_op("BilinearResize2D", [data],
                     _attrs(height=height, width=width), name)
 

@@ -1,0 +1,228 @@
+"""Gluon Estimator API (reference tests/python/unittest/test_gluon_estimator.py
+and test_gluon_event_handler.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, metric as metric_mod, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.contrib.estimator import (
+    BatchEnd, CheckpointHandler, EarlyStoppingHandler, Estimator,
+    GradientUpdateHandler, LoggingHandler, MetricHandler, StoppingHandler,
+    ValidationHandler)
+
+
+def _toy(n=64, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, classes).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(x), nd.array(y))
+    return gluon.data.DataLoader(ds, batch_size=16)
+
+
+def _net(classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(classes))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _estimator(net=None, metrics=None):
+    net = net or _net()
+    return Estimator(
+        net=net,
+        loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=metrics,
+        trainer=gluon.Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 1e-2}),
+    )
+
+
+def test_fit_epochs_trains():
+    data = _toy()
+    est = _estimator(metrics=metric_mod.Accuracy())
+    est.fit(train_data=data, epochs=5)
+    names = dict(m.get_name_value()[0] for m in est.train_metrics)
+    assert names["accuracy"] > 0.5
+    # train loss metric rides along automatically
+    assert any("softmaxcrossentropyloss" in n for n in names)
+
+
+def test_fit_batches_stops_at_count():
+    data = _toy()
+    est = _estimator()
+    seen = []
+
+    class Counter(BatchEnd):
+        def batch_end(self, estimator, *args, **kwargs):
+            seen.append(1)
+
+    est.fit(train_data=data, batches=6, event_handlers=[Counter()])
+    assert len(seen) == 6
+
+
+def test_epochs_and_batches_exclusive():
+    est = _estimator()
+    with pytest.raises(ValueError):
+        est.fit(train_data=_toy(), epochs=1, batches=1)
+    with pytest.raises(ValueError):
+        est.fit(train_data=_toy())
+
+
+def test_validation_handler_runs_every_epoch():
+    data = _toy()
+    val = _toy(seed=1)
+    est = _estimator(metrics=metric_mod.Accuracy())
+    est.fit(train_data=data, val_data=val, epochs=2)
+    names = dict(m.get_name_value()[0] for m in est.val_metrics)
+    assert not np.isnan(list(names.values())[0])
+
+
+def test_evaluate_standalone():
+    est = _estimator(metrics=metric_mod.Accuracy())
+    res = est.evaluate(_toy(seed=2))
+    assert any("loss" in k for k in res)
+
+
+def test_checkpoint_handler(tmp_path):
+    data = _toy()
+    est = _estimator(metrics=metric_mod.Accuracy())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             monitor=est.train_metrics[0], save_best=True)
+    est.fit(train_data=data, epochs=3, event_handlers=[ckpt])
+    files = sorted(os.listdir(tmp_path))
+    assert "toy-epoch0.params" in files and "toy-epoch2.params" in files
+    assert "toy-best.params" in files
+    assert "toy-epoch0.states" in files
+    # params round-trip into a fresh net
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "toy-epoch2.params"))
+
+
+def test_checkpoint_max_checkpoints(tmp_path):
+    data = _toy()
+    est = _estimator()
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="m",
+                             max_checkpoints=2)
+    est.fit(train_data=data, epochs=4, event_handlers=[ckpt])
+    params = [f for f in os.listdir(tmp_path) if f.endswith(".params")]
+    assert sorted(params) == ["m-epoch2.params", "m-epoch3.params"]
+
+
+def test_early_stopping_stops():
+    data = _toy()
+    est = _estimator(metrics=metric_mod.Accuracy())
+
+    class Frozen(metric_mod.EvalMetric):
+        """Monitor that never improves."""
+
+        def __init__(self):
+            super().__init__("frozen")
+
+        def update(self, labels, preds):
+            pass
+
+        def get(self):
+            return "frozen", 0.5
+
+    stopper = EarlyStoppingHandler(monitor=Frozen(), patience=1, mode="max")
+    epochs_run = []
+
+    class EpochCounter(LoggingHandler):
+        def epoch_end(self, estimator, *args, **kwargs):
+            epochs_run.append(1)
+            super().epoch_end(estimator, *args, **kwargs)
+
+    est.fit(train_data=data, epochs=50,
+            event_handlers=[stopper, EpochCounter()])
+    # patience=1: epoch0 sets best? no — first epoch_end: 0.5 not > best
+    # (-inf)... it IS an improvement; epoch1 no improvement (wait=1),
+    # epoch2 no improvement (wait=2 > patience) -> stop well before 50
+    assert 2 <= len(epochs_run) <= 4
+    assert stopper.stopped_epoch is not None
+
+
+def test_handler_priority_order():
+    """GradientUpdateHandler (priority -2000) must run before
+    MetricHandler (-1000), which runs before LoggingHandler (1000)."""
+    est = _estimator()
+    handlers = est._prepare_handlers(None, [])
+    batch_end = est._categorize(handlers)[3]
+    kinds = [type(h).__name__ for h in batch_end]
+    assert kinds.index("GradientUpdateHandler") < kinds.index(
+        "MetricHandler") < kinds.index("LoggingHandler")
+
+
+def test_custom_gradient_update_accumulation():
+    """Replacing GradientUpdateHandler customizes the update cadence
+    (here: step every 2 batches => gradient accumulation)."""
+    data = _toy()
+    net = _net()
+    est = Estimator(net=net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 1e-2}))
+    steps = []
+
+    class EveryTwo(GradientUpdateHandler):
+        def __init__(self):
+            self.n = 0
+
+        def batch_end(self, estimator, *args, **kwargs):
+            self.n += 1
+            if self.n % 2 == 0:
+                estimator.trainer.step(32)
+                steps.append(1)
+
+    est.fit(train_data=data, batches=8, event_handlers=[EveryTwo()])
+    assert len(steps) == 4
+
+
+def test_rejects_non_loss_and_non_metric():
+    net = _net()
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=lambda a, b: a)
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=gluon.loss.L2Loss(),
+                  train_metrics="accuracy")
+
+
+def test_fit_empty_loader_raises():
+    est = _estimator()
+    with pytest.raises(ValueError, match="no batches"):
+        est.fit(train_data=[], batches=4)
+
+
+def test_evaluate_dispatches_event_handlers():
+    est = _estimator(metrics=metric_mod.Accuracy())
+    events = []
+
+    class Observer(LoggingHandler):
+        def epoch_begin(self, estimator, *args, **kwargs):
+            events.append("eb")
+
+        def batch_end(self, estimator, *args, **kwargs):
+            assert kwargs.get("pred") is not None
+            events.append("be")
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            events.append("ee")
+
+    est.evaluate(_toy(), event_handlers=[Observer()])
+    assert events[0] == "eb" and events[-1] == "ee"
+    assert events.count("be") == 4  # 64 samples / batch 16
+
+
+def test_fit_zero_epochs_is_noop():
+    est = _estimator()
+    est.net(nd.array(np.zeros((1, 8), np.float32)))  # materialize params
+    before = {k: v.data().asnumpy().copy()
+              for k, v in est.net.collect_params().items()}
+    est.fit(train_data=_toy(), epochs=0)
+    est.fit(train_data=_toy(), batches=0)
+    for k, v in est.net.collect_params().items():
+        np.testing.assert_array_equal(before[k], v.data().asnumpy())
+    with pytest.raises(ValueError, match=">= 0"):
+        est.fit(train_data=_toy(), epochs=-1)

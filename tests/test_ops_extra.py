@@ -448,3 +448,49 @@ def test_bilinear_resize_requires_sizes():
     x = nd.array(np.ones((1, 1, 4, 4), np.float32))
     with pytest.raises(ValueError, match="height"):
         nd.contrib.BilinearResize2D(x)
+
+def test_bilinear_resize_accepts_numpy_int_sizes():
+    # sizes from shape arithmetic are numpy integer scalars, not python
+    # ints; the op must accept them (and still reject bool/float/None)
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    h = np.int64(7)
+    w = np.ceil(4 * 2.75).astype(np.int32)
+    out = nd.contrib.BilinearResize2D(x, height=h, width=w)
+    assert out.shape == (1, 1, 7, 11)
+    ref = nd.contrib.BilinearResize2D(x, height=7, width=11)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy())
+    for bad_h, bad_w in ((True, 3), (3.5, 3), (-2, 3), (3, 0)):
+        with pytest.raises(ValueError):
+            nd.contrib.BilinearResize2D(x, height=bad_h, width=bad_w)
+
+def test_vision_ops_integer_dtypes():
+    # uint8 images must resize/pool to sensible values, not truncate the
+    # fractional interpolation weights to zero
+    img = np.arange(64, dtype=np.uint8).reshape(1, 1, 8, 8) * 3
+    x = nd.array(img)
+    assert x.dtype == np.uint8
+    out = nd.contrib.BilinearResize2D(x, height=4, width=4)
+    assert out.dtype == np.uint8
+    ref = nd.contrib.BilinearResize2D(x.astype("float32"), height=4,
+                                      width=4).asnumpy()
+    np.testing.assert_allclose(out.asnumpy().astype(np.float32), np.round(ref),
+                               atol=1)
+    assert out.asnumpy().max() > 0
+    pool = nd.contrib.AdaptiveAvgPooling2D(x, output_size=2)
+    assert pool.dtype == np.uint8 and pool.asnumpy().max() > 0
+    refp = nd.contrib.AdaptiveAvgPooling2D(x.astype("float32"),
+                                           output_size=2).asnumpy()
+    np.testing.assert_allclose(pool.asnumpy().astype(np.float32),
+                               np.round(refp), atol=1)
+
+
+def test_symbol_bilinear_resize_validates_sizes():
+    from incubator_mxnet_tpu import symbol as S
+    with pytest.raises(ValueError, match="height"):
+        S.contrib.BilinearResize2D(S.Variable("d"))
+    with pytest.raises(ValueError, match="height"):
+        S.contrib.BilinearResize2D(S.Variable("d"), height=0, width=3)
+    # numpy ints fine on the symbol path too
+    s = S.contrib.BilinearResize2D(S.Variable("d"), height=np.int64(3),
+                                   width=np.int32(3))
+    assert s is not None

@@ -176,12 +176,17 @@ def test_enabled_detects_plugin_tpu_platforms(monkeypatch):
 
     monkeypatch.delenv("MXTPU_FORCE_PALLAS", raising=False)
     monkeypatch.delenv("MXTPU_NO_PALLAS", raising=False)
+    monkeypatch.setenv("MXTPU_PALLAS_SELFTEST", "0")  # no Mosaic on CPU
+    pallas._reset_selftest_for_tests()
     monkeypatch.setattr(jax, "default_backend", lambda: "axon")
     monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
-    assert pallas.enabled()
-    monkeypatch.setattr(jax, "devices", lambda: [type("C", (), {
-        "device_kind": "cpu"})()])
-    assert not pallas.enabled()
+    try:
+        assert pallas.enabled()
+        monkeypatch.setattr(jax, "devices", lambda: [type("C", (), {
+            "device_kind": "cpu"})()])
+        assert not pallas.enabled()
+    finally:
+        pallas._reset_selftest_for_tests()
 
 
 def test_is_tpu_consistent_across_dispatch_sites(monkeypatch):
@@ -196,8 +201,96 @@ def test_is_tpu_consistent_across_dispatch_sites(monkeypatch):
 
     monkeypatch.delenv("MXTPU_FORCE_PALLAS", raising=False)
     monkeypatch.delenv("MXTPU_NO_PALLAS", raising=False)
+    monkeypatch.setenv("MXTPU_PALLAS_SELFTEST", "0")  # no Mosaic on CPU
+    pallas._reset_selftest_for_tests()
     monkeypatch.setattr(jax, "default_backend", lambda: "axon")
     monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
-    assert pallas.is_tpu() and pallas.enabled()
+    try:
+        assert pallas.is_tpu() and pallas.enabled()
+    finally:
+        pallas._reset_selftest_for_tests()
     from incubator_mxnet_tpu.runtime import features
     assert features.Features().is_enabled("TPU")
+
+
+# ---------------------------------------------------------------------------
+# on-device kernel self-test gating (kernels_ok)
+# ---------------------------------------------------------------------------
+
+def test_selftest_not_run_off_tpu(monkeypatch):
+    """Off-TPU, kernels_ok() trusts interpret-mode test coverage and never
+    compiles anything."""
+    from incubator_mxnet_tpu.ops import pallas
+
+    monkeypatch.delenv("MXTPU_PALLAS_SELFTEST", raising=False)
+    monkeypatch.setattr(pallas, "is_tpu", lambda: False)
+    monkeypatch.setattr(pallas, "_selftest",
+                        lambda: (_ for _ in ()).throw(AssertionError(
+                            "selftest must not run off-TPU")))
+    pallas._reset_selftest_for_tests()
+    try:
+        assert pallas.kernels_ok()
+    finally:
+        pallas._reset_selftest_for_tests()
+
+
+def test_selftest_passes_with_correct_kernels(monkeypatch):
+    """The self-test's own reference math must accept the real kernels
+    (run in interpret mode here) — otherwise it would spuriously disable
+    pallas on the chip."""
+    import functools
+    from incubator_mxnet_tpu.ops import pallas
+    from incubator_mxnet_tpu.ops.pallas import flash_attention, layer_norm
+
+    monkeypatch.setattr(pallas, "layer_norm",
+                        functools.partial(layer_norm, interpret=True))
+    monkeypatch.setattr(pallas, "flash_attention",
+                        functools.partial(flash_attention, interpret=True))
+    assert pallas._selftest() is True
+
+
+def test_selftest_failure_disables_pallas(monkeypatch):
+    """A kernel producing wrong numbers (or raising) flips dispatch to the
+    XLA path for the process, with a warning — it must not propagate."""
+    import functools
+    from incubator_mxnet_tpu.ops import pallas
+    from incubator_mxnet_tpu.ops.pallas import layer_norm
+
+    monkeypatch.setattr(pallas, "layer_norm",
+                        functools.partial(layer_norm, interpret=True))
+    monkeypatch.setattr(pallas, "flash_attention",
+                        lambda q, k, v, **kw: q * 0.0)  # very wrong
+    with pytest.warns(RuntimeWarning, match="self-test"):
+        assert pallas._selftest() is False
+
+    # and kernels_ok()/enabled() honor the verdict on a (fake) TPU
+    monkeypatch.delenv("MXTPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("MXTPU_NO_PALLAS", raising=False)
+    monkeypatch.delenv("MXTPU_PALLAS_SELFTEST", raising=False)
+    monkeypatch.setattr(pallas, "is_tpu", lambda: True)
+    monkeypatch.setattr(pallas, "_selftest", lambda: False)
+    pallas._reset_selftest_for_tests()
+    try:
+        assert not pallas.kernels_ok()
+        assert not pallas.enabled()
+        # cached: a later flip of _selftest must not re-run
+        monkeypatch.setattr(pallas, "_selftest", lambda: True)
+        assert not pallas.kernels_ok()
+    finally:
+        pallas._reset_selftest_for_tests()
+
+
+def test_selftest_skip_env(monkeypatch):
+    """MXTPU_PALLAS_SELFTEST=0 trusts the kernels without compiling."""
+    from incubator_mxnet_tpu.ops import pallas
+
+    monkeypatch.setenv("MXTPU_PALLAS_SELFTEST", "0")
+    monkeypatch.setattr(pallas, "is_tpu", lambda: True)
+    monkeypatch.setattr(pallas, "_selftest",
+                        lambda: (_ for _ in ()).throw(AssertionError(
+                            "selftest must be skipped")))
+    pallas._reset_selftest_for_tests()
+    try:
+        assert pallas.kernels_ok()
+    finally:
+        pallas._reset_selftest_for_tests()
