@@ -9,5 +9,6 @@ from ..ndarray import contrib as nd
 from ..symbol import contrib as symbol
 from ..symbol import contrib as sym
 from . import quantization
+from . import text
 
-__all__ = ["ndarray", "nd", "symbol", "sym", "quantization"]
+__all__ = ["ndarray", "nd", "symbol", "sym", "quantization", "text"]

@@ -1,0 +1,7 @@
+"""mx.contrib.text (reference: python/mxnet/contrib/text/): vocabulary,
+token counting, and file-backed token embeddings."""
+from . import embedding  # noqa: F401
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from .utils import count_tokens_from_str  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

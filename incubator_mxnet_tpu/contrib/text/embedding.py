@@ -1,0 +1,272 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py).
+
+File-backed pretrained vectors (GloVe/fastText text formats) load into a
+host matrix and become a device ``NDArray`` ready for
+``gluon.nn.Embedding.weight.set_data`` — the TPU path is one
+embedding-table gather, no per-token host work. This image has no egress,
+so the auto-download path of the reference raises a documented error;
+``pretrained_file_path`` pointing at a local vector file works fully.
+"""
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...base import _Registry
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "GloVe", "FastText"]
+
+_REG = _Registry("token_embedding")
+
+
+def register(cls):
+    _REG.register(cls.__name__.lower())(cls)
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """Create by registered name, e.g. ``create('glove',
+    pretrained_file_name=..., pretrained_file_path=...)``."""
+    return _REG.create(embedding_name.lower(), **kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained vector files per registered embedding (names
+    only; files must be provided locally, this image has no egress).
+    User classes added via @register appear here too."""
+    known = {name: list(getattr(cls, "pretrained_file_names", ()))
+             for name, cls in _REG._map.items()}
+    if embedding_name is None:
+        return known
+    name = embedding_name.lower()
+    if name not in known:
+        raise KeyError(f"unknown embedding {embedding_name!r}; choose from "
+                       f"{sorted(known)}")
+    return known[name]
+
+
+class TokenEmbedding:
+    """Base: an index of tokens with a dense vector per token.
+
+    ``vocabulary`` (optional) re-indexes the loaded vectors against a
+    :class:`~.vocab.Vocabulary`; otherwise tokens index in file order
+    with index 0 = unknown."""
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=np.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None      # (N, D) NDArray after load
+        self._idx_to_vec_np = None   # host mirror (row gathers stay cheap)
+
+    def _set_idx_to_vec(self, matrix_np):
+        """Install the vector table: device NDArray + cached host mirror
+        (a per-lookup asnumpy() of a 2M x 300 table would be a multi-GB
+        device→host copy per call)."""
+        self._idx_to_vec_np = np.asarray(matrix_np, np.float32)
+        self._idx_to_vec = nd.array(self._idx_to_vec_np)
+
+    # -- loading ---------------------------------------------------------
+    def _load_embedding_txt(self, path, elem_delim=" ", encoding="utf8"):
+        """Parse a GloVe/fastText-style text file: `token v1 v2 ... vD`
+        per line. Malformed lines are skipped with a warning (reference
+        behavior)."""
+        if not os.path.isfile(path):
+            raise OSError(
+                f"pretrained embedding file {path!r} not found. This "
+                "environment has no network egress; download is not "
+                "supported — place the vector file locally and pass "
+                "pretrained_file_path.")
+        vecs = []
+        dim = None
+        log = logging.getLogger("incubator_mxnet_tpu.text")
+        with io.open(path, "r", encoding=encoding) as f:
+            for ln_no, line in enumerate(f, 1):
+                parts = line.rstrip().split(elem_delim)
+                if ln_no == 1 and len(parts) == 2:
+                    continue  # fastText header line: "<count> <dim>"
+                token, elems = parts[0], parts[1:]
+                if dim is not None and len(elems) != dim:
+                    log.warning("%s:%d skipped (bad length)", path, ln_no)
+                    continue
+                if token in self._token_to_idx:
+                    log.warning("%s:%d skipped (dup token)", path, ln_no)
+                    continue
+                try:
+                    vec = np.asarray([float(e) for e in elems], np.float32)
+                except ValueError:
+                    log.warning("%s:%d skipped (non-float element)",
+                                path, ln_no)
+                    continue
+                # dim commits only after a line fully parses, so a
+                # malformed first line can't poison the expected length
+                if dim is None:
+                    if len(elems) == 1:
+                        raise ValueError(
+                            f"{path}:{ln_no}: unexpected vector length 1 — "
+                            f"wrong elem_delim?")
+                    dim = len(elems)
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(vec)
+        if dim is None:
+            raise ValueError(f"{path}: no vectors parsed")
+        unk = np.asarray(self._init_unknown_vec((dim,)), np.float32)
+        self._set_idx_to_vec(np.vstack([unk[None, :]] + vecs))
+
+    def _reindex_to_vocabulary(self, vocabulary):
+        old_tok2idx = dict(self._token_to_idx)
+        old = self._idx_to_vec_np
+        dim = old.shape[1]
+        rows = np.zeros((len(vocabulary), dim), np.float32)
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            rows[i] = old[old_tok2idx.get(tok, 0)]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._set_idx_to_vec(rows)
+
+    # -- the reference API ----------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"token index {i} out of range")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+
+        def idx_of(t):
+            if t in self._token_to_idx:
+                return self._token_to_idx[t]
+            if lower_case_backup:
+                return self._token_to_idx.get(t.lower(), 0)
+            return 0
+
+        rows = self._idx_to_vec_np[[idx_of(t) for t in toks]]
+        out = nd.array(rows)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known tokens (reference semantics:
+        unknown tokens raise)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        vecs = new_vectors.asnumpy()
+        if vecs.ndim == 1:
+            vecs = vecs[None, :]
+        if len(toks) != vecs.shape[0]:
+            raise ValueError("tokens / new_vectors length mismatch")
+        data = np.array(self._idx_to_vec_np)  # host mirror is read-only
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is not indexed")
+            data[self._token_to_idx[t]] = v
+        self._set_idx_to_vec(data)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Vectors from a user-supplied text file: `token v1 ... vD` lines
+    (reference CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+        if vocabulary is not None:
+            self._reindex_to_vocabulary(vocabulary)
+
+
+class _PretrainedEmbedding(CustomEmbedding):
+    pretrained_file_names = ()
+
+    def __init__(self, pretrained_file_name=None, pretrained_file_path=None,
+                 vocabulary=None, **kwargs):
+        if pretrained_file_path is None:
+            raise OSError(
+                f"{type(self).__name__}: automatic download of "
+                f"{pretrained_file_name!r} is not supported in this "
+                "no-egress environment. Pass pretrained_file_path= to a "
+                "locally available vector file (same text format).")
+        super().__init__(pretrained_file_path, vocabulary=vocabulary,
+                         **kwargs)
+
+
+@register
+class GloVe(_PretrainedEmbedding):
+    """GloVe vectors (reference class; local-file-backed here)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+
+@register
+class FastText(_PretrainedEmbedding):
+    """fastText vectors (reference class; local-file-backed here)."""
+
+    pretrained_file_names = (
+        "wiki.simple.vec", "wiki.en.vec", "crawl-300d-2M.vec")
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        embs = (token_embeddings if isinstance(token_embeddings, list)
+                else [token_embeddings])
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in embs:
+            rows = emb._idx_to_vec_np
+            tok2idx = emb.token_to_idx
+            block = np.zeros((len(vocabulary), rows.shape[1]), np.float32)
+            for i, tok in enumerate(self._idx_to_token):
+                block[i] = rows[tok2idx.get(tok, 0)]
+            parts.append(block)
+        self._set_idx_to_vec(np.concatenate(parts, axis=1))

@@ -1,0 +1,23 @@
+"""Text token-counting utilities (reference:
+python/mxnet/contrib/text/utils.py)."""
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str``, splitting on ``token_delim`` and
+    ``seq_delim``. Returns (or updates) a ``collections.Counter``."""
+    source_str = re.split(
+        f"({re.escape(token_delim)})|({re.escape(seq_delim)})", source_str)
+    tokens = [t for t in source_str
+              if t is not None and t not in (token_delim, seq_delim)
+              and t.strip()]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    counter = (counter_to_update if counter_to_update is not None
+               else collections.Counter())
+    counter.update(tokens)
+    return counter

@@ -1,0 +1,90 @@
+"""Indexed vocabulary (reference: python/mxnet/contrib/text/vocab.py).
+
+Pure-host data structure: token↔index maps feed Embedding layers /
+one_hot on device; nothing here touches the chip.
+"""
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Token index built from a ``collections.Counter``.
+
+    Index 0 is ``unknown_token``; ``reserved_tokens`` (e.g. <pad>, <bos>,
+    <eos>) follow, then counted tokens by frequency (ties broken
+    alphabetically — the reference's ordering), capped at
+    ``most_freq_count`` and filtered by ``min_freq``."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens:
+                raise ValueError("unknown_token must not be in "
+                                 "reserved_tokens")
+            if len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens) if reserved_tokens
+                                 else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter)
+        unknown_and_reserved = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = (len(pairs) if most_freq_count is None
+                  else most_freq_count)
+        for token, freq in pairs:
+            if budget <= 0:
+                break
+            if freq < min_freq:
+                break  # sorted by freq: nothing later qualifies
+            if token in unknown_and_reserved:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s); raises on out-of-range."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"token index {i} out of range "
+                                 f"[0, {len(self._idx_to_token)})")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks

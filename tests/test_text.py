@@ -1,0 +1,176 @@
+"""mx.contrib.text: vocabulary + embeddings (reference
+tests/python/unittest/test_contrib_text.py)."""
+import collections
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import text
+from incubator_mxnet_tpu.contrib.text import embedding as emb
+
+
+def test_count_tokens_from_str():
+    c = text.count_tokens_from_str("Life is great!\nlife is good.\n")
+    assert c["is"] == 2 and c["Life"] == 1 and c["life"] == 1
+    c2 = text.count_tokens_from_str("Life is great!\nlife is good.\n",
+                                    to_lower=True)
+    assert c2["life"] == 2
+    base = collections.Counter({"is": 10})
+    c3 = text.count_tokens_from_str("is it", counter_to_update=base)
+    assert c3["is"] == 11 and c3["it"] == 1
+
+
+def test_vocabulary_ordering_and_limits():
+    counter = collections.Counter(
+        {"c": 5, "b": 5, "a": 3, "rare": 1, "x": 2})
+    v = text.Vocabulary(counter, most_freq_count=3, min_freq=2,
+                        reserved_tokens=["<pad>"])
+    # 0=<unk>, 1=<pad>, then by (-freq, token): b, c, a
+    assert v.idx_to_token == ["<unk>", "<pad>", "b", "c", "a"]
+    assert len(v) == 5
+    assert v.to_indices("b") == 2
+    assert v.to_indices(["zzz", "a"]) == [0, 4]
+    assert v.to_tokens([0, 3]) == ["<unk>", "c"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+    assert v.unknown_token == "<unk>" and v.reserved_tokens == ["<pad>"]
+
+
+def test_vocabulary_validation():
+    with pytest.raises(ValueError):
+        text.Vocabulary(min_freq=0)
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+
+
+def _vec_file(tmp_path, name="vecs.txt", header=False):
+    lines = []
+    if header:
+        lines.append("3 4")
+    lines += ["hello 1 2 3 4",
+              "world 5 6 7 8",
+              "tpu 9 10 11 12"]
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_custom_embedding_loads_and_queries(tmp_path):
+    e = emb.CustomEmbedding(_vec_file(tmp_path))
+    assert len(e) == 4 and e.vec_len == 4  # + <unk> row 0
+    v = e.get_vecs_by_tokens("world")
+    np.testing.assert_allclose(v.asnumpy(), [5, 6, 7, 8])
+    both = e.get_vecs_by_tokens(["tpu", "nope"])
+    np.testing.assert_allclose(both.asnumpy()[0], [9, 10, 11, 12])
+    np.testing.assert_allclose(both.asnumpy()[1], np.zeros(4))
+    assert e.to_indices("hello") == 1
+    assert e.to_tokens(2) == "world"
+    # lower_case_backup
+    v2 = e.get_vecs_by_tokens("HELLO", lower_case_backup=True)
+    np.testing.assert_allclose(v2.asnumpy(), [1, 2, 3, 4])
+
+
+def test_fasttext_header_line_skipped(tmp_path):
+    e = emb.CustomEmbedding(_vec_file(tmp_path, header=True))
+    assert len(e) == 4 and e.vec_len == 4
+
+
+def test_embedding_malformed_lines_skipped(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("good 1 2 3\nshort 1\nnotfloat a b c\ngood 9 9 9\n"
+                 "fine 4 5 6\n")
+    e = emb.CustomEmbedding(str(p))
+    # good (first), fine; duplicate + malformed skipped
+    assert sorted(e.token_to_idx) == ["<unk>", "fine", "good"]
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("good").asnumpy(), [1, 2, 3])
+
+
+def test_update_token_vectors(tmp_path):
+    e = emb.CustomEmbedding(_vec_file(tmp_path))
+    e.update_token_vectors("hello", nd.array(np.full((1, 4), 7.0)))
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("hello").asnumpy(), np.full(4, 7.0))
+    with pytest.raises(ValueError):
+        e.update_token_vectors("absent", nd.array(np.zeros((1, 4))))
+
+
+def test_embedding_with_vocabulary_reindex(tmp_path):
+    counter = collections.Counter({"world": 3, "unseen": 2})
+    v = text.Vocabulary(counter)
+    e = emb.CustomEmbedding(_vec_file(tmp_path), vocabulary=v)
+    assert e.idx_to_token == v.idx_to_token
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("world").asnumpy(), [5, 6, 7, 8])
+    # in-vocab but not in the file -> unknown vector
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("unseen").asnumpy(), np.zeros(4))
+
+
+def test_composite_embedding(tmp_path):
+    e1 = emb.CustomEmbedding(_vec_file(tmp_path, "a.txt"))
+    p = tmp_path / "b.txt"
+    p.write_text("world 100 200\nhello 300 400\n")
+    e2 = emb.CustomEmbedding(str(p))
+    v = text.Vocabulary(collections.Counter({"hello": 2, "world": 1}))
+    comp = emb.CompositeEmbedding(v, [e1, e2])
+    assert comp.vec_len == 6
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("world").asnumpy(),
+        [5, 6, 7, 8, 100, 200])
+
+
+def test_registry_and_pretrained_errors(tmp_path):
+    names = emb.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in emb.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        emb.get_pretrained_file_names("word2vec")
+    # no-egress: no local file -> documented OSError
+    with pytest.raises(OSError, match="egress"):
+        emb.create("glove", pretrained_file_name="glove.6B.50d.txt")
+    # but a local file works through the registry
+    e = emb.create("glove", pretrained_file_path=_vec_file(tmp_path))
+    assert e.vec_len == 4
+    with pytest.raises(OSError, match="not found"):
+        emb.CustomEmbedding(str(tmp_path / "missing.txt"))
+
+
+def test_embedding_feeds_gluon_embedding_layer(tmp_path):
+    from incubator_mxnet_tpu import gluon
+    e = emb.CustomEmbedding(_vec_file(tmp_path))
+    layer = gluon.nn.Embedding(len(e), e.vec_len)
+    layer.initialize()
+    layer(nd.array(np.array([0.0])))  # materialize
+    layer.weight.set_data(e.idx_to_vec)
+    out = layer(nd.array(np.array([e.to_indices("tpu")], np.float32)))
+    np.testing.assert_allclose(out.asnumpy()[0], [9, 10, 11, 12])
+
+
+def test_malformed_first_line_does_not_poison_dim(tmp_path):
+    p = tmp_path / "poison.txt"
+    p.write_text("word a b c\nhello 1 2 3 4\nworld 5 6 7 8\n")
+    e = emb.CustomEmbedding(str(p))
+    # the bad 3-elem line must not define dim; the 4-d vectors load
+    assert e.vec_len == 4 and len(e) == 3
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3, 4])
+
+
+def test_count_tokens_regex_metachar_delims():
+    c = text.count_tokens_from_str("a.b c", token_delim=".")
+    assert c == collections.Counter({"a": 1, "b c": 1})
+    c2 = text.count_tokens_from_str("x|y|x", token_delim="|")
+    assert c2["x"] == 2 and c2["y"] == 1
+
+
+def test_registered_custom_embedding_listed():
+    @emb.register
+    class MyEmb(emb.CustomEmbedding):
+        pretrained_file_names = ("my.vec",)
+
+    names = emb.get_pretrained_file_names()
+    assert names.get("myemb") == ["my.vec"]

@@ -36,6 +36,8 @@ MODULES = [
     ("incubator_mxnet_tpu.gluon.contrib.nn", "mx.gluon.contrib.nn"),
     ("incubator_mxnet_tpu.gluon.contrib.rnn", "mx.gluon.contrib.rnn"),
     ("incubator_mxnet_tpu.gluon.symbolize", "gluon.symbolize (TPU-first)"),
+    ("incubator_mxnet_tpu.gluon.contrib.estimator",
+     "mx.gluon.contrib.estimator"),
     ("incubator_mxnet_tpu.optimizer", "mx.optimizer"),
     ("incubator_mxnet_tpu.optimizer.lr_scheduler", "mx.lr_scheduler"),
     ("incubator_mxnet_tpu.initializer", "mx.init"),
@@ -52,6 +54,9 @@ MODULES = [
     ("incubator_mxnet_tpu.amp", "mx.amp"),
     ("incubator_mxnet_tpu.contrib.quantization", "contrib.quantization"),
     ("incubator_mxnet_tpu.contrib.onnx", "contrib.onnx"),
+    ("incubator_mxnet_tpu.contrib.text", "contrib.text (vocab)"),
+    ("incubator_mxnet_tpu.contrib.text.embedding",
+     "contrib.text.embedding"),
     ("incubator_mxnet_tpu.callback", "mx.callback"),
     ("incubator_mxnet_tpu.monitor", "mx.monitor"),
     ("incubator_mxnet_tpu.visualization", "mx.viz"),
