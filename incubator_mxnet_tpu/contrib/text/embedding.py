@@ -15,6 +15,7 @@ import numpy as np
 
 from ... import ndarray as nd
 from ...base import _Registry
+from .vocab import TokenIndexMixin
 
 __all__ = ["register", "create", "get_pretrained_file_names",
            "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
@@ -49,7 +50,17 @@ def get_pretrained_file_names(embedding_name=None):
     return known[name]
 
 
-class TokenEmbedding:
+def _gather_rows(rows, tok2idx, tokens):
+    """(len(tokens), D) matrix of each token's vector from ``rows``
+    (unknown → row 0). The one definition of token→row lookup shared by
+    vocabulary re-indexing and CompositeEmbedding."""
+    out = np.zeros((len(tokens), rows.shape[1]), np.float32)
+    for i, tok in enumerate(tokens):
+        out[i] = rows[tok2idx.get(tok, 0)]
+    return out
+
+
+class TokenEmbedding(TokenIndexMixin):
     """Base: an index of tokens with a dense vector per token.
 
     ``vocabulary`` (optional) re-indexes the loaded vectors against a
@@ -92,6 +103,10 @@ class TokenEmbedding:
                 if ln_no == 1 and len(parts) == 2:
                     continue  # fastText header line: "<count> <dim>"
                 token, elems = parts[0], parts[1:]
+                if not elems:
+                    # blank/vector-less line: must not commit dim=0
+                    log.warning("%s:%d skipped (no vector)", path, ln_no)
+                    continue
                 if dim is not None and len(elems) != dim:
                     log.warning("%s:%d skipped (bad length)", path, ln_no)
                     continue
@@ -121,12 +136,8 @@ class TokenEmbedding:
         self._set_idx_to_vec(np.vstack([unk[None, :]] + vecs))
 
     def _reindex_to_vocabulary(self, vocabulary):
-        old_tok2idx = dict(self._token_to_idx)
-        old = self._idx_to_vec_np
-        dim = old.shape[1]
-        rows = np.zeros((len(vocabulary), dim), np.float32)
-        for i, tok in enumerate(vocabulary.idx_to_token):
-            rows[i] = old[old_tok2idx.get(tok, 0)]
+        rows = _gather_rows(self._idx_to_vec_np, self._token_to_idx,
+                            vocabulary.idx_to_token)
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._token_to_idx = dict(vocabulary.token_to_idx)
         self._set_idx_to_vec(rows)
@@ -154,21 +165,6 @@ class TokenEmbedding:
     @property
     def unknown_token(self):
         return self._unknown_token
-
-    def to_indices(self, tokens):
-        single = isinstance(tokens, str)
-        toks = [tokens] if single else tokens
-        idx = [self._token_to_idx.get(t, 0) for t in toks]
-        return idx[0] if single else idx
-
-    def to_tokens(self, indices):
-        single = isinstance(indices, int)
-        idxs = [indices] if single else indices
-        for i in idxs:
-            if not 0 <= i < len(self._idx_to_token):
-                raise ValueError(f"token index {i} out of range")
-        toks = [self._idx_to_token[i] for i in idxs]
-        return toks[0] if single else toks
 
     def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
         """Vectors for token(s); unknown tokens get the unknown vector."""
@@ -261,12 +257,6 @@ class CompositeEmbedding(TokenEmbedding):
                 else [token_embeddings])
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._token_to_idx = dict(vocabulary.token_to_idx)
-        parts = []
-        for emb in embs:
-            rows = emb._idx_to_vec_np
-            tok2idx = emb.token_to_idx
-            block = np.zeros((len(vocabulary), rows.shape[1]), np.float32)
-            for i, tok in enumerate(self._idx_to_token):
-                block[i] = rows[tok2idx.get(tok, 0)]
-            parts.append(block)
+        parts = [_gather_rows(emb._idx_to_vec_np, emb.token_to_idx,
+                              self._idx_to_token) for emb in embs]
         self._set_idx_to_vec(np.concatenate(parts, axis=1))

@@ -5,10 +5,35 @@ one_hot on device; nothing here touches the chip.
 """
 import collections
 
-__all__ = ["Vocabulary"]
+__all__ = ["Vocabulary", "TokenIndexMixin"]
 
 
-class Vocabulary:
+class TokenIndexMixin:
+    """Shared token↔index semantics for Vocabulary and TokenEmbedding:
+    requires ``self._token_to_idx`` / ``self._idx_to_token``; unknown
+    tokens map to index 0."""
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s); raises on out-of-range."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"token index {i} out of range "
+                                 f"[0, {len(self._idx_to_token)})")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks
+
+
+class Vocabulary(TokenIndexMixin):
     """Token index built from a ``collections.Counter``.
 
     Index 0 is ``unknown_token``; ``reserved_tokens`` (e.g. <pad>, <bos>,
@@ -69,22 +94,3 @@ class Vocabulary:
     @property
     def reserved_tokens(self):
         return self._reserved_tokens
-
-    def to_indices(self, tokens):
-        """Token(s) → index/indices; unknown tokens map to index 0."""
-        single = isinstance(tokens, str)
-        toks = [tokens] if single else tokens
-        idx = [self._token_to_idx.get(t, 0) for t in toks]
-        return idx[0] if single else idx
-
-    def to_tokens(self, indices):
-        """Index/indices → token(s); raises on out-of-range."""
-        single = isinstance(indices, int)
-        idxs = [indices] if single else indices
-        toks = []
-        for i in idxs:
-            if not 0 <= i < len(self._idx_to_token):
-                raise ValueError(f"token index {i} out of range "
-                                 f"[0, {len(self._idx_to_token)})")
-            toks.append(self._idx_to_token[i])
-        return toks[0] if single else toks

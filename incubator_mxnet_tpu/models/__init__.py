@@ -19,6 +19,8 @@ from .bert import (BERTModel, BERTForPretrain, BERTPretrainLoss,
                    get_bert_model, bert_12_768_12, bert_24_1024_16)
 from .ssd import (SSD, SSDLoss, ssd_512_resnet18_v1, ssd_512_resnet50_v1,
                   ssd_300_resnet18_v1)
+from .transformer_lm import (TransformerLM, lm_loss, transformer_lm_small,
+                             transformer_lm_base)
 
 _MODELS = {}
 for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
@@ -35,7 +37,8 @@ for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
               "inception_v3",
               "bert_12_768_12", "bert_24_1024_16",
               "ssd_512_resnet18_v1", "ssd_512_resnet50_v1",
-              "ssd_300_resnet18_v1"]:
+              "ssd_300_resnet18_v1",
+              "transformer_lm_small", "transformer_lm_base"]:
     _MODELS[_name] = globals()[_name]
 
 

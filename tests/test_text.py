@@ -168,9 +168,21 @@ def test_count_tokens_regex_metachar_delims():
 
 
 def test_registered_custom_embedding_listed():
-    @emb.register
-    class MyEmb(emb.CustomEmbedding):
-        pretrained_file_names = ("my.vec",)
+    try:
+        @emb.register
+        class MyEmb(emb.CustomEmbedding):
+            pretrained_file_names = ("my.vec",)
 
-    names = emb.get_pretrained_file_names()
-    assert names.get("myemb") == ["my.vec"]
+        names = emb.get_pretrained_file_names()
+        assert names.get("myemb") == ["my.vec"]
+    finally:
+        emb._REG._map.pop("myemb", None)  # keep the registry test-order-safe
+
+
+def test_blank_first_line_does_not_poison_dim(tmp_path):
+    p = tmp_path / "blank.txt"
+    p.write_text("\nhello 1 2 3 4\nworld 5 6 7 8\n")
+    e = emb.CustomEmbedding(str(p))
+    assert e.vec_len == 4 and len(e) == 3
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("world").asnumpy(), [5, 6, 7, 8])
