@@ -226,8 +226,42 @@ def _build_ssd(batch, dtype):
     return net, loss_fn, x, y, 3 * 30e9, "ssd512_voc"
 
 
+def _build_transformer_lm(batch, dtype):
+    """Causal-LM step (GPT-2-base scale by default): fused-QKV causal
+    flash attention, tied head, shifted-CE loss."""
+    from incubator_mxnet_tpu.models import TransformerLM
+    from incubator_mxnet_tpu.models.transformer_lm import lm_loss
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    layers = int(os.environ.get("BENCH_LAYERS", "12"))
+    units = int(os.environ.get("BENCH_UNITS", "768"))
+    if units < 64 or units % 64:
+        raise ValueError(f"BENCH_UNITS={units} must be a multiple of 64 "
+                         "(64 dims per attention head)")
+    vocab = 50257
+    # dropout 0 by default: attention-weight dropout forces the dense
+    # O(L^2) softmax path (ops/_raw.py) and the throughput bench should
+    # measure the flash kernel; BENCH_DROPOUT restores training realism
+    net = TransformerLM(vocab, num_layers=layers, units=units,
+                        hidden_size=4 * units, num_heads=units // 64,
+                        max_length=seq,
+                        dropout=float(os.environ.get("BENCH_DROPOUT", "0")))
+    net.initialize(init=mx.init.Normal(0.02))
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    x = nd.array(np.random.randint(0, vocab, (batch, seq)))
+
+    def loss_fn(logits, y):
+        return lm_loss(logits, y).mean()
+
+    # ~6 * params_per_block flops per token per pass; fwd+bwd = 3x fwd.
+    # block params ~= 12 * units^2; embeddings excluded (gather-bound)
+    flops_per_sample = 3 * 2 * 12 * units * units * seq * layers
+    return net, loss_fn, x, x, flops_per_sample, f"gpt_{units}_seq{seq}"
+
+
 _BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
-                 "lenet": _build_lenet, "ssd": _build_ssd}
+                 "lenet": _build_lenet, "ssd": _build_ssd,
+                 "transformer_lm": _build_transformer_lm}
 
 
 class _CastNorm(gluon.nn.HybridBlock):
@@ -406,7 +440,7 @@ def main():
                          f"{sorted(_BENCH_MODELS)}")
     try:
         default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
-                         "ssd": "16"}[model]
+                         "ssd": "16", "transformer_lm": "16"}[model]
     except KeyError:
         raise ValueError(f"BENCH_MODEL {model!r} has no default batch; "
                          f"set BENCH_BATCH explicitly")
