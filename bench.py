@@ -254,8 +254,11 @@ def _build_transformer_lm(batch, dtype):
         return lm_loss(logits, y).mean()
 
     # ~6 * params_per_block flops per token per pass; fwd+bwd = 3x fwd.
-    # block params ~= 12 * units^2; embeddings excluded (gather-bound)
-    flops_per_sample = 3 * 2 * 12 * units * units * seq * layers
+    # block params ~= 12 * units^2. The tied-head logits matmul is a
+    # DENSE (units, vocab) GEMM per token and must be counted (~30% of
+    # total at base config); only the input-embedding gather is excluded.
+    flops_per_sample = (3 * 2 * 12 * units * units * seq * layers
+                        + 3 * 2 * seq * units * vocab)
     return net, loss_fn, x, x, flops_per_sample, f"gpt_{units}_seq{seq}"
 
 
@@ -520,8 +523,14 @@ def main():
 
     # BENCH_K > 1: dispatch k micro-steps as ONE XLA program (lax.scan in
     # FusedTrainStep.run_k) — amortizes the per-step relay/host dispatch
-    # latency, the dominant cost through the axon tunnel.
-    k = int(os.environ.get("BENCH_K", "1"))
+    # latency, the dominant cost through the axon tunnel. Default 8 for
+    # the headline resnet50 config: the only chip datapoint (r02, 80 ms/
+    # step @ b128 ≈ 10% MFU vs a ~26 ms compute-bound step) points at
+    # dispatch latency, which the scan amortizes ~k-fold; the scan body
+    # compiles once so the extra cost is one bounded compile. BENCH_K=1
+    # restores per-step dispatch.
+    k = int(os.environ.get("BENCH_K",
+                           "8" if model == "resnet50" else "1"))
     if k > 1:
         import jax.numpy as jnp
         xs = jnp.broadcast_to(x._data, (k,) + x._data.shape)

@@ -32,9 +32,28 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
          local_device_ids=None):
     """Form the multi-host cluster (parity: the reference launcher's
     scheduler rendezvous). No-op when already initialized or single-host
-    with no coordinator given."""
+    with no coordinator given.
+
+    Arguments default from the MXTPU_COORDINATOR / MXTPU_NUM_PROCESSES /
+    MXTPU_PROCESS_ID environment (set by tools/launch.py, the analogue of
+    the reference launcher's DMLC_* variables), so an unmodified training
+    script that calls ``mx.distributed.init()`` works under the
+    launcher."""
     if _state["initialized"]:
         return
+    import os
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        # env applies only as a COMPLETE set — a partial/leaked variable
+        # (e.g. a stray MXTPU_NUM_PROCESSES) must not reroute a plain
+        # single-host init() into a hard-crashing explicit rendezvous
+        env_vals = [os.environ.get(k, "") for k in
+                    ("MXTPU_COORDINATOR", "MXTPU_NUM_PROCESSES",
+                     "MXTPU_PROCESS_ID")]
+        if all(env_vals):
+            coordinator_address = env_vals[0]
+            num_processes = int(env_vals[1])
+            process_id = int(env_vals[2])
     if coordinator_address is None and num_processes is None:
         # single-host or TPU-pod auto-discovery; jax treats absent args as
         # "use the runtime's own metadata" and works standalone too

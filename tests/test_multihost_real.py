@@ -98,3 +98,65 @@ def test_four_process_cluster():
     for w in ws[1:]:
         np.testing.assert_allclose(np.array([float(v) for v in w]), ref,
                                    rtol=1e-6)
+
+
+def test_launch_py_runs_local_cluster():
+    """tools/launch.py (the reference launcher's analogue) spawns N local
+    workers whose unmodified `mx.distributed.init()` picks the cluster up
+    from the MXTPU_* env it sets."""
+    launcher = os.path.join(_HERE, "..", "tools", "launch.py")
+    script = (
+        "import os;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=2';"
+        "import jax;"
+        "jax.config.update('jax_platforms', 'cpu');"
+        "import incubator_mxnet_tpu as mx;"
+        "mx.distributed.init();"
+        "assert mx.distributed.is_initialized();"
+        "n=mx.distributed.num_workers();"
+        "r=mx.distributed.rank();"
+        "print('RANK', r, 'OF', n, 'DEVS', len(jax.devices()))")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "--",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.join(_HERE, ".."))
+    assert r.returncode == 0, f"launcher rc={r.returncode}\n{r.stdout}\n{r.stderr}"
+    lines = sorted(ln for ln in r.stdout.splitlines() if "RANK" in ln)
+    # both ranks formed one 2-process cluster spanning 4 CPU devices
+    assert len(lines) == 2, r.stdout
+    assert "RANK 0 OF 2 DEVS 4" in lines[0]
+    assert "RANK 1 OF 2 DEVS 4" in lines[1]
+
+
+def test_launch_py_fail_fast_on_worker_crash():
+    """A crashing worker must terminate the rest promptly (not hang the
+    job in rank-order waits)."""
+    launcher = os.path.join(_HERE, "..", "tools", "launch.py")
+    # rank 1 exits rc=3 immediately; rank 0 would sleep for 300s
+    script = ("import os,sys,time;"
+              "r=int(os.environ['MXTPU_PROCESS_ID']);"
+              "sys.exit(3) if r==1 else time.sleep(300)")
+    import time as _t
+    t0 = _t.time()
+    r = subprocess.run(
+        [sys.executable, launcher, "-n", "2", "--",
+         sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3, (r.returncode, r.stderr)
+    assert _t.time() - t0 < 60, "launcher failed to fail fast"
+    assert "worker 1 exited rc=3" in r.stderr
+
+
+def test_distributed_init_ignores_partial_env(monkeypatch):
+    """A stray MXTPU_NUM_PROCESSES (no coordinator) must not reroute a
+    plain single-host init() into an explicit rendezvous crash."""
+    import incubator_mxnet_tpu as mx
+    monkeypatch.setenv("MXTPU_NUM_PROCESSES", "1")
+    monkeypatch.delenv("MXTPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("MXTPU_PROCESS_ID", raising=False)
+    assert not mx.distributed.is_initialized()
+    mx.distributed.init()  # must not raise

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Multi-worker job launcher (parity: the reference's tools/launch.py,
+which starts DMLC/ps-lite workers over ssh with DMLC_* env vars).
+
+TPU-native contract: every worker runs the SAME SPMD program and calls
+``mx.distributed.init()``, which reads the MXTPU_COORDINATOR /
+MXTPU_NUM_PROCESSES / MXTPU_PROCESS_ID variables this launcher sets —
+the analogue of the reference's DMLC_PS_ROOT_URI / DMLC_NUM_WORKER /
+DMLC_WORKER_ID. After init, ``jax.devices()`` spans the cluster and one
+``Mesh`` provides the collectives (no scheduler/server processes: the
+reference's ps-lite topology has no TPU analogue).
+
+Local mode (default) spawns -n worker processes on this machine —
+useful for multi-process testing and for machines exposing several
+accelerator processes. With -H HOSTFILE, workers start over ssh, one
+per host line (passwordless ssh assumed, like the reference launcher).
+
+Examples:
+  python tools/launch.py -n 4 python train.py --epochs 1
+  python tools/launch.py -n 8 -H hosts.txt --env FOO=1 python train.py
+"""
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stream(proc, rank, out=sys.stdout):
+    """Prefix each worker line with its rank (reference launcher does the
+    same so interleaved logs stay attributable)."""
+    for line in proc.stdout:
+        out.write(f"[{rank}] {line}")
+        out.flush()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="launch N distributed workers (local or ssh)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-H", "--hostfile",
+                    help="file with one host per line -> ssh mode")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank 0 (default: this host, a free "
+                         "port)")
+    ap.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="extra env for every worker")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the training command (every worker runs it)")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    command = args.command[1:] if args.command[0] == "--" else args.command
+    n = args.num_workers
+
+    extra = {}
+    for kv in args.env:
+        if "=" not in kv:
+            ap.error(f"--env expects K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        extra[k] = v
+
+    hosts = None
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [ln.strip() for ln in f if ln.strip()
+                     and not ln.startswith("#")]
+        if len(hosts) < n:
+            sys.exit(f"hostfile has {len(hosts)} hosts < -n {n}")
+
+    if args.coordinator:
+        coordinator = args.coordinator
+    elif hosts:
+        coordinator = f"{hosts[0]}:{_free_port()}"
+    else:
+        coordinator = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    threads = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(extra)
+        env.update({"MXTPU_COORDINATOR": coordinator,
+                    "MXTPU_NUM_PROCESSES": str(n),
+                    "MXTPU_PROCESS_ID": str(rank)})
+        if hosts:
+            # reference-style ssh fanout: env rides the remote command line
+            envs = " ".join(f"{k}={shlex.quote(v)}"
+                            for k, v in sorted(env.items())
+                            if k.startswith("MXTPU_") or k in extra)
+            remote = f"cd {shlex.quote(os.getcwd())} && {envs} " + " ".join(
+                shlex.quote(c) for c in command)
+            # -tt allocates a pty so terminating the local ssh client
+            # HUPs the remote worker too (otherwise remote pythons orphan
+            # and hold their chips when a peer fails or the operator ^Cs)
+            p = subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes",
+                                  hosts[rank], remote],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+        else:
+            p = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, rank), daemon=True)
+        t.start()
+        threads.append(t)
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    # fail fast: poll ALL workers — waiting in rank order would let a
+    # crashed high-rank worker strand the others in their next collective
+    # (holding accelerators) before the launcher ever noticed
+    import time
+    rc = 0
+    live = set(range(n))
+    while live:
+        for rank in sorted(live):
+            p = procs[rank]
+            if p.poll() is not None:
+                live.discard(rank)
+                if p.returncode != 0:
+                    print(f"launch: worker {rank} exited "
+                          f"rc={p.returncode}; terminating the rest",
+                          file=sys.stderr)
+                    rc = rc or p.returncode
+                    _terminate()
+        if live:
+            time.sleep(0.2)
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,7 +151,9 @@ def main():
         return res
 
     steps = 20
-    base = {"BENCH_STEPS": steps}
+    # pin K: bench.py defaults resnet50 to BENCH_K=8, but the sweep
+    # isolates K explicitly per config
+    base = {"BENCH_STEPS": steps, "BENCH_K": 1}
     aborted = False
     # 1) dispatch-vs-compute: K sweep at the round-2 config (b128, already
     #    the cheapest compile; K=1 first so the base step compiles alone)
