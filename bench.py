@@ -253,11 +253,13 @@ def _build_transformer_lm(batch, dtype):
     def loss_fn(logits, y):
         return lm_loss(logits, y).mean()
 
-    # ~6 * params_per_block flops per token per pass; fwd+bwd = 3x fwd.
-    # block params ~= 12 * units^2. The tied-head logits matmul is a
-    # DENSE (units, vocab) GEMM per token and must be counted (~30% of
-    # total at base config); only the input-embedding gather is excluded.
-    flops_per_sample = (3 * 2 * 12 * units * units * seq * layers
+    # fwd+bwd = 3x fwd. Per layer per sample: 6*params (block params
+    # ~= 12*units^2 GEMMs) + the attention score/value matmuls
+    # (QK^T + AV: 2 * 2*L^2*units). Plus the tied-head logits GEMM
+    # (units x vocab per token — dense, ~30% of total at base config).
+    # Only the input-embedding gather is excluded.
+    flops_per_sample = (3 * (2 * 12 * units * units * seq
+                             + 4 * seq * seq * units) * layers
                         + 3 * 2 * seq * units * vocab)
     return net, loss_fn, x, x, flops_per_sample, f"gpt_{units}_seq{seq}"
 

@@ -91,11 +91,13 @@ class MultiHeadAttentionCell(HybridBlock):
                                           self._dropout)
         return self.proj(out)
 
-    def _ring_core(self, q, k, v):
+    def _ring_core(self, q, k, v, causal=False):
         """Long-context core: sequence dim sharded over the mesh 'sp' axis.
         scheme "ring" rotates KV blocks over ICI
         (parallel/ring_attention.py); "ulysses" trades the sequence shard
-        for a head shard with two all-to-alls (parallel/ulysses.py)."""
+        for a head shard with two all-to-alls (parallel/ulysses.py). Both
+        cores are position-aware, so causal masking stays exact across
+        sequence shards (the causal-LM subclass passes causal=True)."""
         from ..parallel import ring_attention, ulysses_attention
         mesh, axis = self._ring[0], self._ring[1]
         scheme = self._ring[2] if len(self._ring) > 2 else "ring"
@@ -110,7 +112,8 @@ class MultiHeadAttentionCell(HybridBlock):
             def split(t):
                 return t.reshape(b, L, heads, hd).transpose(0, 2, 1, 3)
 
-            o = core(split(qr), split(kr), split(vr), mesh, axis)
+            o = core(split(qr), split(kr), split(vr), mesh, axis,
+                     causal=causal)
             return o.transpose(0, 2, 1, 3).reshape(b, L, d)
         return _apply(f, [q, k, v], name=scheme + "_self_attention")
 

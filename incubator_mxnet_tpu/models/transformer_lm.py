@@ -44,9 +44,15 @@ class CausalSelfAttention(MultiHeadAttentionCell):
         if mask is not None:
             raise ValueError("causal attention builds its own mask")
         q, k, v = nd.split(self.qkv(x), 3, axis=-1)
-        out = ops.multihead_attention(q, k, v, self._num_heads,
-                                      dropout_rate=self._dropout,
-                                      causal=True)
+        if self._ring is not None:
+            # sequence-parallel long-context training: ring / ulysses
+            # cores are position-aware, so causality is exact across
+            # sequence shards
+            out = self._ring_core(q, k, v, causal=True)
+        else:
+            out = ops.multihead_attention(q, k, v, self._num_heads,
+                                          dropout_rate=self._dropout,
+                                          causal=True)
         return self.proj(out)
 
     def forward_step(self, x_t, k_cache, v_cache, pos, pos_mask):
@@ -71,10 +77,12 @@ class TransformerLMCell(HybridBlock):
     """Pre-LN decoder block: LN→causal-MHA→residual, LN→FFN→residual."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 weight_initializer=None, prefix=None, params=None):
+                 weight_initializer=None, ring=None, prefix=None,
+                 params=None):
         super().__init__(prefix, params)
         self.attention = CausalSelfAttention(
-            units, num_heads, dropout, weight_initializer=weight_initializer)
+            units, num_heads, dropout, weight_initializer=weight_initializer,
+            ring=ring)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout,
                                    weight_initializer=weight_initializer)
         self.dropout = nn.Dropout(dropout)
@@ -102,17 +110,19 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size, num_layers=2, units=128,
                  hidden_size=512, num_heads=4, max_length=512, dropout=0.0,
-                 tie_weights=True, prefix=None, params=None):
+                 tie_weights=True, ring=None, prefix=None, params=None):
         super().__init__(prefix, params)
         self._units = units
         self._max_length = max_length
         self._vocab_size = vocab_size
         self._tie = tie_weights
+        self._ring = ring
         self.embedding = nn.Embedding(vocab_size, units)
         self.pos_embedding = nn.Embedding(max_length, units)
         self.layers = []
         for i in range(num_layers):
-            cell = TransformerLMCell(units, hidden_size, num_heads, dropout)
+            cell = TransformerLMCell(units, hidden_size, num_heads, dropout,
+                                     ring=ring)
             self.register_child(cell, f"layer{i}")
             self.layers.append(cell)
         self.ln_f = nn.LayerNorm(in_channels=units)
@@ -179,6 +189,12 @@ class TransformerLM(HybridBlock):
         Prefill runs ONE full causal pass (flash path) and fills the
         caches; each subsequent token is a fixed-shape one-step call.
         Returns (B, Lp + max_new_tokens) token ids."""
+        if self._ring is not None:
+            raise ValueError(
+                "generate() decodes single-device; build the model without "
+                "ring= for inference (sequence parallelism is a training "
+                "configuration — load the same parameters into a dense "
+                "model)")
         prompt = nd.array(prompt) if not isinstance(prompt, nd.NDArray) \
             else prompt
         b, lp = prompt.shape

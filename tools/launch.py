@@ -74,15 +74,23 @@ def main(argv=None):
     hosts = None
     if args.hostfile:
         with open(args.hostfile) as f:
-            hosts = [ln.strip() for ln in f if ln.strip()
-                     and not ln.startswith("#")]
+            hosts = [h for h in (ln.strip() for ln in f)
+                     if h and not h.startswith("#")]
         if len(hosts) < n:
             sys.exit(f"hostfile has {len(hosts)} hosts < -n {n}")
 
     if args.coordinator:
         coordinator = args.coordinator
     elif hosts:
-        coordinator = f"{hosts[0]}:{_free_port()}"
+        # the port must be free on hosts[0], which we can't probe from
+        # here — pick from a wide random range and tell the operator the
+        # authoritative fix is --coordinator host0:port
+        import random
+        port = random.randint(20000, 59999)
+        coordinator = f"{hosts[0]}:{port}"
+        print(f"launch: coordinator {coordinator} (random port; pass "
+              "--coordinator to pin one known-free on that host)",
+              file=sys.stderr)
     else:
         coordinator = f"127.0.0.1:{_free_port()}"
 
