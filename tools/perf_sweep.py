@@ -192,9 +192,11 @@ def main():
     if results and not aborted and probe():
         for cfg in ([{"BENCH_MODEL": "bert"}] if quick else
                     [{"BENCH_MODEL": "bert"},
-                     {"BENCH_MODEL": "bert", "BENCH_K": 8}]):
+                     {"BENCH_MODEL": "bert", "BENCH_K": 8},
+                     {"BENCH_MODEL": "transformer_lm"},
+                     {"BENCH_MODEL": "transformer_lm", "BENCH_K": 8}]):
             if record({**base, **cfg}) is None:
-                log("aborting BERT stage (unhealthy run)")
+                log("aborting model stage (unhealthy run)")
                 break
 
     if not results:
