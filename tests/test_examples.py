@@ -73,3 +73,9 @@ def test_example_quantize_inference():
 def test_example_onnx():
     out = _run("onnx_export_import.py", "--steps", "5")
     assert "OK: ONNX round trip preserves predictions" in out
+
+
+@pytest.mark.slow
+def test_example_train_lm():
+    out = _run("train_lm.py", "--steps", "60")
+    assert "greedy :" in out and "loss" in out

@@ -183,12 +183,14 @@ def main():
                 aborted = True
                 break
 
-    # 3) BERT (BASELINE config 2; first-ever chip number for this model —
-    #    VERDICT r3 next-step #4). Flash attention pays here; default
-    #    batch from bench.py, one K variant. HARD RULE: any earlier
-    #    timeout means the tunnel is presumed unhealthy — a fresh BERT
-    #    compile on a sick tunnel is exactly the round-2 wedge; the tiny
-    #    probe is not sufficient clearance after an abort.
+    # 3) model stage: BERT (BASELINE config 2; first-ever chip number —
+    #    VERDICT r3 next-step #4) then transformer_lm (the causal-LM
+    #    family's first chip number). Flash attention pays in both;
+    #    default batches from bench.py, one K variant each. HARD RULE:
+    #    any earlier timeout means the tunnel is presumed unhealthy — a
+    #    fresh large-model compile on a sick tunnel is exactly the
+    #    round-2 wedge; the tiny probe is not sufficient clearance after
+    #    an abort.
     if results and not aborted and probe():
         for cfg in ([{"BENCH_MODEL": "bert"}] if quick else
                     [{"BENCH_MODEL": "bert"},
@@ -232,6 +234,12 @@ def main():
         lines.append(f"**BERT: {bb['_config']} → {bb['value']} "
                      f"{bb['unit']} (MFU "
                      f"{bb.get('extra', {}).get('mfu')})**")
+    lm = [r for r in results if "transformer_lm" in r["_config"]]
+    if lm:
+        lb = max(lm, key=lambda r: r["value"])
+        lines.append(f"**TransformerLM: {lb['_config']} → {lb['value']} "
+                     f"{lb['unit']} (MFU "
+                     f"{lb.get('extra', {}).get('mfu')})**")
     if pallas_res is not None:
         lines += ["",
                   "Pallas on-chip validation: "
