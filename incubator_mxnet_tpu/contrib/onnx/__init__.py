@@ -480,8 +480,35 @@ _BINOP = {"_plus": "Add", "elemwise_add": "Add", "broadcast_add": "Add",
 
 @_export(*_BINOP)
 def _exp_binop(ex, idx, node):
-    ex.add_node(_BINOP[node["op"]], ex.resolve(node), [node["name"]],
-                node["name"])
+    ins = ex.resolve(node)
+    if node["op"] == "dot":
+        # dot may carry transpose flags (sym.dot(transpose_b=True), the
+        # weight-tied LM head); MatMul alone would silently drop them
+        a = node.get("attrs") or {}
+        for flag, pos, swap_last in (("transpose_a", 0, True),
+                                     ("transpose_b", 1, False)):
+            if not a.get(flag):
+                continue
+            src_node = ex.nodes[node["inputs"][pos][0]]
+            param = ex.params.get(src_node["name"])
+            if param is None:
+                raise NotImplementedError(
+                    "ONNX export: dot with %s on a non-parameter input "
+                    "needs a static rank; restructure with an explicit "
+                    "transpose" % flag)
+            rank = len(param.shape)
+            if rank < 2:
+                continue  # dot_mx treats transpose on 1-D as a no-op
+            perm = list(range(rank))
+            if swap_last:      # lhs: swap last two (nd.dot semantics)
+                perm[-1], perm[-2] = perm[-2], perm[-1]
+            else:              # rhs: swap first two
+                perm[0], perm[1] = perm[1], perm[0]
+            tname = node["name"] + "_" + flag
+            ex.add_node("Transpose", [ins[pos]], [tname], tname,
+                        perm=perm)
+            ins[pos] = tname
+    ex.add_node(_BINOP[node["op"]], ins, [node["name"]], node["name"])
     ex.names[(idx, 0)] = node["name"]
 
 

@@ -132,15 +132,32 @@ class TransformerLM(HybridBlock):
 
     def _logits(self, h):
         if self._tie:
-            return nd.dot(h, self.embedding.weight.data().T)
+            # transpose_b (not .data().T): keeps the weight itself as the
+            # op input, so symbol tracing maps it to its parameter
+            # Variable and eager mode avoids materializing the transpose
+            return nd.dot(h, self.embedding.weight.data(),
+                          transpose_b=True)
         return self.head(h)
 
     def _embed(self, inputs, position_offset=0):
+        if not isinstance(inputs, nd.NDArray):
+            # symbol trace: positions are 0..L-1, so the first L rows of
+            # the table ARE the positional embeddings — slice_like keeps
+            # the length tied to the input, and an L > max_length bind
+            # fails the broadcast add (a gather would silently clamp)
+            if position_offset:
+                raise ValueError("symbolic trace supports "
+                                 "position_offset=0 only")
+            pos_emb = nd.slice_like(self.pos_embedding.weight.data(),
+                                    nd.swapaxes(inputs, 0, 1), axes=(0,))
+            h = (self.embedding(inputs) * float(np.sqrt(self._units))
+                 + pos_emb)
+            return self.dropout(h)
         L = inputs.shape[1]
         if position_offset + L > self._max_length:
             raise ValueError(
-                f"sequence length {position_offset + L} exceeds max_length "
-                f"{self._max_length}")
+                f"sequence length {position_offset + L} exceeds "
+                f"max_length {self._max_length}")
         pos = nd.arange(position_offset, position_offset + L)
         h = (self.embedding(inputs) * float(np.sqrt(self._units))
              + self.pos_embedding(pos))

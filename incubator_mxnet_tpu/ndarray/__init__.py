@@ -799,6 +799,8 @@ def transpose(x, axes=None):
 
 
 def swapaxes(x, a1, a2):
+    if _symbolic(x):
+        return _sym_call("swapaxes", data=x, a1=a1, a2=a2)
     return x.swapaxes(a1, a2)
 
 
@@ -1005,6 +1007,10 @@ def softmin(x, axis=-1):
 
 
 def slice_like(x, shape_like, axes=None):
+    if _symbolic(x) or _symbolic(shape_like):
+        return _sym_call("slice_like", data=x, shape_like=shape_like,
+                         axes=tuple(axes) if axes is not None else None)
+
     def f(a, b):
         idx = []
         for ax in range(a.ndim):
@@ -1240,15 +1246,13 @@ SequenceMask = sequence_mask
 
 def dot(a, b, transpose_a=False, transpose_b=False):
     """MXNet dot: contract last axis of a with first axis of b."""
-    def f(x, y):
-        if transpose_a:
-            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
-        if transpose_b:
-            y = jnp.swapaxes(y, 0, 1) if y.ndim > 1 else y
-        if x.ndim == 1 and y.ndim == 1:
-            return jnp.dot(x, y)
-        return jnp.tensordot(x, y, axes=1)
-    return _apply(f, [a, b], name="dot")
+    if _symbolic(a) or _symbolic(b):
+        return _sym_call("dot", lhs=a, rhs=b, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+    from ..ops import _raw as _raw_ops
+    return _apply(lambda x, y: _raw_ops.dot_mx(x, y, transpose_a,
+                                               transpose_b),
+                  [a, b], name="dot")
 
 
 def batch_dot(a, b, transpose_a=False, transpose_b=False):

@@ -271,6 +271,17 @@ def SliceChannel(data, num_outputs, axis=1, squeeze_axis=False):
 
 def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
                         scale=None, causal=False):
+    if _symbolic(q):
+        if dropout_rate and dropout_rate > 0.0:
+            import warnings
+            warnings.warn(
+                "symbol trace of multihead_attention drops attention-"
+                "weight dropout (the reference's symbol attention ops "
+                "carry none either); residual/FFN Dropout nodes still "
+                "honor is_train", stacklevel=3)
+        return _sym_call("multihead_attention", queries=q, keys=k, values=v,
+                         num_heads=num_heads, mask=mask, scale=scale,
+                         causal=causal)
     training = autograd.is_training()
     key = ndrandom._key() if (dropout_rate > 0.0 and training) else None
     inputs = [q, k, v] + ([mask] if mask is not None else [])
@@ -574,6 +585,9 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     """Parity: mx.nd.contrib.arange_like — arange sized by `data`'s shape
     (whole array flattened-shape when axis is None, else that axis); with
     repeat=r, r consecutive elements share a value, total size unchanged."""
+    if _symbolic(data):
+        return _sym_call("arange_like", data=data, start=start, step=step,
+                         repeat=repeat, axis=axis)
     def f(x):
         n = x.shape[axis] if axis is not None else int(np.prod(x.shape))
         if n % repeat:

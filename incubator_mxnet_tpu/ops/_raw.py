@@ -682,6 +682,20 @@ def _interp_matrix(out_len, in_len):
     return a.at[rows, i0].add(1.0 - f).at[rows, i1].add(f)
 
 
+def dot_mx(x, y, transpose_a=False, transpose_b=False):
+    """MXNet dot semantics on raw arrays: contract last axis of x with
+    first axis of y; transpose_a swaps x's last two axes, transpose_b
+    swaps y's first two. The ONE implementation behind nd.dot and the
+    symbol 'dot' op."""
+    if transpose_a:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_b:
+        y = jnp.swapaxes(y, 0, 1) if y.ndim > 1 else y
+    if x.ndim == 1 and y.ndim == 1:
+        return jnp.dot(x, y)
+    return jnp.tensordot(x, y, axes=1)
+
+
 def validate_resize_sizes(height, width, op="BilinearResize2D"):
     """Shared nd/symbol-path validation: explicit positive integer sizes
     (python ints or numpy integer scalars; bool rejected). Returns them as

@@ -126,7 +126,10 @@ register_op("log_softmax",
             ("data",))
 register_op("clip", lambda rt, a, x: jnp.clip(x, a["a_min"], a["a_max"]),
             ("data",))
-register_op("dot", lambda rt, a, x, y: jnp.dot(x, y), ("lhs", "rhs"))
+register_op("dot",
+            lambda rt, a, x, y: _raw.dot_mx(x, y, a.get("transpose_a"),
+                                            a.get("transpose_b")),
+            ("lhs", "rhs"))
 register_op("batch_dot", lambda rt, a, x, y: jnp.einsum(
     "bij,bjk->bik",
     x if not a.get("transpose_a") else jnp.swapaxes(x, -1, -2),
@@ -612,8 +615,11 @@ def clip(data=None, a_min=None, a_max=None, name=None):
     return _make_op("clip", [data], {"a_min": a_min, "a_max": a_max}, name)
 
 
-def dot(lhs=None, rhs=None, name=None):
-    return _make_op("dot", [lhs, rhs], {}, name)
+def dot(lhs=None, rhs=None, transpose_a=False, transpose_b=False,
+        name=None):
+    return _make_op("dot", [lhs, rhs],
+                    _attrs(transpose_a=bool(transpose_a) or None,
+                           transpose_b=bool(transpose_b) or None), name)
 
 
 def batch_dot(lhs=None, rhs=None, transpose_a=False, transpose_b=False,
@@ -736,9 +742,12 @@ def _attach_symbol_methods():
     if not hasattr(Symbol, "reshape"):
         Symbol.reshape = _sym_reshape
     def _sym_transpose(self, *axes):
-        # accept both NDArray spellings: x.transpose((0,2,1)) and
-        # x.transpose(0, 2, 1); bare x.transpose() reverses dims
-        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+        # accept all NDArray spellings: x.transpose((0,2,1)),
+        # x.transpose(0, 2, 1), x.transpose(None), bare x.transpose()
+        # (the None/bare forms reverse dims)
+        if len(axes) == 1 and axes[0] is None:
+            axes = ()
+        elif len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
         return globals()["transpose"](self, axes=(axes if axes else None))
     if not hasattr(Symbol, "transpose"):
@@ -1131,6 +1140,57 @@ for _n in ("ROIAlign", "BilinearResize2D", "AdaptiveAvgPooling2D",
     setattr(_sym_mod, _n, globals()[_n])
 
 
+# -- attention as a first-class symbol op (reference: the symbol-level
+#    interleaved_matmul_selfatt_* / multihead attention ops of
+#    src/operator/contrib/transformer.cc) --------------------------------
+
+def _mha_fn(rt, a, q, k, v, *rest):
+    mask = rest[0] if a.get("has_mask") else None
+    # symbol executors run inference semantics for dropout (reference
+    # symbol attention ops carry no dropout either): rate 0, no key
+    return _raw.multihead_attention(q, k, v, a["num_heads"], mask, 0.0,
+                                    None, False, a.get("scale"),
+                                    a.get("causal", False))
+
+
+register_op("multihead_attention", _mha_fn, ("queries", "keys", "values"))
+
+
+def multihead_attention(queries=None, keys=None, values=None, num_heads=1,
+                        mask=None, scale=None, causal=False, name=None):
+    ins = [queries, keys, values] + ([mask] if mask is not None else [])
+    return _make_op("multihead_attention", ins,
+                    _attrs(num_heads=int(num_heads), scale=scale,
+                           causal=bool(causal) or None,
+                           has_mask=True if mask is not None else None),
+                    name)
+
+
+_sym_mod.multihead_attention = multihead_attention
+
+
+def _arange_like_fn(rt, a, x):
+    from .. import ops as _ops_mod
+    from ..ndarray import NDArray
+    out = _ops_mod.arange_like(NDArray(x), a.get("start", 0.0),
+                               a.get("step", 1.0), a.get("repeat", 1),
+                               a.get("axis"))
+    return out._data
+
+
+register_op("arange_like", _arange_like_fn, ("data",))
+
+
+def arange_like(data=None, start=0.0, step=1.0, repeat=1, axis=None,
+                name=None):
+    return _make_op("arange_like", [data],
+                    _attrs(start=float(start), step=float(step),
+                           repeat=int(repeat), axis=axis), name)
+
+
+_sym_mod.arange_like = arange_like
+
+
 def _install_sym_contrib():
     import sys
     import types
@@ -1143,6 +1203,7 @@ def _install_sym_contrib():
     contrib.ROIAlign = ROIAlign
     contrib.BilinearResize2D = BilinearResize2D
     contrib.AdaptiveAvgPooling2D = AdaptiveAvgPooling2D
+    contrib.arange_like = arange_like
     _sym_mod.contrib = contrib
     sys.modules["incubator_mxnet_tpu.symbol.contrib"] = contrib
 

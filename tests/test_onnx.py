@@ -305,3 +305,26 @@ class TestErrors:
             pytest.skip("no handy unsupported op")
         with pytest.raises(NotImplementedError, match="Supported"):
             onnx_mxnet.export_model(net, {}, [(2, 2)])
+
+
+def test_dot_transpose_b_exports_correctly():
+    """dot with transpose flags must emit a Transpose before MatMul, not
+    silently drop the flag (the weight-tied LM head pattern)."""
+    from incubator_mxnet_tpu import symbol as S
+    rng = np.random.RandomState(0)
+    w = mx.nd.array(rng.randn(6, 5).astype(np.float32))  # (vocab, units)
+    x = mx.nd.array(rng.randn(3, 5).astype(np.float32))
+    s = S.dot(S.Variable("data"), S.Variable("w"), transpose_b=True)
+    buf = onnx_mxnet.export_model(s, {"w": w}, [(3, 5)])
+    sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+    out = sym2.bind(mx.cpu(), {**arg2, **aux2, "data": x}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() @ w.asnumpy().T,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dot_transpose_on_activation_input_raises():
+    from incubator_mxnet_tpu import symbol as S
+    s = S.dot(S.Variable("a"), S.Variable("b"), transpose_b=True)
+    with pytest.raises(NotImplementedError, match="transpose"):
+        # b is a graph input (not in params) -> rank unknown -> refuse
+        onnx_mxnet.export_model(s, {}, [(3, 5), (6, 5)])

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 import incubator_mxnet_tpu as mx
-from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu import gluon, nd
 from incubator_mxnet_tpu.gluon import nn
 from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
 
@@ -190,3 +190,129 @@ class TestErrors:
         net(mx.nd.array(np.zeros((1, 3), np.float32)))
         with pytest.raises(NotImplementedError, match="parameter"):
             trace_symbol(net)
+
+
+class TestTransformerLMTracing:
+    """Attention as a first-class symbol op (reference: the symbol-level
+    interleaved_matmul/multihead ops of src/operator/contrib/
+    transformer.cc) — the causal LM traces to a serializable graph."""
+
+    def _lm(self):
+        from incubator_mxnet_tpu.models import TransformerLM
+        mx.random.seed(0)
+        np.random.seed(0)
+        m = TransformerLM(vocab_size=30, num_layers=2, units=32,
+                          hidden_size=64, num_heads=4, max_length=16)
+        m.initialize(init=mx.init.Xavier())
+        return m
+
+    def test_trace_parity_and_json_roundtrip(self):
+        from incubator_mxnet_tpu import symbol as S
+        m = self._lm()
+        x = nd.array(np.random.RandomState(0).randint(0, 30, (2, 8))
+                     .astype(np.float32))
+        ref = m(x).asnumpy()
+        sym, args, aux = trace_symbol(m, "data")
+        out = sym.bind(mx.cpu(), {**args, "data": x}).forward()[0]
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+        s2 = S.load_json(sym.tojson())
+        out2 = s2.bind(mx.cpu(), {**args, "data": x}).forward()[0]
+        np.testing.assert_allclose(out2.asnumpy(), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_traced_lm_backward(self):
+        m = self._lm()
+        x = nd.array(np.random.RandomState(1).randint(0, 30, (2, 8))
+                     .astype(np.float32))
+        sym, args, aux = trace_symbol(m, "data")
+        ex = sym.bind(mx.cpu(), {**args, "data": x},
+                      args_grad={k: nd.zeros(v.shape)
+                                 for k, v in args.items()})
+        ex.forward(is_train=True)
+        ex.backward(nd.ones(ex.outputs[0].shape))
+        # the tied embedding weight must receive gradient through BOTH
+        # uses (input lookup AND the transpose_b logits head)
+        emb_name = [n for n in ex.grad_dict
+                    if "embedding" in n and "pos" not in n]
+        assert emb_name, sorted(ex.grad_dict)
+        assert float(np.abs(
+            ex.grad_dict[emb_name[0]].asnumpy()).sum()) > 0
+        total = sum(float(np.abs(g.asnumpy()).sum())
+                    for g in ex.grad_dict.values())
+        assert total > 0
+
+
+def test_sym_multihead_attention_direct():
+    """sym.multihead_attention as a user-facing symbol op: parity with the
+    nd op, causal + mask variants, JSON round-trip."""
+    from incubator_mxnet_tpu import symbol as S
+    from incubator_mxnet_tpu import ops
+
+    rng = np.random.RandomState(0)
+    q = nd.array(rng.randn(2, 6, 16).astype(np.float32))
+    k = nd.array(rng.randn(2, 6, 16).astype(np.float32))
+    v = nd.array(rng.randn(2, 6, 16).astype(np.float32))
+
+    mask = nd.array((rng.rand(1, 1, 6, 6) > 0.3).astype(np.float32))
+    for kwargs in ({}, {"causal": True}, {"scale": 0.5}, {"mask": mask}):
+        feed = {"q": q, "k": k, "v": v}
+        skw = dict(kwargs)
+        if "mask" in skw:
+            skw["mask"] = S.Variable("mask")
+            feed["mask"] = mask
+        s = S.multihead_attention(S.Variable("q"), S.Variable("k"),
+                                  S.Variable("v"), num_heads=4, **skw)
+        out = s.bind(mx.cpu(), feed).forward()[0]
+        ref = ops.multihead_attention(q, k, v, 4, **kwargs)
+        np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
+        s2 = __import__("incubator_mxnet_tpu").symbol.load_json(s.tojson())
+        out2 = s2.bind(mx.cpu(), feed).forward()[0]
+        np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(), rtol=1e-6)
+
+
+def test_sym_arange_like_and_dot_transpose():
+    from incubator_mxnet_tpu import symbol as S
+
+    d = nd.array(np.zeros((3, 7), np.float32))
+    s = S.contrib.arange_like(S.Variable("d"), axis=1)
+    out = s.bind(mx.cpu(), {"d": d}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.arange(7, dtype=np.float32))
+
+    rng = np.random.RandomState(3)
+    a = nd.array(rng.randn(4, 5).astype(np.float32))
+    b = nd.array(rng.randn(6, 5).astype(np.float32))
+    s = S.dot(S.Variable("a"), S.Variable("b"), transpose_b=True)
+    out = s.bind(mx.cpu(), {"a": a, "b": b}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() @ b.asnumpy().T, rtol=1e-5)
+    # nd path agrees
+    np.testing.assert_allclose(
+        nd.dot(a, b, transpose_b=True).asnumpy(),
+        a.asnumpy() @ b.asnumpy().T, rtol=1e-5)
+
+
+def test_traced_lm_overlength_fails_at_bind():
+    """L > max_length must fail at bind (shape mismatch), never silently
+    clamp positional embeddings."""
+    from incubator_mxnet_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=20, num_layers=1, units=16,
+                      hidden_size=32, num_heads=2, max_length=8)
+    m.initialize(init=mx.init.Xavier())
+    sym, args, aux = trace_symbol(m, "data")
+    ok = nd.array(np.zeros((2, 8), np.float32))
+    out = sym.bind(mx.cpu(), {**args, "data": ok}).forward()[0]
+    assert out.shape == (2, 8, 20)
+    too_long = nd.array(np.zeros((2, 12), np.float32))
+    with pytest.raises(Exception):
+        sym.bind(mx.cpu(), {**args, "data": too_long}).forward()[0].asnumpy()
+
+
+def test_trace_warns_on_attention_dropout():
+    from incubator_mxnet_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=20, num_layers=1, units=16,
+                      hidden_size=32, num_heads=2, max_length=8,
+                      dropout=0.1)
+    m.initialize(init=mx.init.Xavier())
+    with pytest.warns(UserWarning, match="dropout"):
+        trace_symbol(m, "data")
