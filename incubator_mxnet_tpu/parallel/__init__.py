@@ -22,10 +22,12 @@ from .pipeline import pipeline_apply, spmd_pipeline
 from .moe import moe_gate, moe_ffn, MoEFFN
 from .tensor_parallel import (column_parallel, row_parallel,
                               annotate_bert_tp, annotate_ffn_tp)
+from .checkpoint import (save_train_step, restore_train_step, latest_step)
 
 __all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep",
            "ring_attention", "ring_self_attention",
            "ulysses_attention", "ulysses_self_attention", "pipeline_apply",
            "spmd_pipeline", "moe_gate", "moe_ffn", "MoEFFN",
            "column_parallel", "row_parallel", "annotate_bert_tp",
-           "annotate_ffn_tp"]
+           "annotate_ffn_tp", "save_train_step", "restore_train_step",
+           "latest_step"]
