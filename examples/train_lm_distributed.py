@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Distributed causal-LM training with sharded checkpoint/resume.
+
+The flagship training loop end-to-end: TransformerLM on a data-parallel
+mesh via FusedTrainStep (fwd+bwd+psum+AdamW as ONE XLA program, ZeRO-1
+optimizer-state sharding), periodic sharded checkpoints, and resume —
+rerunning the script continues from the latest checkpoint bit-exactly.
+
+Run (CPU demo):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/train_lm_distributed.py --steps 40
+On TPU hardware drop the env vars; on a pod, add mx.distributed.init()
+(tools/launch.py) and the same mesh spans all hosts.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, nd  # noqa: E402
+from incubator_mxnet_tpu.models import TransformerLM  # noqa: E402
+from incubator_mxnet_tpu.models.transformer_lm import lm_loss  # noqa: E402
+from incubator_mxnet_tpu.parallel import (FusedTrainStep, latest_step,  # noqa: E402
+                                          make_mesh, restore_train_step,
+                                          save_train_step)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--save-every", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--ckpt-dir", default="/tmp/lm_ckpt_demo")
+    args = ap.parse_args()
+
+    import jax
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev}) if n_dev > 1 else None
+    print(f"devices: {n_dev} ({'dp mesh' if mesh else 'single'})")
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    model = TransformerLM(vocab_size=64, num_layers=2, units=128,
+                          hidden_size=256, num_heads=4,
+                          max_length=args.seq_len)
+    model.initialize(init=mx.init.Xavier())
+    step = FusedTrainStep(model, lambda logits, y: lm_loss(logits, y).mean(),
+                          mx.optimizer.create("adamw", learning_rate=3e-3),
+                          mesh=mesh, shard_optimizer_states=mesh is not None)
+
+    def batch(i):
+        # deterministic per-step data: resume sees the SAME stream the
+        # uninterrupted run would, so continuation is bit-exact
+        rng = np.random.RandomState(1000 + i)
+        starts = rng.randint(0, 8, args.batch)
+        seq = (starts[:, None] + np.arange(args.seq_len)[None, :]) % 8
+        return nd.array(seq.astype(np.float32))
+
+    x0 = batch(-1)
+    t0 = time.time()
+    float(step(x0, x0))                                   # compile
+    print(f"compiled in {time.time() - t0:.1f}s")
+
+    # checkpoints are numbered by SCRIPT step (explicit step_num=), not
+    # by step._num_update, which also counts the compile call above
+    start = latest_step(args.ckpt_dir) or 0
+    if start:
+        restore_train_step(args.ckpt_dir, step, step_num=start)
+        print(f"resumed from step {start}")
+
+    for i in range(start, args.steps):
+        xb = batch(i)
+        loss = float(step(xb, xb))
+        if (i + 1) % args.save_every == 0 or i + 1 == args.steps:
+            path = save_train_step(args.ckpt_dir, step, step_num=i + 1)
+            print(f"step {i + 1}: loss {loss:.4f} (checkpoint -> {path})")
+        elif (i + 1) % 10 == 0:
+            print(f"step {i + 1}: loss {loss:.4f}")
+    print("done; rerun to resume from the latest checkpoint")
+
+
+if __name__ == "__main__":
+    main()

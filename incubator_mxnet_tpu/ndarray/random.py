@@ -49,6 +49,18 @@ class _TraceKeyScope:
         return False
 
 
+def _ensure_global_key():
+    """Materialize (and return) the process-global key WITHOUT consuming
+    from it — unlike _key(), this ignores any active trace-key context,
+    so checkpoint code can always reach the real global state."""
+    global _global_key
+    with _lock:
+        if _global_key is None:
+            _global_key = jax.random.PRNGKey(
+                np.random.SeedSequence().entropy % (2**63))
+        return _global_key
+
+
 def _key():
     stack = _tk_stack()
     if stack:
@@ -56,9 +68,8 @@ def _key():
         stack[-1] = nxt
         return sub
     global _global_key
+    _ensure_global_key()
     with _lock:
-        if _global_key is None:
-            _global_key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**63))
         _global_key, sub = jax.random.split(_global_key)
     return sub
 

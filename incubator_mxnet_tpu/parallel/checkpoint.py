@@ -49,9 +49,9 @@ def _tree_of(step):
     }
     # the framework RNG key feeds every step's dropout masks; exact
     # resume for stochastic nets needs it (fresh-process keys would
-    # diverge from the uninterrupted run). None before first random use.
-    if ndrandom._global_key is not None:
-        tree["rng_key"] = ndrandom._global_key
+    # diverge from the uninterrupted run). _ensure_global_key (not
+    # _key()) so an active trace-key context can't hide the global.
+    tree["rng_key"] = ndrandom._ensure_global_key()
     return tree
 
 
@@ -86,20 +86,21 @@ def restore_train_step(directory, step, step_num=None):
         raise FileNotFoundError(f"no step_* checkpoints in {directory!r}")
     path = os.path.join(os.path.abspath(directory), f"step_{n:08d}")
     from ..ndarray import random as ndrandom
-    if ndrandom._global_key is None:
-        ndrandom._key()      # materialize so the live tree carries a slot
+    ndrandom._ensure_global_key()  # live tree must carry an rng slot
     live = _tree_of(step)
-    restore_args = ocp.checkpoint_utils.construct_restore_args(live)
     with ocp.PyTreeCheckpointer() as ckptr:
-        try:
-            restored = ckptr.restore(path, item=live,
-                                     restore_args=restore_args)
-        except ValueError:
-            # checkpoint written before any random use carries no rng_key
-            live.pop("rng_key", None)
-            restore_args = ocp.checkpoint_utils.construct_restore_args(live)
-            restored = ckptr.restore(path, item=live,
-                                     restore_args=restore_args)
+        # consult the checkpoint's own structure (no except-and-retry: a
+        # genuine restore error must not silently drop the rng_key)
+        meta = ckptr.metadata(path)
+        # orbax wraps the tree dict: StepMetadata.item_metadata.tree
+        tree_meta = getattr(meta, "item_metadata", meta)
+        tree_meta = getattr(tree_meta, "tree", tree_meta)
+        saved_keys = set(tree_meta)
+        if "rng_key" not in saved_keys:
+            live.pop("rng_key", None)  # pre-randomness checkpoint
+        restore_args = ocp.checkpoint_utils.construct_restore_args(live)
+        restored = ckptr.restore(path, item=live,
+                                 restore_args=restore_args)
     for i, p in enumerate(step.params):
         p._data._data = restored["params"][f"p{i:04d}"]
     if "rng_key" in restored:

@@ -79,3 +79,13 @@ def test_example_onnx():
 def test_example_train_lm():
     out = _run("train_lm.py", "--steps", "60")
     assert "greedy :" in out and "loss" in out
+
+
+@pytest.mark.slow
+def test_example_train_lm_distributed(tmp_path):
+    out = _run("train_lm_distributed.py", "--steps", "12",
+               "--save-every", "6", "--ckpt-dir", str(tmp_path / "ck"))
+    assert "dp mesh" in out and "checkpoint ->" in out
+    out2 = _run("train_lm_distributed.py", "--steps", "16",
+                "--save-every", "8", "--ckpt-dir", str(tmp_path / "ck"))
+    assert "resumed from step" in out2
