@@ -89,3 +89,10 @@ def test_example_train_lm_distributed(tmp_path):
     out2 = _run("train_lm_distributed.py", "--steps", "16",
                 "--save-every", "8", "--ckpt-dir", str(tmp_path / "ck"))
     assert "resumed from step" in out2
+
+
+@pytest.mark.slow
+def test_example_estimator_mnist(tmp_path):
+    out = _run("estimator_mnist.py", "--epochs", "2",
+               "--num-examples", "512", "--ckpt-dir", str(tmp_path))
+    assert "final validation accuracy=" in out
