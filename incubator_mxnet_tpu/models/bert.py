@@ -187,10 +187,20 @@ class BERTEncoder(HybridBlock):
                 layer_norm_eps, weight_initializer, ring=ring))
 
     def forward(self, x, mask=None):
-        seq_len = x.shape[1]
+        from ..ndarray import _symbolic
         pos = self.position_weight.data()
-        x = _apply(lambda xr, pr: xr + pr[:seq_len][None, :, :],
-                   [x, pos], name="add_position_embed")
+        if _symbolic(x):
+            # symbol trace has no python shape: the first L rows of the
+            # table are the positional embeddings; slice_like ties the
+            # length to the input and an over-length bind fails the
+            # broadcast instead of silently clamping
+            x = x + nd.slice_like(pos, nd.swapaxes(x, 0, 1), axes=(0,))
+        else:
+            # eager/hybridized: static row slice (no transposed copy of
+            # the activations just to read a shape)
+            seq_len = x.shape[1]
+            x = _apply(lambda xr, pr: xr + pr[:seq_len][None, :, :],
+                       [x, pos], name="add_position_embed")
         x = self.dropout(self.ln(x))
         for cell in self.cells:
             x = cell(x, mask)
@@ -236,6 +246,12 @@ class BERTModel(HybridBlock):
             x = x + self.token_type_embed(token_types)
         mask = None
         if valid_length is not None:
+            from ..ndarray import _symbolic
+            if _symbolic(inputs):
+                raise ValueError(
+                    "symbol tracing of BERTModel does not support "
+                    "valid_length (the mask needs a static length); pad "
+                    "to max_length and trace without it")
             mask = _length_mask(valid_length, inputs.shape[1])
         seq = self.encoder(x, mask)
         if self.pooler is None:

@@ -316,3 +316,31 @@ def test_trace_warns_on_attention_dropout():
     m.initialize(init=mx.init.Xavier())
     with pytest.warns(UserWarning, match="dropout"):
         trace_symbol(m, "data")
+
+
+def test_bert_traces_and_serializes():
+    """BERT (encoder path) traces to a serializable symbol graph — the
+    NLP deployment story alongside the CNN zoo and the causal LM."""
+    from incubator_mxnet_tpu.models.bert import BERTModel
+    mx.random.seed(0)
+    np.random.seed(0)
+    m = BERTModel(num_layers=2, units=32, hidden_size=64, num_heads=4,
+                  max_length=16, vocab_size=50, dropout=0.0,
+                  use_pooler=False)
+    m.initialize(init=mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randint(0, 50, (2, 10))
+                 .astype(np.float32))
+    ref = m(x).asnumpy()
+    sym, args, aux = trace_symbol(m, "data")
+    out = sym.bind(mx.cpu(), {**args, "data": x}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+    s2 = mx.sym.load_json(sym.tojson())
+    out2 = s2.bind(mx.cpu(), {**args, "data": x}).forward()[0]
+    np.testing.assert_allclose(out2.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+    # valid_length cannot trace: clear error, not a crash
+    with pytest.raises(ValueError, match="valid_length"):
+        from incubator_mxnet_tpu.gluon.symbolize import SymbolizeScope
+        from incubator_mxnet_tpu.symbol import Variable
+        id2name = {id(p.data()): n for n, p in m.collect_params().items()}
+        with SymbolizeScope(id2name):
+            m(Variable("data"), valid_length=Variable("vl"))
