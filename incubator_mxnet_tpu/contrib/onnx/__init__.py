@@ -103,7 +103,8 @@ def _vi(name, shape, elem_type=P.TensorProto.FLOAT):
 # ---------------------------------------------------------------------------
 
 class _Exporter:
-    def __init__(self, graph_json, params, opset, np_dtype=np.float32):
+    def __init__(self, graph_json, params, opset, np_dtype=np.float32,
+                 input_shapes=None):
         self.nodes = graph_json["nodes"]
         self.heads = graph_json["heads"]
         self.params = params
@@ -113,8 +114,69 @@ class _Exporter:
         self.names = {}          # (node_idx, out_idx) -> tensor name
         self.emitted_inits = set()
         self.used_inputs = []    # graph-input var names in consumption order
+        self.shapes = {}         # (node_idx, out_idx) -> tuple | missing
+        if input_shapes is not None:
+            self._annotate_shapes(list(input_shapes))
+
+    def _annotate_shapes(self, input_shapes):
+        """Static per-node output shapes via jax.eval_shape over the
+        registered op runtimes (abstract — nothing computes). Shape-
+        dependent exporters (attention decomposition, Slice ends,
+        Transpose perms) read self.shapes; ops eval_shape can't handle
+        (e.g. pure_callback customs) just leave gaps."""
+        import jax
+
+        from ...symbol import _OPS, _Runtime
+
+        rt = _Runtime(False, jax.random.PRNGKey(0))
+        specs = {}
+        # input_shape entries map to DATA inputs; label-like variables
+        # (dropped by exporters like SoftmaxOutput) must not steal a
+        # shape from a later real input
+        null_names = [n["name"] for n in self.nodes
+                      if n["op"] == "null" and n["name"] not in self.params]
+        data_names = [n for n in null_names if "label" not in n]
+        assign = dict(zip(data_names, input_shapes))
+        for idx, node in enumerate(self.nodes):
+            try:
+                if node["op"] == "null":
+                    name = node["name"]
+                    if name in self.params:
+                        arr = self.params[name]
+                        a_np = (arr.asnumpy() if hasattr(arr, "asnumpy")
+                                else np.asarray(arr))
+                        spec = jax.ShapeDtypeStruct(a_np.shape, a_np.dtype)
+                    elif name in assign:
+                        spec = jax.ShapeDtypeStruct(tuple(assign[name]),
+                                                    self.np_dtype)
+                    else:
+                        continue  # label/unknown input: no shape
+                    specs[(idx, 0)] = spec
+                    continue
+                od = _OPS[node["op"]]
+                ins = [specs[(i, o)] for i, o in node["inputs"]]
+                attrs = node.get("attrs") or {}
+                out = jax.eval_shape(
+                    lambda *raws: od.fn(rt, attrs, *raws), *ins)
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                for o, s in enumerate(outs):
+                    specs[(idx, o)] = s
+            except Exception:  # noqa: BLE001 — gaps are allowed
+                continue
+        self.shapes = {k: tuple(v.shape) for k, v in specs.items()}
 
     # -- helpers ------------------------------------------------------------
+    def shape_of(self, node_idx, out_idx=0):
+        s = self.shapes.get((node_idx, out_idx))
+        if s is None:
+            raise NotImplementedError(
+                "ONNX export of %r needs static shape inference for node "
+                "%r, which was unavailable (pass input_shape to "
+                "export_model, and check the op's runtime is "
+                "eval_shape-able)" % (self.nodes[node_idx]["op"],
+                                      self.nodes[node_idx]["name"]))
+        return s
+
     def name_of(self, node_idx, out_idx=0):
         return self.names[(node_idx, out_idx)]
 
@@ -478,10 +540,44 @@ _BINOP = {"_plus": "Add", "elemwise_add": "Add", "broadcast_add": "Add",
           "dot": "MatMul"}
 
 
+@_export("batch_dot")
+def _exp_batch_dot(ex, idx, node):
+    """batch_dot == numpy-matmul semantics == ONNX MatMul; transpose
+    flags become Transpose of the last two axes (rank from the shape
+    pass)."""
+    a = node.get("attrs") or {}
+    ins = ex.resolve(node)
+    n = node["name"]
+    for flag, pos in (("transpose_a", 0), ("transpose_b", 1)):
+        if not a.get(flag):
+            continue
+        rank = len(ex.shape_of(node["inputs"][pos][0],
+                               node["inputs"][pos][1]))
+        perm = list(range(rank))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        tname = f"{n}_{flag}"
+        ex.add_node("Transpose", [ins[pos]], [tname], tname, perm=perm)
+        ins[pos] = tname
+    ex.add_node("MatMul", ins, [n], n)
+    ex.names[(idx, 0)] = n
+
+
 @_export(*_BINOP)
 def _exp_binop(ex, idx, node):
     ins = ex.resolve(node)
     if node["op"] == "dot":
+        # MatMul only matches dot's tensordot semantics up to rank 2;
+        # a rank>2 stacked dot would silently change numerics
+        lhs_shape = ex.shapes.get((node["inputs"][0][0],
+                                   node["inputs"][0][1]))
+        rhs_shape = ex.shapes.get((node["inputs"][1][0],
+                                   node["inputs"][1][1]))
+        if ((lhs_shape is not None and len(lhs_shape) > 2
+             and rhs_shape is not None and len(rhs_shape) > 2)):
+            raise NotImplementedError(
+                "ONNX export: dot with rank>2 on both sides contracts "
+                "differently from MatMul; use batch_dot for batched "
+                "matmul semantics")
         # dot may carry transpose flags (sym.dot(transpose_b=True), the
         # weight-tied LM head); MatMul alone would silently drop them
         a = node.get("attrs") or {}
@@ -602,6 +698,167 @@ def _exp_pad(ex, idx, node):
     ex.names[(idx, 0)] = node["name"]
 
 
+@_export("swapaxes")
+def _exp_swapaxes(ex, idx, node):
+    a = node["attrs"]
+    rank = len(ex.shape_of(node["inputs"][0][0], node["inputs"][0][1]))
+    perm = list(range(rank))
+    i1, i2 = int(a["a1"]) % rank, int(a["a2"]) % rank
+    perm[i1], perm[i2] = perm[i2], perm[i1]
+    ex.add_node("Transpose", ex.resolve(node), [node["name"]],
+                node["name"], perm=perm)
+    ex.names[(idx, 0)] = node["name"]
+
+
+@_export("slice_like")
+def _exp_slice_like(ex, idx, node):
+    # output shape is static: emit a plain Slice of `data` to it
+    out_shape = ex.shape_of(idx)
+    a = node.get("attrs") or {}
+    axes = a.get("axes")
+    axes = (list(range(len(out_shape))) if axes is None
+            else [int(x) % len(out_shape) for x in axes])
+    name = node["name"]
+    ins = ex.resolve(node, positions=[0]) + [
+        ex.add_init(name + "_starts",
+                    np.zeros(len(axes), np.int64)),
+        ex.add_init(name + "_ends",
+                    np.asarray([out_shape[ax] for ax in axes], np.int64)),
+        ex.add_init(name + "_axes", np.asarray(axes, np.int64))]
+    ex.add_node("Slice", ins, [name], name)
+    ex.names[(idx, 0)] = name
+
+
+@_export("LayerNorm")
+def _exp_layer_norm(ex, idx, node):
+    # opset-13 decomposition (LayerNormalization itself is opset 17):
+    # (x - mean) / sqrt(var + eps) * gamma + beta over the last axis
+    a = node.get("attrs") or {}
+    axis = int(a.get("axis", -1))
+    rank = len(ex.shape_of(node["inputs"][0][0], node["inputs"][0][1]))
+    if axis % rank != rank - 1:
+        raise NotImplementedError(
+            "ONNX LayerNorm export supports last-axis normalization only "
+            "(got axis=%d)" % axis)
+    x, gamma, beta = ex.resolve(node)
+    n = node["name"]
+    eps = ex.add_init(n + "_eps",
+                      np.asarray(a.get("eps", 1e-5), np.float32))
+    ex.add_node("ReduceMean", [x], [n + "_mean"], n + "_mean",
+                axes=[-1], keepdims=1)
+    ex.add_node("Sub", [x, n + "_mean"], [n + "_xmu"], n + "_xmu")
+    ex.add_node("Mul", [n + "_xmu", n + "_xmu"], [n + "_sq"], n + "_sq")
+    ex.add_node("ReduceMean", [n + "_sq"], [n + "_var"], n + "_var",
+                axes=[-1], keepdims=1)
+    ex.add_node("Add", [n + "_var", eps], [n + "_vareps"], n + "_vareps")
+    ex.add_node("Sqrt", [n + "_vareps"], [n + "_std"], n + "_std")
+    ex.add_node("Div", [n + "_xmu", n + "_std"], [n + "_norm"], n + "_norm")
+    ex.add_node("Mul", [n + "_norm", gamma], [n + "_scaled"], n + "_scaled")
+    ex.add_node("Add", [n + "_scaled", beta], [n], n)
+    ex.names[(idx, 0)] = n
+
+
+@_export("SliceChannel")
+def _exp_slice_channel(ex, idx, node):
+    a = node["attrs"]
+    num = int(a["num_outputs"])
+    axis = int(a.get("axis", 1))
+    n = node["name"]
+    part_names = [f"{n}_part{k}" for k in range(num)]
+    ex.add_node("Split", ex.resolve(node), part_names, n, axis=axis)
+    for k in range(num):
+        if a.get("squeeze_axis"):
+            sq = f"{n}_out{k}"
+            ex.add_node("Squeeze", [part_names[k],
+                                    ex.add_init(n + "_sqax",
+                                                np.asarray([axis],
+                                                           np.int64))],
+                        [sq], sq)
+            ex.names[(idx, k)] = sq
+        else:
+            ex.names[(idx, k)] = part_names[k]
+
+
+@_export("multihead_attention")
+def _exp_multihead_attention(ex, idx, node):
+    """Decomposition of the symbol attention op: split heads ->
+    QK^T*scale (+causal/mask) -> Softmax -> AV -> merge heads. Shapes
+    are static at export, so the causal mask is a constant and the
+    reshapes use concrete dims."""
+    a = node.get("attrs") or {}
+    heads = int(a["num_heads"])
+    qi, qo = node["inputs"][0]
+    ki, ko = node["inputs"][1]
+    b_, lq, d = ex.shape_of(qi, qo)
+    lk = ex.shape_of(ki, ko)[1]
+    hd = d // heads
+    scale = a.get("scale")
+    scale = float(scale) if scale is not None else 1.0 / (hd ** 0.5)
+    n = node["name"]
+    ins = ex.resolve(node)
+    q, k, v = ins[0], ins[1], ins[2]
+    mask = ins[3] if a.get("has_mask") else None
+
+    def split_heads(src, tag, length):
+        ex.add_node("Reshape", [src, ex.add_init(
+            f"{n}_{tag}_shape", np.asarray([b_, length, heads, hd],
+                                           np.int64))],
+            [f"{n}_{tag}_r"], f"{n}_{tag}_r")
+        ex.add_node("Transpose", [f"{n}_{tag}_r"], [f"{n}_{tag}_h"],
+                    f"{n}_{tag}_h", perm=[0, 2, 1, 3])
+        return f"{n}_{tag}_h"
+
+    qh, kh, vh = (split_heads(q, "q", lq), split_heads(k, "k", lk),
+                  split_heads(v, "v", lk))
+    ex.add_node("Transpose", [kh], [n + "_kt"], n + "_kt",
+                perm=[0, 1, 3, 2])
+    ex.add_node("MatMul", [qh, n + "_kt"], [n + "_scores"], n + "_scores")
+    ex.add_node("Mul", [n + "_scores",
+                        ex.add_init(n + "_scale",
+                                    np.asarray(scale, np.float32))],
+                [n + "_scaled"], n + "_scaled")
+    cur = n + "_scaled"
+    neg = ex.add_init(n + "_neg", np.asarray(-1e9, np.float32))
+    if a.get("causal"):
+        tri = np.tril(np.ones((lq, lk), bool), k=lk - lq)
+        cond = ex.add_init(n + "_tri", tri)
+        ex.add_node("Where", [cond, cur, neg], [n + "_causal"],
+                    n + "_causal")
+        cur = n + "_causal"
+    if mask is not None:
+        ex.add_node("Cast", [mask], [n + "_maskb"], n + "_maskb",
+                    to=P.TensorProto.BOOL)
+        ex.add_node("Where", [n + "_maskb", cur, neg], [n + "_masked"],
+                    n + "_masked")
+        cur = n + "_masked"
+    ex.add_node("Softmax", [cur], [n + "_w"], n + "_w", axis=-1)
+    ex.add_node("MatMul", [n + "_w", vh], [n + "_ctx"], n + "_ctx")
+    ex.add_node("Transpose", [n + "_ctx"], [n + "_ctxT"], n + "_ctxT",
+                perm=[0, 2, 1, 3])
+    ex.add_node("Reshape", [n + "_ctxT", ex.add_init(
+        n + "_out_shape", np.asarray([b_, lq, d], np.int64))], [n], n)
+    ex.names[(idx, 0)] = n
+
+
+@_export("where")
+def _exp_where(ex, idx, node):
+    ins = ex.resolve(node)
+    n = node["name"]
+    # ONNX Where requires a BOOL condition; our where accepts numeric
+    ex.add_node("Cast", [ins[0]], [n + "_cond"], n + "_cond",
+                to=P.TensorProto.BOOL)
+    ex.add_node("Where", [n + "_cond", ins[1], ins[2]], [n], n)
+    ex.names[(idx, 0)] = n
+
+
+@_export("cast")
+def _exp_cast(ex, idx, node):
+    dt = np.dtype(node["attrs"]["dtype"])
+    ex.add_node("Cast", ex.resolve(node), [node["name"]], node["name"],
+                to=_NP2ONNX[dt])
+    ex.names[(idx, 0)] = node["name"]
+
+
 @_export("gelu")
 def _exp_gelu(ex, idx, node):
     # opset 13 has no Gelu; emit the exact erf form
@@ -656,13 +913,14 @@ def export_model(sym, params, input_shape, input_type="float32",
         raise NotImplementedError("control-flow subgraphs cannot be "
                                   "exported to ONNX")
     in_np = np.dtype(input_type)
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
     ex = _Exporter(graph_json, params, opset,
-                   np_dtype=in_np if in_np in _NP2ONNX else np.float32)
+                   np_dtype=in_np if in_np in _NP2ONNX else np.float32,
+                   input_shapes=input_shape)
     g = ex.run()
     g.name = model_name
 
-    if isinstance(input_shape, tuple):
-        input_shape = [input_shape]
     elem = _NP2ONNX.get(in_np, P.TensorProto.FLOAT)
     data_inputs = ex.used_inputs
     if len(input_shape) < len(data_inputs):
@@ -880,7 +1138,10 @@ def _imp_gemm(im, node, a):
 
 @_import("MatMul")
 def _imp_matmul(im, node, a):
-    im.tensors[node.output[0]] = im.S.dot(
+    # ONNX MatMul is numpy-matmul semantics (batched over leading dims,
+    # broadcasting) — that is batch_dot's jnp.matmul runtime, NOT dot's
+    # tensordot (which mis-contracts rank>2 stacks)
+    im.tensors[node.output[0]] = im.S.batch_dot(
         im.sym_of(node.input[0]), im.sym_of(node.input[1]),
         name=node.name or None)
 
@@ -1007,6 +1268,26 @@ def _imp_slice(im, node, a):
         s = im.S.slice_axis(s, axis=ax, begin=b,
                             end=None if e >= imax else e)
     im.tensors[node.output[0]] = s
+
+
+@_import("Split")
+def _imp_split(im, node, a):
+    if len(node.input) > 1:
+        raise NotImplementedError(
+            "ONNX Split with explicit split lengths is unsupported "
+            "(equal-parts Split only)")
+    parts = im.S.split(im.sym_of(node.input[0]),
+                       num_outputs=len(node.output),
+                       axis=int(a.get("axis", 0)))
+    for k, out in enumerate(node.output):
+        im.tensors[out] = parts[k]
+
+
+@_import("Where")
+def _imp_where(im, node, a):
+    im.tensors[node.output[0]] = im.S.where(
+        im.sym_of(node.input[0]), im.sym_of(node.input[1]),
+        im.sym_of(node.input[2]))
 
 
 @_import("Concat")

@@ -130,8 +130,9 @@ register_op("dot",
             lambda rt, a, x, y: _raw.dot_mx(x, y, a.get("transpose_a"),
                                             a.get("transpose_b")),
             ("lhs", "rhs"))
-register_op("batch_dot", lambda rt, a, x, y: jnp.einsum(
-    "bij,bjk->bik",
+# numpy-matmul semantics (stacked leading dims, broadcasting) — matches
+# nd.batch_dot exactly (the 3-D MXNet case is a subset) and ONNX MatMul
+register_op("batch_dot", lambda rt, a, x, y: jnp.matmul(
     x if not a.get("transpose_a") else jnp.swapaxes(x, -1, -2),
     y if not a.get("transpose_b") else jnp.swapaxes(y, -1, -2)),
     ("lhs", "rhs"))

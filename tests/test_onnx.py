@@ -328,3 +328,120 @@ def test_dot_transpose_on_activation_input_raises():
     with pytest.raises(NotImplementedError, match="transpose"):
         # b is a graph input (not in params) -> rank unknown -> refuse
         onnx_mxnet.export_model(s, {}, [(3, 5), (6, 5)])
+
+
+class TestTransformerONNX:
+    """Transformer-family export: the shape-annotated exporter decomposes
+    multihead_attention/LayerNorm/SliceChannel/slice_like/swapaxes into
+    opset-13 ONNX; imported graphs reproduce eager numerics."""
+
+    def _roundtrip(self, net, shape, seed=0):
+        from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
+        net.initialize(init=mx.init.Xavier())
+        x = mx.nd.array(np.random.RandomState(seed).randint(
+            0, 29, shape).astype(np.float32))
+        ref = net(x).asnumpy()
+        sym, args, aux = trace_symbol(net, "data")
+        buf = onnx_mxnet.export_model(sym, {**args, **aux}, [shape])
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        ex = sym2.bind(mx.cpu(), {**arg2, **aux2, "data": x})
+        out = ex.forward()[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+        return sym2, arg2, aux2
+
+    def test_transformer_lm_roundtrip(self):
+        from incubator_mxnet_tpu.models import TransformerLM
+        mx.random.seed(0)
+        np.random.seed(0)
+        m = TransformerLM(vocab_size=30, num_layers=2, units=32,
+                          hidden_size=64, num_heads=4, max_length=16)
+        self._roundtrip(m, (2, 8))
+
+    def test_causality_survives_onnx(self):
+        """The constant causal mask in the exported graph must actually
+        mask: changing a future token cannot change past logits."""
+        from incubator_mxnet_tpu.models import TransformerLM
+        from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
+        mx.random.seed(1)
+        np.random.seed(1)
+        m = TransformerLM(vocab_size=20, num_layers=1, units=32,
+                          hidden_size=64, num_heads=4, max_length=8)
+        m.initialize(init=mx.init.Xavier())
+        sym, args, aux = trace_symbol(m, "data")
+        buf = onnx_mxnet.export_model(sym, {**args, **aux}, [(1, 6)])
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        a = np.random.RandomState(2).randint(0, 20, (1, 6)).astype(
+            np.float32)
+        b = a.copy()
+        b[0, -1] = (b[0, -1] + 1) % 20
+        outs = [sym2.bind(mx.cpu(), {**arg2, **aux2,
+                                     "data": mx.nd.array(v)})
+                .forward()[0].asnumpy() for v in (a, b)]
+        np.testing.assert_allclose(outs[0][:, :-1], outs[1][:, :-1],
+                                   atol=1e-5)
+        assert np.abs(outs[0][:, -1] - outs[1][:, -1]).max() > 1e-4
+
+    def test_bert_roundtrip(self):
+        from incubator_mxnet_tpu.models.bert import BERTModel
+        mx.random.seed(0)
+        np.random.seed(0)
+        m = BERTModel(num_layers=2, units=32, hidden_size=64, num_heads=4,
+                      max_length=16, vocab_size=30, dropout=0.0,
+                      use_pooler=False)
+        self._roundtrip(m, (2, 10))
+
+    def test_sym_attention_with_mask_roundtrip(self):
+        from incubator_mxnet_tpu import symbol as S
+        from incubator_mxnet_tpu import ops
+        rng = np.random.RandomState(3)
+        q = mx.nd.array(rng.randn(2, 6, 16).astype(np.float32))
+        maskv = mx.nd.array((rng.rand(1, 1, 6, 6) > 0.4)
+                            .astype(np.float32))
+        s = S.multihead_attention(S.Variable("q"), S.Variable("q2"),
+                                  S.Variable("q3"), num_heads=4,
+                                  mask=S.Variable("mask"))
+        buf = onnx_mxnet.export_model(
+            s, {}, [(2, 6, 16), (2, 6, 16), (2, 6, 16), (1, 1, 6, 6)])
+        sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+        out = sym2.bind(mx.cpu(), {**arg2, **aux2, "q": q, "q2": q,
+                                   "q3": q, "mask": maskv}).forward()[0]
+        ref = ops.multihead_attention(q, q, q, 4, mask=maskv)
+        np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_reimported_attention_model_reexports():
+    """import -> export cycle: MatMul imports as batch_dot, which must
+    itself export (regression: the cycle used to die on 'batch_dot')."""
+    from incubator_mxnet_tpu.models import TransformerLM
+    from incubator_mxnet_tpu.gluon.symbolize import trace_symbol
+    mx.random.seed(0)
+    np.random.seed(0)
+    m = TransformerLM(vocab_size=20, num_layers=1, units=32,
+                      hidden_size=64, num_heads=4, max_length=8)
+    m.initialize(init=mx.init.Xavier())
+    sym, args, aux = trace_symbol(m, "data")
+    buf = onnx_mxnet.export_model(sym, {**args, **aux}, [(2, 6)])
+    sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+    buf2 = onnx_mxnet.export_model(sym2, {**arg2, **aux2}, [(2, 6)])
+    sym3, arg3, aux3 = onnx_mxnet.import_model(buf2)
+    x = mx.nd.array(np.random.RandomState(1).randint(0, 20, (2, 6))
+                    .astype(np.float32))
+    ref = m(x).asnumpy()
+    out = sym3.bind(mx.cpu(), {**arg3, **aux3, "data": x}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_softmaxoutput_label_does_not_steal_shape():
+    """Shape annotation must skip label variables: a graph with
+    SoftmaxOutput (label input dropped at export) plus shape-dependent
+    ops downstream still exports with the documented one-shape-per-data
+    input."""
+    sym = mx.sym
+    data = sym.Variable("data")
+    h = sym.swapaxes(sym.FullyConnected(data, num_hidden=6, flatten=False,
+                                        name="fc"), a1=1, a2=2, name="sw")
+    net = sym.SoftmaxOutput(sym.Flatten(h), name="softmax")
+    params = _params_for(net, {"data": (2, 3, 4)})
+    buf = onnx_mxnet.export_model(net, params, [(2, 3, 4)])
+    assert buf
