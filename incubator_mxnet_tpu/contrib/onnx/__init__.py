@@ -135,7 +135,13 @@ class _Exporter:
         # shape from a later real input
         null_names = [n["name"] for n in self.nodes
                       if n["op"] == "null" and n["name"] not in self.params]
-        data_names = [n for n in null_names if "label" not in n]
+        data_names = [n for n in null_names
+                      if not (n == "label" or n.endswith("_label"))]
+        if len(input_shapes) < len(data_names):
+            raise ValueError(
+                "model has %d data inputs %r but input_shape has %d "
+                "entries" % (len(data_names), data_names,
+                             len(input_shapes)))
         assign = dict(zip(data_names, input_shapes))
         for idx, node in enumerate(self.nodes):
             try:
@@ -568,14 +574,14 @@ def _exp_binop(ex, idx, node):
     if node["op"] == "dot":
         # MatMul only matches dot's tensordot semantics up to rank 2;
         # a rank>2 stacked dot would silently change numerics
-        lhs_shape = ex.shapes.get((node["inputs"][0][0],
-                                   node["inputs"][0][1]))
         rhs_shape = ex.shapes.get((node["inputs"][1][0],
                                    node["inputs"][1][1]))
-        if ((lhs_shape is not None and len(lhs_shape) > 2
-             and rhs_shape is not None and len(rhs_shape) > 2)):
+        if rhs_shape is not None and len(rhs_shape) > 2:
+            # MatMul broadcasts the lhs over rhs leading dims; dot's
+            # tensordot contracts lhs-last with rhs-FIRST — different
+            # result whenever rhs rank > 2, whatever the lhs rank
             raise NotImplementedError(
-                "ONNX export: dot with rank>2 on both sides contracts "
+                "ONNX export: dot with a rank>2 rhs contracts "
                 "differently from MatMul; use batch_dot for batched "
                 "matmul semantics")
         # dot may carry transpose flags (sym.dot(transpose_b=True), the
@@ -818,17 +824,20 @@ def _exp_multihead_attention(ex, idx, node):
                                     np.asarray(scale, np.float32))],
                 [n + "_scaled"], n + "_scaled")
     cur = n + "_scaled"
-    neg = ex.add_init(n + "_neg", np.asarray(-1e9, np.float32))
+
+    def neg():
+        return ex.add_init(n + "_neg", np.asarray(-1e9, np.float32))
+
     if a.get("causal"):
         tri = np.tril(np.ones((lq, lk), bool), k=lk - lq)
         cond = ex.add_init(n + "_tri", tri)
-        ex.add_node("Where", [cond, cur, neg], [n + "_causal"],
+        ex.add_node("Where", [cond, cur, neg()], [n + "_causal"],
                     n + "_causal")
         cur = n + "_causal"
     if mask is not None:
         ex.add_node("Cast", [mask], [n + "_maskb"], n + "_maskb",
                     to=P.TensorProto.BOOL)
-        ex.add_node("Where", [n + "_maskb", cur, neg], [n + "_masked"],
+        ex.add_node("Where", [n + "_maskb", cur, neg()], [n + "_masked"],
                     n + "_masked")
         cur = n + "_masked"
     ex.add_node("Softmax", [cur], [n + "_w"], n + "_w", axis=-1)

@@ -531,7 +531,10 @@ class SymbolBlock(HybridBlock):
                 arg_raws[pos] = r
             rt = _Runtime(is_train, key)
             outs, new_aux = runner(rt, arg_raws, aux_raws)
-            return tuple(outs) + tuple(new_aux)
+            flat = tuple(outs) + tuple(new_aux)
+            # a 1-tuple under _apply(n_out=1) would stack into a bogus
+            # leading axis (bit every no-aux graph, e.g. the causal LM)
+            return flat[0] if len(flat) == 1 else flat
 
         res = _apply(f, list(args) + param_nds + aux_nds,
                      n_out=n_out + n_aux, name="symbolblock")

@@ -445,3 +445,32 @@ def test_softmaxoutput_label_does_not_steal_shape():
     params = _params_for(net, {"data": (2, 3, 4)})
     buf = onnx_mxnet.export_model(net, params, [(2, 3, 4)])
     assert buf
+
+
+def test_dot_rank3_rhs_export_refuses():
+    """dot with a rank>2 rhs contracts differently from MatMul — export
+    must refuse, not silently change numerics."""
+    from incubator_mxnet_tpu import symbol as S
+    w = mx.nd.array(np.random.RandomState(0).randn(5, 5, 6)
+                    .astype(np.float32))
+    s = S.dot(S.Variable("data"), S.Variable("w"))
+    with pytest.raises(NotImplementedError, match="batch_dot"):
+        onnx_mxnet.export_model(s, {"w": w}, [(4, 5)])
+
+
+def test_label_named_data_input_gets_shape():
+    """Only exact 'label'/'*_label' names are treated as droppable label
+    variables; a data input whose name merely CONTAINS 'label' must
+    still receive its shape."""
+    sym = mx.sym
+    s = sym.swapaxes(sym.Variable("label_weights"), a1=1, a2=2)
+    buf = onnx_mxnet.export_model(s, {}, [(2, 3, 4)])
+    assert buf
+
+
+def test_too_few_input_shapes_is_clear_error():
+    from incubator_mxnet_tpu import symbol as S
+    s = S.multihead_attention(S.Variable("q"), S.Variable("k"),
+                              S.Variable("v"), num_heads=2)
+    with pytest.raises(ValueError, match="data inputs"):
+        onnx_mxnet.export_model(s, {}, [(2, 4, 8), (2, 4, 8)])

@@ -344,3 +344,21 @@ def test_bert_traces_and_serializes():
         id2name = {id(p.data()): n for n, p in m.collect_params().items()}
         with SymbolizeScope(id2name):
             m(Variable("data"), valid_length=Variable("vl"))
+
+
+def test_lm_export_symbolblock_imports(tmp_path):
+    """HybridBlock.export -> SymbolBlock.imports deployment path for the
+    causal LM (bit-exact)."""
+    from incubator_mxnet_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=40, num_layers=2, units=32,
+                      hidden_size=64, num_heads=4, max_length=16)
+    m.initialize(init=mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randint(0, 40, (2, 8))
+                 .astype(np.float32))
+    ref = m(x).asnumpy()
+    path = os.path.join(str(tmp_path), "lm")
+    m.export(path, epoch=1)
+    blk = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                    path + "-0001.params")
+    np.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=2e-5,
+                               atol=2e-5)
