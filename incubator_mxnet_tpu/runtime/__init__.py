@@ -23,7 +23,24 @@ __all__ = ["Engine", "StoragePool", "TokenQueue", "native_available",
            "feature_list"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libmxtpu_runtime.so")
+
+
+def _so_dir():
+    """Directory for first-use-compiled .so files: the package dir when
+    writable (source checkouts — keeps the artifact next to its source),
+    else a user cache dir (read-only site-packages installs must not
+    silently lose the native engine)."""
+    if os.access(_DIR, os.W_OK):
+        return _DIR
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "incubator_mxnet_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return cache
+
+
+_SO = os.path.join(_so_dir(), "libmxtpu_runtime.so")
 _lib = None
 _build_failed = False
 _build_lock = threading.Lock()
@@ -479,7 +496,7 @@ class TokenQueue:
 # toolchain/libjpeg only disables this path; callers fall back to PIL.
 # ---------------------------------------------------------------------------
 
-_IMG_SO = os.path.join(_DIR, "libmxtpu_imgdec.so")
+_IMG_SO = os.path.join(_so_dir(), "libmxtpu_imgdec.so")
 _img_lib = None
 _img_build_failed = False
 _img_lock = threading.Lock()
