@@ -57,7 +57,11 @@ def _last_json_line(stdout):
 
 
 def run_bench(env_overrides, timeout):
-    env = dict(os.environ)
+    # driver-parity: ALWAYS drop BENCH_* exported in the caller's shell —
+    # a stray BENCH_MODEL/BENCH_DTYPE would silently mislabel every row
+    # (and the no-override warm run must be the driver's exact config)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
     env.update({k: str(v) for k, v in env_overrides.items()})
     desc = " ".join(f"{k}={v}" for k, v in env_overrides.items()) or "default"
     log(f"bench: {desc}")
@@ -150,14 +154,51 @@ def main():
             results.append(res)
         return res
 
+    def cache_size():
+        d = os.path.join(ROOT, ".jax_cache")
+        total, biggest = 0, 0
+        try:
+            for fn in os.listdir(d):
+                sz = os.path.getsize(os.path.join(d, fn))
+                total += sz
+                biggest = max(biggest, sz)
+        except OSError:
+            pass
+        return total, biggest
+
+    # 0.5) CACHE WARM — the round-2 TPU-compiled ResNet step fell out of
+    # .jax_cache (VERDICT r4 weak #2), so the driver's protected bench
+    # would pay the full remote compile inside its watchdog. Run bench.py
+    # with NO overrides — the driver's EXACT config (BENCH_K defaults to
+    # 8, batch 128) — so both its single-step and k-scan programs land in
+    # the cache, then verify a big entry exists before sweeping.
+    t_before, b_before = cache_size()
+    log(f"stage 0.5: cache warm (driver-default config); .jax_cache "
+        f"total={t_before >> 20} MB biggest={b_before >> 20} MB")
+    # always run even if a big entry already exists: the warm run doubles
+    # as the driver-default (K=8) data row, and on a warm cache it's a
+    # cheap cache hit, not a fresh compile
+    warm = record({}, timeout=3600)
+    t_after, b_after = cache_size()
+    log(f"cache after warm: total={t_after >> 20} MB "
+        f"biggest={b_after >> 20} MB "
+        f"({'OK: large TPU entry present' if b_after > 10 << 20 else 'WARN: no >10 MB entry — driver bench may still pay the compile'})")
+    if warm is None:
+        log("aborting: driver-default warm run failed/timed out")
+        sys.exit(2)
+
     steps = 20
     # pin K: bench.py defaults resnet50 to BENCH_K=8, but the sweep
     # isolates K explicitly per config
-    base = {"BENCH_STEPS": steps, "BENCH_K": 1}
+    # K1_CONTROL off inside the sweep: BENCH_K=1 is its own isolated row
+    # here, so the in-bench control would be redundant tunnel risk (the
+    # scrubbed warm run above keeps it — driver parity)
+    base = {"BENCH_STEPS": steps, "BENCH_K": 1, "BENCH_K1_CONTROL": 0}
     aborted = False
     # 1) dispatch-vs-compute: K sweep at the round-2 config (b128, already
-    #    the cheapest compile; K=1 first so the base step compiles alone)
-    for k in ([1, 8] if quick else [1, 5, 20]):
+    #    the cheapest compile; K=1 first so the base step compiles alone;
+    #    K=8 is covered by the driver-default warm run above)
+    for k in ([1] if quick else [1, 5, 20]):
         if record({**base, "BENCH_K": k}) is None:
             log("aborting sweep (unhealthy run)")
             aborted = True
