@@ -143,6 +143,11 @@ class _Exporter:
                 "entries" % (len(data_names), data_names,
                              len(input_shapes)))
         assign = dict(zip(data_names, input_shapes))
+        # canonical name->shape map (declaration order, matching the
+        # documented input_shape contract); export_model reads this so the
+        # emitted input value_infos can never disagree with the shape pass
+        # on multi-input graphs whose consumption order differs
+        self.input_shape_assign = {k: tuple(v) for k, v in assign.items()}
         for idx, node in enumerate(self.nodes):
             try:
                 if node["op"] == "null":
@@ -593,12 +598,20 @@ def _exp_binop(ex, idx, node):
                 continue
             src_node = ex.nodes[node["inputs"][pos][0]]
             param = ex.params.get(src_node["name"])
-            if param is None:
-                raise NotImplementedError(
-                    "ONNX export: dot with %s on a non-parameter input "
-                    "needs a static rank; restructure with an explicit "
-                    "transpose" % flag)
-            rank = len(param.shape)
+            if param is not None:
+                rank = len(param.shape)
+            else:
+                # activations have a static rank too whenever the shape
+                # pass covered them (input_shape given) — only raise when
+                # the pass has a genuine gap
+                shp = ex.shapes.get(tuple(node["inputs"][pos]))
+                if shp is None:
+                    raise NotImplementedError(
+                        "ONNX export: dot with %s on a non-parameter input "
+                        "whose shape the annotation pass could not infer; "
+                        "pass input_shape or restructure with an explicit "
+                        "transpose" % flag)
+                rank = len(shp)
             if rank < 2:
                 continue  # dot_mx treats transpose on 1-D as a no-op
             perm = list(range(rank))
@@ -935,7 +948,26 @@ def export_model(sym, params, input_shape, input_type="float32",
     if len(input_shape) < len(data_inputs):
         raise ValueError("model has %d data inputs %r but input_shape has %d"
                          % (len(data_inputs), data_inputs, len(input_shape)))
-    shape_of = dict(zip(data_inputs, input_shape))
+    # one canonical name->shape assignment (declaration order, built by the
+    # shape pass) so the emitted input value_infos can never disagree with
+    # the shapes the exporters decomposed against. Consumed inputs the
+    # pass skipped (label-heuristic names like *_label that a real op
+    # reads) take the SPARE input_shape entries in consumption order —
+    # the legacy contract — and only a genuine shortfall raises.
+    canonical = dict(getattr(ex, "input_shape_assign", None)
+                     or zip(data_inputs, input_shape))
+    shape_of = dict(canonical)
+    spare = list(input_shape[len(canonical):])
+    for n in data_inputs:
+        if n not in shape_of:
+            if not spare:
+                raise ValueError(
+                    "graph consumes input %r which the shape pass "
+                    "skipped (label-heuristic name) and no spare "
+                    "input_shape entry remains; append its shape to "
+                    "input_shape (declared data inputs: %r)"
+                    % (n, sorted(canonical)))
+            shape_of[n] = spare.pop(0)
     for name in data_inputs:
         g.input.append(_vi(name, shape_of[name], elem))
 

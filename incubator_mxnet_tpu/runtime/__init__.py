@@ -40,7 +40,34 @@ def _so_dir():
     return cache
 
 
-_SO = os.path.join(_so_dir(), "libmxtpu_runtime.so")
+def _so_path(stem, src_name):
+    """Cache artifact path keyed by a hash of the C++ source: a cached .so
+    surviving a package upgrade (the user-cache dir outlives read-only
+    site-packages installs) must never be loaded against newer source with
+    a changed ABI — the hash suffix makes version skew a cache miss, not a
+    crash. Stale siblings from older sources are removed opportunistically."""
+    import hashlib
+    try:
+        with open(os.path.join(_DIR, "src", src_name), "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        return os.path.join(_so_dir(), f"{stem}.so")
+    d = _so_dir()
+    path = os.path.join(d, f"{stem}.{tag}.so")
+    try:
+        import re
+        for fn in os.listdir(d):
+            # only hash-suffixed siblings: a plain <stem>.so may be a
+            # developer's deliberate Makefile artifact, not our cache
+            if re.fullmatch(re.escape(stem) + r"\.[0-9a-f]{12}\.so", fn) \
+                    and os.path.join(d, fn) != path:
+                os.unlink(os.path.join(d, fn))
+    except OSError:
+        pass
+    return path
+
+
+_SO = _so_path("libmxtpu_runtime", "runtime.cc")
 _lib = None
 _build_failed = False
 _build_lock = threading.Lock()
@@ -50,25 +77,35 @@ def _build_so(src_name, so_path, extra_flags=()):
     """First-use g++ build of a native component: compiles to a pid-unique
     temp file and os.replace()s it into place (atomic on POSIX), so
     concurrent importers (pytest-xdist, DataLoader workers) never observe
-    a partially written .so. Returns the loaded CDLL or None."""
-    if not os.path.exists(so_path):
-        src = os.path.join(_DIR, "src", src_name)
-        tmp = f"{so_path}.tmp.{os.getpid()}"
-        try:
-            subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
-                            "-o", tmp, src, *extra_flags],
-                           check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so_path)
-        except Exception:
+    a partially written .so. Returns the loaded CDLL or None.
+
+    Two passes: a concurrent process sharing the cache dir (e.g. a
+    different package version doing its stale-sibling cleanup) can unlink
+    the artifact between our exists() check and CDLL load — rebuild once
+    instead of permanently disabling the native engine."""
+    for _ in range(2):
+        if not os.path.exists(so_path):
+            src = os.path.join(_DIR, "src", src_name)
+            tmp = f"{so_path}.tmp.{os.getpid()}"
             try:
-                os.unlink(tmp)
+                subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC",
+                                "-shared", "-o", tmp, src, *extra_flags],
+                               check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so_path)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+        try:
+            return ctypes.CDLL(so_path)
+        except OSError:
+            try:
+                os.unlink(so_path)   # corrupt or raced away: rebuild
             except OSError:
                 pass
-            return None
-    try:
-        return ctypes.CDLL(so_path)
-    except OSError:
-        return None
+    return None
 
 
 def _build_and_load():
@@ -496,7 +533,7 @@ class TokenQueue:
 # toolchain/libjpeg only disables this path; callers fall back to PIL.
 # ---------------------------------------------------------------------------
 
-_IMG_SO = os.path.join(_so_dir(), "libmxtpu_imgdec.so")
+_IMG_SO = _so_path("libmxtpu_imgdec", "imgdec.cc")
 _img_lib = None
 _img_build_failed = False
 _img_lock = threading.Lock()

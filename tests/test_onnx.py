@@ -322,12 +322,48 @@ def test_dot_transpose_b_exports_correctly():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_dot_transpose_on_activation_input_raises():
+def test_dot_transpose_on_activation_input():
+    # b is a graph input (not in params); its rank comes from the shape
+    # pass, so the export succeeds and matches eager numerics
     from incubator_mxnet_tpu import symbol as S
     s = S.dot(S.Variable("a"), S.Variable("b"), transpose_b=True)
+    buf = onnx_mxnet.export_model(s, {}, [(3, 5), (6, 5)])
+    sym2, arg2, aux2 = onnx_mxnet.import_model(buf)
+    rng = np.random.RandomState(0)
+    a = mx.nd.array(rng.rand(3, 5).astype(np.float32))
+    b = mx.nd.array(rng.rand(6, 5).astype(np.float32))
+    out = sym2.bind(mx.cpu(), {**arg2, **aux2, "a": a, "b": b}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() @ b.asnumpy().T,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dot_transpose_shape_gap_raises():
+    # no input_shape -> the shape pass never ran -> activation rank is a
+    # genuine gap and the exporter must refuse with guidance
+    from incubator_mxnet_tpu import symbol as S
+    from incubator_mxnet_tpu.contrib.onnx import _Exporter
+    import json as _json
+    s = S.dot(S.Variable("a"), S.Variable("b"), transpose_b=True)
+    ex = _Exporter(_json.loads(s.tojson()), {}, 13, np.float32,
+                   input_shapes=None)
     with pytest.raises(NotImplementedError, match="transpose"):
-        # b is a graph input (not in params) -> rank unknown -> refuse
-        onnx_mxnet.export_model(s, {}, [(3, 5), (6, 5)])
+        ex.run()
+
+
+def test_consumed_label_input_uses_spare_shape_entry():
+    # *_label names are skipped by the shape pass's label heuristic, but a
+    # graph that really consumes one stays exportable via a spare
+    # input_shape entry — and a missing spare raises with guidance
+    from incubator_mxnet_tpu import symbol as S
+    s = S.broadcast_add(S.Variable("x"), S.Variable("w_label"))
+    buf = onnx_mxnet.export_model(s, {}, [(2, 3), (2, 3)])
+    m = onnx_mxnet._load_model_proto(buf)
+    shapes = {i.name: tuple(d.dim_value for d in i.type.tensor_type.shape.dim)
+              for i in m.graph.input}
+    assert shapes == {"x": (2, 3), "w_label": (2, 3)}
+    with pytest.raises(ValueError, match="input_shape has"):
+        onnx_mxnet.export_model(s, {}, [(2, 3)])
 
 
 class TestTransformerONNX:

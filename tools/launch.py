@@ -82,15 +82,47 @@ def main(argv=None):
     if args.coordinator:
         coordinator = args.coordinator
     elif hosts:
-        # the port must be free on hosts[0], which we can't probe from
-        # here — pick from a wide random range and tell the operator the
-        # authoritative fix is --coordinator host0:port
+        # the port must be free on hosts[0]: probe candidates there over
+        # ssh (a one-line bind test) so a busy port surfaces here as a
+        # retried candidate, not later as every worker's opaque rendezvous
+        # failure; if the probe itself can't run, fall back to random
         import random
-        port = random.randint(20000, 59999)
+        port = None
+        probes_ran = 0
+        for cand in random.sample(range(20000, 60000), 4):
+            try:
+                r = subprocess.run(
+                    ["ssh", "-o", "BatchMode=yes", hosts[0],
+                     f"python3 -c \"import socket; s=socket.socket(); "
+                     f"s.bind(('', {cand})); s.close()\""],
+                    capture_output=True, timeout=15)
+            except Exception:  # ssh missing/unreachable: can't probe
+                break
+            probes_ran += 1
+            if r.returncode == 0:
+                port = cand
+                break
+            if r.returncode in (255, 127):
+                # 255 = ssh transport/auth failure, 127 = no python3 on
+                # the host: retrying other ports can never succeed, and
+                # "port busy" would send the operator down the wrong path
+                print(f"launch: cannot probe ports on {hosts[0]} "
+                      f"(ssh/python3 failure rc={r.returncode}: "
+                      f"{r.stderr.decode(errors='replace').strip()[:120]})",
+                      file=sys.stderr)
+                break
+            print(f"launch: port {cand} busy on {hosts[0]}; retrying",
+                  file=sys.stderr)
+        if port is None:
+            port = random.randint(20000, 59999)
+            why = (f"all {probes_ran} probed candidates were busy/refused"
+                   if probes_ran == 4 else
+                   f"probing stopped after {probes_ran} attempts")
+            print(f"launch: {why} on {hosts[0]}; using unverified port "
+                  f"{port}", file=sys.stderr)
         coordinator = f"{hosts[0]}:{port}"
-        print(f"launch: coordinator {coordinator} (random port; pass "
-              "--coordinator to pin one known-free on that host)",
-              file=sys.stderr)
+        print(f"launch: coordinator {coordinator} (pass --coordinator to "
+              "pin one known-free on that host)", file=sys.stderr)
     else:
         coordinator = f"127.0.0.1:{_free_port()}"
 
@@ -147,6 +179,12 @@ def main(argv=None):
                     print(f"launch: worker {rank} exited "
                           f"rc={p.returncode}; terminating the rest",
                           file=sys.stderr)
+                    if hosts and not args.coordinator:
+                        print("launch: if workers died in distributed "
+                              f"rendezvous, the coordinator port on "
+                              f"{hosts[0]} may be busy — rerun with "
+                              "--coordinator host:port pinned to a "
+                              "known-free port", file=sys.stderr)
                     rc = rc or p.returncode
                     _terminate()
         if live:
