@@ -525,15 +525,12 @@ def main():
     float(step(x, y))
 
     # BENCH_K > 1: dispatch k micro-steps as ONE XLA program (lax.scan in
-    # FusedTrainStep.run_k) — amortizes the per-step relay/host dispatch
-    # latency, the dominant cost through the axon tunnel. Default 8 for
-    # the headline resnet50 config: the only chip datapoint (r02, 80 ms/
-    # step @ b128 ≈ 10% MFU vs a ~26 ms compute-bound step) points at
-    # dispatch latency, which the scan amortizes ~k-fold; the scan body
-    # compiles once so the extra cost is one bounded compile. BENCH_K=1
-    # restores per-step dispatch.
-    k = int(os.environ.get("BENCH_K",
-                           "8" if model == "resnet50" else "1"))
+    # FusedTrainStep.run_k) — amortizes per-step relay/host dispatch
+    # latency. Default 1 since the r05 on-chip sweep MEASURED the k
+    # hypothesis and refuted it: k=1 2064 img/s vs k=8 2015 img/s at the
+    # same config (PERF.md) — the 62 ms step is device-bound, not
+    # dispatch-bound, so the scan only adds compile surface.
+    k = int(os.environ.get("BENCH_K", "1"))
     if k > 1:
         import jax.numpy as jnp
         xs = jnp.broadcast_to(x._data, (k,) + x._data.shape)

@@ -254,9 +254,41 @@ def _grad_impl(heads, head_grads, variables, create_graph):
     # final total is combined with any pre-existing .grad).
     leaf_acc = {}
 
+    # Early finalization (backward mode): once the walk passes the LAST
+    # node that can contribute to a leaf, that leaf's grad is final — write
+    # it and fire its grad hook right there, mid-backward. This is the
+    # readiness signal ByteScheduler-style overlapped communication needs
+    # (reference: BytePS per-tensor ready callbacks); without it every
+    # push would wait for the whole backward pass.
+    rev_order = list(reversed(order))
+    finalize_after = {}
+    if variables is None:
+        last_contrib = {}
+        any_hook = False
+        for idx, node in enumerate(rev_order):
+            for leaf in node.leaf_refs:
+                if leaf is not None:
+                    last_contrib[id(leaf)] = idx
+                    any_hook = any_hook or leaf._grad_hook is not None
+        # no hooks registered -> skip the per-node finalize machinery
+        # entirely (hot single-chip loops pay nothing; hooks then fire in
+        # the end-of-walk loop, which is the no-overlap behavior anyway)
+        if any_hook:
+            for lid, idx in last_contrib.items():
+                finalize_after.setdefault(idx, []).append(lid)
+
+    def _finalize(lid):
+        ent = leaf_acc.pop(lid, None)
+        if ent is None:
+            return
+        leaf, g = ent
+        _accumulate_leaf(leaf, g)
+        if leaf._grad_hook is not None:
+            leaf._grad_hook(leaf)
+
     rec_scope = record() if create_graph else pause()
     with rec_scope:
-        for node in reversed(order):
+        for walk_idx, node in enumerate(rev_order):
             outs = []
             have_any = False
             for oi in range(node.n_out):
@@ -268,6 +300,8 @@ def _grad_impl(heads, head_grads, variables, create_graph):
                     have_any = True
                 outs.append(c)
             if not have_any:
+                for lid in finalize_after.get(walk_idx, ()):
+                    _finalize(lid)
                 continue
             n_in = len(node.input_values)
             if isinstance(node.fn, _CustomFn):
@@ -310,9 +344,16 @@ def _grad_impl(heads, head_grads, variables, create_graph):
                         else:
                             leaf_acc[k] = (leaf, g)
                 # else: constant input, discard
+            for lid in finalize_after.get(walk_idx, ()):
+                _finalize(lid)
 
-        for leaf, g in leaf_acc.values():
+        # leaves the early pass missed (explicit-variables mode runs
+        # entirely here; hooks still fire so overlap degrades gracefully)
+        for leaf, g in list(leaf_acc.values()):
             _accumulate_leaf(leaf, g)
+            if leaf._grad_hook is not None:
+                leaf._grad_hook(leaf)
+        leaf_acc.clear()
 
     return var_grads
 

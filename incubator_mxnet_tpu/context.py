@@ -72,9 +72,18 @@ class Context:
         return default_context()
 
 
+def _local(devs):
+    """Process-local (addressable) devices only: in a multi-process
+    cluster `mx.cpu(0)`/`mx.tpu(0)` means THIS worker's device 0, exactly
+    as the reference's `mx.gpu(0)` is local to its worker — and jax
+    refuses to place data on another process's devices anyway."""
+    mine = [d for d in devs if d.process_index == jax.process_index()]
+    return mine or devs
+
+
 def _platform_devices(platform: str):
     try:
-        return jax.devices(platform)
+        return _local(jax.devices(platform))
     except RuntimeError:
         return []
 
@@ -86,7 +95,8 @@ def _resolve_device(device_type: str, device_id: int) -> jax.Device:
         # TPU may surface under an experimental platform name; fall back to
         # whatever the default backend exposes if it is not plain CPU, else
         # (CPU-only test envs) use CPU so `tpu()` code still runs.
-        devs = [d for d in jax.devices() if d.platform != "cpu"] or _platform_devices("cpu")
+        devs = _local([d for d in jax.devices() if d.platform != "cpu"]) \
+            or _platform_devices("cpu")
     if not devs:
         raise ValueError(
             f"No device of type {device_type!r} available (jax platforms: "
@@ -105,7 +115,7 @@ def default_context() -> Context:
     TPU host, CPU in the test environment)."""
     global _default_ctx
     if _default_ctx is None:
-        dev = jax.devices()[0]
+        dev = _local(jax.devices())[0]
         devtype = "tpu" if dev.platform not in ("cpu", "gpu", "cuda") else dev.platform
         ctx = Context(devtype, 0)
         ctx._device = dev

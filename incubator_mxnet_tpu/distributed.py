@@ -81,6 +81,11 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
 
 def shutdown():
     if _state["initialized"]:
+        # stop any dist_async server threads FIRST: a grpc poll in flight
+        # while the coordination client is destroyed aborts the process
+        # (C++ exception in a detached thread)
+        from .kvstore import async_ps
+        async_ps.stop_all()
         jax.distributed.shutdown()
         _state["initialized"] = False
 

@@ -172,6 +172,20 @@ class Parameter:
             self._data._grad._data = nd.zeros(self._data.shape,
                                               dtype=self._data._data.dtype)._data
 
+    def register_grad_hook(self, fn):
+        """``fn(self)`` fires the moment this parameter's gradient is
+        finalized inside ``autograd.backward`` — i.e. mid-backward, as
+        soon as no remaining node can contribute to it. The readiness
+        signal for overlapped gradient communication (reference: BytePS /
+        ByteScheduler per-tensor ready callbacks in ps-lite's push/pull
+        pipeline). ``fn=None`` clears. Requires an initialized parameter
+        (call after ``initialize()``/first forward for deferred shapes)."""
+        if self._data is None:
+            raise RuntimeError(
+                f"Parameter {self.name} is not initialized; grad hooks "
+                "attach to the parameter's storage")
+        self._data._grad_hook = None if fn is None else (lambda _leaf: fn(self))
+
     def list_ctx(self):
         return [self._data.context] if self._data is not None else []
 

@@ -20,9 +20,142 @@ from .parameter import Parameter, ParameterDict
 __all__ = ["Trainer"]
 
 
+class _GradCommScheduler:
+    """ByteScheduler-style priority scheduler for gradient aggregation
+    (reference: ps-lite push/pull pipelining in src/kvstore/kvstore_dist.h
+    and the BytePS/ByteScheduler papers the ymjiang fork exists for).
+
+    Semantics rebuilt TPU-native:
+
+    * **readiness** — parameters' grad hooks fire mid-backward the moment
+      each gradient is finalized (reverse layer order), not at step();
+    * **priority** — forward-order parameter index, ascending: the next
+      iteration's forward is unblocked by the FRONT layers, so when
+      several buckets are ready the front-most is issued first;
+    * **overlap** — each issued aggregation is an XLA computation that
+      dispatches asynchronously, so device collective work runs while the
+      host continues the remaining backward walk (the reference overlaps
+      NCCL/ps-lite transfers the same way);
+    * **credit** — at most ``credit_bytes`` of aggregation may be in
+      flight (completion polled via ``jax.Array.is_ready``); when credit
+      is exhausted, ready buckets wait in a priority heap — so a
+      front-layer gradient arriving later OVERTAKES queued lower-priority
+      buckets, which is the ByteScheduler reordering;
+    * **bucketing** — consecutive parameters are grouped into ~``
+      bucket_bytes`` buckets (0 = one bucket per parameter); a bucket
+      issues once every member's grad is ready.
+
+    ``step()`` calls ``flush()`` which force-issues stragglers (params
+    that never fired — e.g. unused this pass) and drains the heap, so the
+    result is always bit-identical to the unscheduled batched path.
+    """
+
+    def __init__(self, kvstore, params, bucket_bytes=0,
+                 credit_bytes=4 << 20):
+        self._kv = kvstore
+        self._params = params
+        self._bucket_bytes = int(bucket_bytes)
+        self._credit = int(credit_bytes)
+        self._buckets = []           # list[list[int]] consecutive indices
+        self._bucket_of = {}         # param idx -> bucket idx
+        self._rebucket()
+        self._ready = set()          # param indices with finalized grads
+        self._issued = set()         # bucket indices already issued
+        self._heap = []              # [(priority, bucket_idx)]
+        self._inflight = []          # [(nbytes, [jax.Array])]
+        self.issued_log = []         # bucket priority order (tests/debug)
+
+    def _rebucket(self):
+        self._buckets, self._bucket_of = [], {}
+        cur, cur_bytes = [], 0
+        for i, p in enumerate(self._params):
+            nbytes = 4 * int(np.prod(p.shape)) if p.shape_is_known else 0
+            cur.append(i)
+            cur_bytes += nbytes
+            if self._bucket_bytes <= 0 or cur_bytes >= self._bucket_bytes:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            self._buckets.append(cur)
+        for b, members in enumerate(self._buckets):
+            for i in members:
+                self._bucket_of[i] = b
+
+    # -- readiness --------------------------------------------------------
+    def notify(self, i):
+        """Param i's grad finalized mid-backward: queue its bucket when
+        complete, then drain as much as credit allows."""
+        import heapq
+        if self._kv.num_workers <= 1:
+            return                    # nothing to aggregate: keep backward hot
+        b = self._bucket_of[i]
+        if i in self._ready or b in self._issued:
+            # a SECOND finalization before step(): the bucket's aggregated
+            # value is already (or about to be) replaced by the collective,
+            # so re-aggregating would double-count the earlier contribution
+            # across workers. Real overlapped schedulers (BytePS) share
+            # this one-push-per-iteration contract.
+            raise RuntimeError(
+                "overlap_comm saw a second backward pass before step(); "
+                "gradient accumulation across multiple backwards is not "
+                "compatible with mid-backward aggregation — call step() "
+                "after each backward, or construct the Trainer with "
+                "overlap_comm=False")
+        self._ready.add(i)
+        if all(j in self._ready for j in self._buckets[b]):
+            heapq.heappush(self._heap, (self._buckets[b][0], b))
+            self._issued.add(b)
+        self._drain(force=False)
+
+    # -- issue ------------------------------------------------------------
+    def _inflight_bytes(self):
+        self._inflight = [(n, arrs) for n, arrs in self._inflight
+                          if not all(a.is_ready() for a in arrs)]
+        return sum(n for n, _ in self._inflight)
+
+    def _issue(self, b):
+        members = self._buckets[b]
+        grads = [self._params[i].grad() for i in members]
+        keys = [f"grad{i}" for i in members]
+        self._kv.pushpull(keys, grads, out=grads)
+        self.issued_log.append(b)
+        nbytes = sum(int(np.prod(g.shape)) * g._data.dtype.itemsize
+                     for g in grads)
+        self._inflight.append((nbytes, [g._data for g in grads]))
+
+    def _drain(self, force):
+        import heapq
+        while self._heap:
+            if not force and self._inflight_bytes() >= self._credit:
+                return
+            _, b = heapq.heappop(self._heap)
+            self._issue(b)
+
+    def flush(self):
+        """step(): issue stragglers (whole-bucket, priority order) and
+        drain the heap unconditionally; afterwards every param's .grad()
+        holds the aggregated value, as the batched path would."""
+        import heapq
+        if self._kv.num_workers <= 1:
+            return
+        # EVERY bucket not yet issued goes now — including ones whose
+        # hooks never fired (deferred-init params, partial buckets): the
+        # batched path aggregates all params, and parity is the contract
+        for b, members in enumerate(self._buckets):
+            if b not in self._issued:
+                heapq.heappush(self._heap, (members[0], b))
+                self._issued.add(b)
+        self._drain(force=True)
+        self._ready.clear()
+        self._issued.clear()
+        self._inflight.clear()
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 overlap_comm=False, comm_bucket_bytes=0,
+                 comm_credit_bytes=4 << 20):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -43,6 +176,27 @@ class Trainer:
             self._kvstore = (kvstore if isinstance(kvstore, kvs_mod.KVStore)
                              else kvs_mod.create(kvstore))
         self._scale = 1.0
+        self._sched = None
+        if overlap_comm:
+            if self._kvstore is None:
+                raise ValueError("overlap_comm=True requires a kvstore")
+            self._sched = _GradCommScheduler(
+                self._kvstore, self._params,
+                bucket_bytes=comm_bucket_bytes,
+                credit_bytes=comm_credit_bytes)
+            self._hooked = [False] * len(self._params)
+            self._ensure_grad_hooks()
+
+    def _ensure_grad_hooks(self):
+        """Attach readiness hooks to every initialized param; deferred-init
+        params get theirs on a later call (their first backward simply
+        falls back to flush-time aggregation — numerics are unchanged)."""
+        sched = self._sched
+        for i, p in enumerate(self._params):
+            if not self._hooked[i] and p._data is not None:
+                p.register_grad_hook(
+                    lambda _p, _i=i: sched.notify(_i))
+                self._hooked[i] = True
 
     # -- properties -------------------------------------------------------
     @property
@@ -66,6 +220,12 @@ class Trainer:
     def allreduce_grads(self):
         """Aggregate gradients across devices/workers. Single-chip: no-op.
         The mesh path does this inside the compiled step via psum."""
+        if self._sched is not None:
+            # overlapped path: most buckets were issued mid-backward by
+            # grad hooks; flush issues stragglers and resets the pass
+            self._ensure_grad_hooks()
+            self._sched.flush()
+            return
         if self._kvstore is not None and self._kvstore.num_workers > 1:
             grads = [p.grad() for p in self._params]
             keys = [f"grad{i}" for i in range(len(grads))]

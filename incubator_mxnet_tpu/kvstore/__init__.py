@@ -188,34 +188,42 @@ class _AsyncQueue:
         self.max_delay = max_delay
         self.delayed_total = 0  # pushes that were held back at least once
         self.applied_total = 0
+        # on rank 0 of a cross-process cluster BOTH the main thread
+        # (barrier/flush) and the AsyncPSTransport server thread mutate
+        # this queue; unlocked, a push between _drain's iteration and its
+        # reassignment of _pending would be silently dropped
+        self._qlock = threading.RLock()
 
     def push(self, key, grad):
-        self._pending.append([0, key, grad])
-        self._drain(force=False)
+        with self._qlock:
+            self._pending.append([0, key, grad])
+            self._drain(force=False)
 
     def _drain(self, force):
-        now, keep = [], []
-        for item in self._pending:
-            overdue = item[0] >= self.max_delay
-            if force or overdue or self._rng.rand() < 0.5:
-                now.append(item)
-            else:
-                if item[0] == 0:
-                    self.delayed_total += 1  # distinct pushes held back
-                item[0] += 1
-                keep.append(item)
-        self._rng.shuffle(now)
-        for _, k, g in now:
-            self._apply(k, g)
-            self.applied_total += 1
-        self._pending = keep
+        with self._qlock:
+            now, keep = [], []
+            for item in self._pending:
+                overdue = item[0] >= self.max_delay
+                if force or overdue or self._rng.rand() < 0.5:
+                    now.append(item)
+                else:
+                    if item[0] == 0:
+                        self.delayed_total += 1  # distinct pushes held back
+                    item[0] += 1
+                    keep.append(item)
+            self._rng.shuffle(now)
+            for _, k, g in now:
+                self._apply(k, g)
+                self.applied_total += 1
+            self._pending = keep
 
     def flush(self):
         self._drain(force=True)
 
     @property
     def pending_count(self):
-        return len(self._pending)
+        with self._qlock:
+            return len(self._pending)
 
 
 class KVStore:
@@ -229,8 +237,29 @@ class KVStore:
         self._compression = None
         self._residuals = {}
         self._allreduce = _BucketedAllReduce()
-        self._async_queue = (_AsyncQueue(self._apply_one_update)
+        self._async_queue = (_AsyncQueue(self._async_apply)
                              if self._is_async else None)
+        self._async_ps = None     # cross-process transport, created lazily
+
+    def _ps(self):
+        """Cross-process async transport (kvstore/async_ps.py), active
+        when this is a dist_async store in a real multi-process cluster.
+        Lazy: the store may be created before mx.distributed.init()."""
+        if not self._is_async or jax.process_count() <= 1:
+            return None
+        if self._async_ps is None:
+            from .async_ps import AsyncPSTransport
+            self._async_ps = AsyncPSTransport(self)
+        return self._async_ps
+
+    def _async_apply(self, key, grad):
+        """Apply target for the async queue: plain keys are this
+        process's virtual-worker pushes; (key, rank) tuples were tagged
+        by the cross-process server for per-worker accounting."""
+        if isinstance(key, tuple):
+            self._async_ps._apply(key, grad)
+        else:
+            self._apply_one_update(key, grad)
 
     # -- topology ---------------------------------------------------------
     @property
@@ -248,6 +277,10 @@ class KVStore:
                 self.init(k, v)
             return
         self._store[key] = value.copy() if isinstance(value, NDArray) else NDArray(value)
+        ps = self._ps()
+        if ps is not None:
+            # server publishes initial weights; workers block until seen
+            ps.publish_init(key, self._store[key].asnumpy())
 
     def _compress(self, values):
         """Apply gradient compression per device slot with error-feedback
@@ -304,13 +337,19 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         if self._is_async:
+            ps = self._ps()
             keys = key if isinstance(key, (list, tuple)) else [key]
             vals = value if isinstance(key, (list, tuple)) else [value]
             for k, v in zip(keys, vals):
                 slots = list(v) if isinstance(v, (list, tuple)) else [v]
                 slots = self._compress_slots(k, slots)
                 for g in slots:  # each device slot = one virtual worker
-                    self._async_queue.push(k, g)
+                    if ps is not None:
+                        # cross-process: ship to the rank-0 server, which
+                        # applies it in genuine arrival order
+                        ps.push(k, np.asarray(g))
+                    else:
+                        self._async_queue.push(k, g)
             return
         if isinstance(key, (list, tuple)):
             aggs = self._batch_aggregate(key, value)
@@ -327,7 +366,7 @@ class KVStore:
             raise ValueError("set_async_staleness requires a dist_async "
                              "store, got %r" % self.type)
         self._async_queue.flush()  # don't drop in-flight delayed pushes
-        self._async_queue = _AsyncQueue(self._apply_one_update,
+        self._async_queue = _AsyncQueue(self._async_apply,
                                         max_delay=max_delay, seed=seed)
 
     def _apply_one_update(self, key, grad):
@@ -364,7 +403,15 @@ class KVStore:
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
-        src = self._store[key]
+        ps = self._ps()
+        if ps is not None and ps.rank != 0:
+            # CURRENT published server weights — in-flight pushes may be
+            # missing, which is the async contract. (Rank 0 reads its own
+            # store: the server thread updates it in place, and swapping
+            # the entry here would race a concurrent update.)
+            src = NDArray(ps.pull(key))
+        else:
+            src = self._store[key]
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             src.copyto(o)
@@ -381,6 +428,11 @@ class KVStore:
             if out is not None:
                 self.pull(key, out=out)
                 return None
+            ps = self._ps()
+            if ps is not None and ps.rank != 0:
+                if isinstance(key, (list, tuple)):
+                    return [NDArray(ps.pull(k)) for k in key]
+                return NDArray(ps.pull(key))
             if isinstance(key, (list, tuple)):
                 return [self._store[k].copy() for k in key]
             return self._store[key].copy()
@@ -457,7 +509,25 @@ class KVStore:
         self._states = {k: jax.tree_util.tree_map(jnp.asarray, v)
                         for k, v in blob.items()}
 
+    def async_applied_counts(self):
+        """dist_async: per-worker counts of server-applied updates.
+        Cross-process these come from the rank-0 server's published
+        accounting; single-process, all pushes are worker 0's."""
+        if not self._is_async:
+            raise ValueError("async_applied_counts requires dist_async")
+        ps = self._ps()
+        if ps is not None:
+            return ps.applied_counts()
+        return {0: self._async_queue.applied_total}
+
     def barrier(self):
+        ps = self._ps() if self._is_async else None
+        if ps is not None:
+            # wait until MY pushes are all server-applied, then rendezvous
+            # with the other workers (reference: Barrier on the server)
+            ps.flush()
+            from .. import distributed
+            distributed.barrier("mxtpu_kv_barrier")
         if self._async_queue is not None:
             self._async_queue.flush()  # drain in-flight async pushes
         from ..ndarray import waitall

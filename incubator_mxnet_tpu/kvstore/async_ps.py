@@ -1,0 +1,238 @@
+"""Cross-process asynchronous parameter server for `dist_async`.
+
+Parity: the reference's ps-lite server path (`src/kvstore/
+kvstore_dist_server.h`) — each worker's push is applied as its OWN
+server-side optimizer update in arrival order, with no cross-worker
+aggregation barrier, and pulls return the server's CURRENT weights
+(possibly missing other workers' in-flight pushes).
+
+TPU-native rebuild: there are no ps-lite server processes to rebuild —
+the wire is the jax coordination service's key-value store (the same
+channel `jax.distributed` already runs on), and rank 0 hosts the server
+state. Workers publish pickled gradients under per-worker monotonic
+sequence keys (per-worker FIFO — ps-lite's ordering guarantee); a server
+thread on rank 0 discovers them by polling, feeds them through the
+store's `_AsyncQueue` (so `set_async_staleness` bounds REAL cross-process
+staleness too), applies them with the server-side optimizer, and
+republishes weights. Cross-worker interleaving is genuine arrival
+nondeterminism: grpc delivery and poll timing decide it.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+import numpy as np
+
+_NS = "mxtpu_aps"
+_LIVE = []      # live transports; distributed.shutdown() stops them first
+
+
+def stop_all(timeout=5.0):
+    """Stop every live server thread (joined, not abandoned): called by
+    mx.distributed.shutdown() before the coordination client dies."""
+    for t in list(_LIVE):
+        t.stop()
+    for t in list(_LIVE):
+        if t._thread is not None:
+            t._thread.join(timeout)
+    _LIVE.clear()
+
+
+def _client():
+    from jax._src import distributed
+    c = distributed.global_state.client
+    if c is None:
+        raise RuntimeError(
+            "dist_async across processes needs jax.distributed "
+            "(mx.distributed.init()) — the coordination service is the "
+            "transport")
+    return c
+
+
+class AsyncPSTransport:
+    """One per dist_async KVStore when process_count > 1."""
+
+    def __init__(self, kv, poll_ms=2.0):
+        import jax
+        self._kv = kv
+        self._c = _client()
+        self.rank = jax.process_index()
+        self.nproc = jax.process_count()
+        self._seq = 0                 # my push sequence (per-worker FIFO)
+        self._pushed = 0
+        self._poll_s = poll_ms / 1e3
+        self._stop = threading.Event()
+        self._applied = {}            # server: worker rank -> applied count
+        self._touched = set()         # server: keys updated since publish
+        self._lock = threading.Lock()
+        self._thread = None
+        if self.rank == 0:
+            self._thread = threading.Thread(target=self._serve, daemon=True)
+            self._thread.start()
+        _LIVE.append(self)
+
+    # -- worker side -------------------------------------------------------
+    def publish_init(self, key, value_np):
+        """Rank 0 publishes initial weights; others wait for them (the
+        reference's init-on-server + worker pull-before-train)."""
+        if self.rank == 0:
+            self._c.key_value_set_bytes(
+                f"{_NS}/w/{key}", pickle.dumps(np.asarray(value_np)),
+                allow_overwrite=True)
+        else:
+            self._c.blocking_key_value_get_bytes(f"{_NS}/w/{key}", 60_000)
+
+    def push(self, key, grad_np):
+        from urllib.parse import quote
+        self._seq += 1
+        self._pushed += 1
+        # quote the user key: kvstore keys may contain '/' (layer paths),
+        # which would corrupt the wire-key structure the server parses
+        self._c.key_value_set_bytes(
+            f"{_NS}/push/{self.rank:04d}/{self._seq:012d}/"
+            f"{quote(str(key), safe='')}",
+            pickle.dumps(np.asarray(grad_np)))
+
+    def pull(self, key):
+        blob = self._c.blocking_key_value_get_bytes(f"{_NS}/w/{key}", 60_000)
+        return pickle.loads(blob)
+
+    def _try_get(self, key):
+        """try_get that treats NOT_FOUND as None (the client raises)."""
+        try:
+            return self._c.key_value_try_get_bytes(key)
+        except Exception:
+            return None
+
+    def flush(self):
+        """Block until every push THIS worker issued has been applied
+        server-side (the reference's per-worker Wait on the send queue).
+        Signals the server to force-drain any staleness-delayed entries."""
+        self._c.key_value_set_bytes(f"{_NS}/flushreq/{self.rank}", b"1",
+                                    allow_overwrite=True)
+        if self._pushed == 0:
+            return   # nothing to wait for (the flushreq still releases
+                     # any delayed peers' entries on the server)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            blob = self._try_get(f"{_NS}/applied/{self.rank}")
+            if blob is not None and int(blob) >= self._pushed:
+                return
+            time.sleep(self._poll_s)
+        raise TimeoutError(
+            f"dist_async flush: rank {self.rank} pushed {self._pushed} "
+            "but the server did not acknowledge them in 120s")
+
+    def wait_outstanding(self, max_outstanding, timeout=60.0):
+        """Block until at most `max_outstanding` of MY pushes are still
+        unapplied — the worker-side pacing ps-lite gets implicitly from
+        pulling updated weights after each push. Cross-worker staleness
+        stays unbounded; only a worker's lead over ITSELF is capped."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            blob = self._try_get(f"{_NS}/applied/{self.rank}")
+            applied = int(blob) if blob is not None else 0
+            if self._pushed - applied <= max_outstanding:
+                return
+            time.sleep(self._poll_s)
+        raise TimeoutError(
+            f"rank {self.rank}: {self._pushed} pushed but server applied "
+            f"only {applied} after {timeout}s")
+
+    def applied_counts(self):
+        """Per-worker applied-update counts as published by the server."""
+        out = {}
+        for r in range(self.nproc):
+            blob = self._try_get(f"{_NS}/applied/{r}")
+            out[r] = int(blob) if blob is not None else 0
+        return out
+
+    def stop(self):
+        self._stop.set()
+
+    # -- server side (rank 0 thread) --------------------------------------
+    def _apply(self, tagged_key, grad):
+        """_AsyncQueue apply hook: one worker push = one optimizer step."""
+        key, rank = tagged_key
+        self._kv._apply_one_update(key, grad)
+        with self._lock:
+            self._applied[rank] = self._applied.get(rank, 0) + 1
+            self._touched.add(key)
+
+    def _publish(self):
+        with self._lock:
+            touched, self._touched = self._touched, set()
+            applied = dict(self._applied)
+        for key in touched:
+            w = self._kv._store[key]
+            self._c.key_value_set_bytes(
+                f"{_NS}/w/{key}", pickle.dumps(np.asarray(w.asnumpy())),
+                allow_overwrite=True)
+        for rank, n in applied.items():
+            self._c.key_value_set_bytes(f"{_NS}/applied/{rank}",
+                                        str(n).encode(),
+                                        allow_overwrite=True)
+
+    def _serve(self):
+        import sys
+        from urllib.parse import unquote
+        from ..ndarray import NDArray
+        queue = lambda: self._kv._async_queue  # noqa: E731 — swappable via
+        last_seq = {}                         # set_async_staleness
+        while not self._stop.is_set():
+            try:
+                entries = self._c.key_value_dir_get_bytes(f"{_NS}/push/")
+            except Exception:
+                # NOT_FOUND = simply no pending pushes; real transport
+                # failures land here too and resolve when the daemon
+                # thread dies with the process
+                entries = []
+            # dir order is key-sorted: per-worker FIFO by sequence number;
+            # cross-worker interleave = whatever had ARRIVED by this poll.
+            # Per-entry guard: one malformed/poison entry must not kill
+            # the server thread (workers would block until flush timeout).
+            for k, blob in entries:
+                try:
+                    parts = k.rsplit("/", 3)  # .../push/<rank>/<seq>/<key>
+                    rank, seq = int(parts[1]), int(parts[2])
+                    key = unquote(parts[3])
+                    # seq dedup: if a delete failed last round the entry
+                    # reappears — applying it twice would double-update
+                    if seq > last_seq.get(rank, 0):
+                        grad = pickle.loads(blob)
+                        queue().push((key, rank), NDArray(np.asarray(grad)))
+                        last_seq[rank] = seq
+                except Exception as e:  # noqa: BLE001
+                    print(f"mxtpu dist_async server: dropping push "
+                          f"{k!r}: {type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
+                try:
+                    self._c.key_value_delete(k)
+                except Exception:
+                    pass
+            q = queue()
+            if not entries and q.pending_count:
+                # a service round with no arrivals still ages held-back
+                # entries, so induced staleness releases by TIME as well
+                # as by traffic (otherwise a quiet wire deadlocks pacing
+                # workers against the delayed queue)
+                q._drain(force=False)
+            try:
+                reqs = self._c.key_value_dir_get_bytes(f"{_NS}/flushreq/")
+            except Exception:
+                reqs = []
+            if reqs:
+                q.flush()                     # release delayed entries
+                for k, _ in reqs:
+                    try:
+                        self._c.key_value_delete(k)
+                    except Exception:
+                        pass
+            with self._lock:
+                dirty = bool(self._touched)
+            if dirty:
+                self._publish()
+            if not entries:
+                time.sleep(self._poll_s)

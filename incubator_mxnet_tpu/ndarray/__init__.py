@@ -113,7 +113,8 @@ def _sym_call(name, out_index=None, **kw):
 class NDArray:
     """An n-dimensional array on a device (TPU-first)."""
 
-    __slots__ = ("_data", "_node", "_grad", "_grad_req", "__weakref__")
+    __slots__ = ("_data", "_node", "_grad", "_grad_req", "_grad_hook",
+                 "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None, _node=None):
         if isinstance(data, NDArray):
@@ -129,6 +130,10 @@ class NDArray:
         self._node = _node
         self._grad = None
         self._grad_req = None
+        # fires with this NDArray the moment its gradient is FINALIZED
+        # during a backward walk (not at the end) — the readiness signal
+        # overlapped gradient communication schedules on
+        self._grad_hook = None
 
     # -- basic properties -------------------------------------------------
     @property

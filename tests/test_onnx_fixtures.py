@@ -1,0 +1,100 @@
+"""ONNX golden-fixture tests (VERDICT r4 #9): the committed .onnx bytes
+freeze the exporter's wire format, making the "wire-compatible" claim
+falsifiable — a refactor that changes serialization fails byte-equality
+here even though the in-repo importer (same authorship, shared bugs)
+would still round-trip. Where `onnxruntime` exists, the same bytes run
+through the foreign parser and must match our importer numerically.
+
+Parity: python/mxnet/contrib/onnx's test suite runs the real onnx
+checker; this is the closest equivalent in a zero-egress image.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib import onnx as onnx_mxnet
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+from gen_onnx_fixtures import BUILDERS, FIXDIR, export_bytes  # noqa: E402
+
+
+def _fixture(name):
+    with open(os.path.join(FIXDIR, f"{name}.onnx"), "rb") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("name", sorted(BUILDERS))
+def test_wire_format_is_byte_stable(name):
+    """Re-exporting the fixture model reproduces the committed bytes
+    EXACTLY. If this fails after an intentional format change, regenerate
+    with tools/gen_onnx_fixtures.py and review the diff in the PR."""
+    committed = _fixture(name)
+    fresh = export_bytes(name)
+    if fresh != committed:
+        m_old = onnx_mxnet._load_model_proto(committed)
+        m_new = onnx_mxnet._load_model_proto(fresh)
+        ops_old = [n.op_type for n in m_old.graph.node]
+        ops_new = [n.op_type for n in m_new.graph.node]
+        pytest.fail(
+            f"exported wire bytes changed for {name}: "
+            f"{len(committed)} -> {len(fresh)} bytes; node ops "
+            f"{'UNCHANGED' if ops_old == ops_new else 'CHANGED'} "
+            f"({len(ops_old)} -> {len(ops_new)} nodes). If intentional, "
+            "regenerate fixtures via tools/gen_onnx_fixtures.py")
+
+
+@pytest.mark.parametrize("name,n_inputs,opset", [("lenet", 1, 13),
+                                                 ("tiny_transformer", 1, 13)])
+def test_fixture_structure(name, n_inputs, opset):
+    m = onnx_mxnet._load_model_proto(_fixture(name))
+    assert m.opset_import[0].version == opset
+    assert len(m.graph.input) == n_inputs
+    assert m.graph.input[0].name == "data"
+    assert len(m.graph.output) >= 1
+    assert len(m.graph.node) > 3
+    # every node input resolves to a graph input, initializer, or an
+    # earlier node output — the basic well-formedness the onnx checker
+    # enforces
+    known = {i.name for i in m.graph.input} | \
+        {t.name for t in m.graph.initializer}
+    for node in m.graph.node:
+        for i in node.input:
+            assert i == "" or i in known, f"dangling input {i!r} in {name}"
+        known.update(node.output)
+
+
+@pytest.mark.parametrize("name", sorted(BUILDERS))
+def test_fixture_imports_and_runs(name):
+    """The committed bytes (not a fresh export) import and execute."""
+    sym2, args2, aux2 = onnx_mxnet.import_model(_fixture(name))
+    shape = BUILDERS[name]()[2]
+    x = mx.nd.array(np.random.RandomState(0).rand(*shape).astype(np.float32)
+                    if name == "lenet" else
+                    np.random.RandomState(0).randint(0, 17, shape)
+                    .astype(np.float32))
+    out = sym2.bind(mx.cpu(), {**args2, **aux2, "data": x}).forward()[0]
+    assert np.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name", sorted(BUILDERS))
+def test_onnxruntime_parity(name):
+    """Foreign-parser validation: activates wherever onnxruntime exists
+    (zero-egress CI lacks it; the fixture makes the claim portable)."""
+    ort = pytest.importorskip("onnxruntime")
+    blob = _fixture(name)
+    sess = ort.InferenceSession(blob)
+    shape = BUILDERS[name]()[2]
+    x = (np.random.RandomState(0).rand(*shape).astype(np.float32)
+         if name == "lenet" else
+         np.random.RandomState(0).randint(0, 17, shape).astype(np.float32))
+    ort_out = sess.run(None, {"data": x})[0]
+    sym2, args2, aux2 = onnx_mxnet.import_model(blob)
+    ours = sym2.bind(mx.cpu(), {**args2, **aux2,
+                                "data": mx.nd.array(x)}).forward()[0]
+    np.testing.assert_allclose(ort_out, ours.asnumpy(), rtol=2e-5,
+                               atol=2e-5)
