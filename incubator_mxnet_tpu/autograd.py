@@ -347,12 +347,12 @@ def _grad_impl(heads, head_grads, variables, create_graph):
             for lid in finalize_after.get(walk_idx, ()):
                 _finalize(lid)
 
-        # leaves the early pass missed (explicit-variables mode runs
-        # entirely here; hooks still fire so overlap degrades gracefully)
+        # backward mode without hooks lands every leaf here (the early
+        # finalize machinery is skipped then); with hooks, the early pass
+        # popped them all already. Explicit-variables mode never fills
+        # leaf_acc, so grad() does not drive overlapped communication.
         for leaf, g in list(leaf_acc.values()):
             _accumulate_leaf(leaf, g)
-            if leaf._grad_hook is not None:
-                leaf._grad_hook(leaf)
         leaf_acc.clear()
 
     return var_grads

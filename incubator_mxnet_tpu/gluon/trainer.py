@@ -56,6 +56,15 @@ class _GradCommScheduler:
         self._params = params
         self._bucket_bytes = int(bucket_bytes)
         self._credit = int(credit_bytes)
+        # SPMD safety: when aggregation is a cross-process collective
+        # (process_allgather in _batch_aggregate), EVERY process must
+        # issue buckets in the SAME order — credit-based overtaking
+        # depends on local is_ready() timing and would mispair the
+        # collectives. Multi-process clusters therefore issue strictly in
+        # (deterministic) availability order; overlap is kept, only the
+        # reordering is dropped. Single-process keeps full ByteScheduler
+        # semantics.
+        self._deterministic = jax.process_count() > 1
         self._buckets = []           # list[list[int]] consecutive indices
         self._bucket_of = {}         # param idx -> bucket idx
         self._rebucket()
@@ -69,7 +78,9 @@ class _GradCommScheduler:
         self._buckets, self._bucket_of = [], {}
         cur, cur_bytes = [], 0
         for i, p in enumerate(self._params):
-            nbytes = 4 * int(np.prod(p.shape)) if p.shape_is_known else 0
+            itemsize = np.dtype(p.dtype).itemsize if p.dtype else 4
+            nbytes = (itemsize * int(np.prod(p.shape))
+                      if p.shape_is_known else 0)
             cur.append(i)
             cur_bytes += nbytes
             if self._bucket_bytes <= 0 or cur_bytes >= self._bucket_bytes:
@@ -105,7 +116,7 @@ class _GradCommScheduler:
         if all(j in self._ready for j in self._buckets[b]):
             heapq.heappush(self._heap, (self._buckets[b][0], b))
             self._issued.add(b)
-        self._drain(force=False)
+        self._drain(force=self._deterministic)
 
     # -- issue ------------------------------------------------------------
     def _inflight_bytes(self):

@@ -1,0 +1,117 @@
+"""Deep convergence (VERDICT r4 #10): train-to-plateau with logged
+curves, beyond the 'loss decreases over tens of steps' smokes.
+
+- ResNet-18 on synthetic CIFAR-shape data to a high-accuracy PLATEAU
+  (parity: example/image-classification/train_cifar10.py's role).
+- TransformerLM to a low-perplexity plateau on a learnable synthetic
+  language (parity: the LM training scripts' ppl curves).
+
+Both are slow-tier (RUN_SLOW=1): full-size-enough models, hundreds of
+steps on the CPU test backend.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.models import get_model
+
+pytestmark = pytest.mark.slow
+
+
+def _synthetic_cifar(classes=8, n_per_class=24, seed=0):
+    """Separable 32x32x3 classes: fixed template + noise, NHWC."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(classes, 32, 32, 3).astype(np.float32)
+    xs, ys = [], []
+    for c in range(classes):
+        noise = rng.randn(n_per_class, 32, 32, 3).astype(np.float32) * 0.25
+        xs.append(templates[c][None] + noise)
+        ys.append(np.full(n_per_class, c, np.int32))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+def test_resnet18_synthetic_cifar_plateau():
+    mx.random.seed(0)
+    x_np, y_np = _synthetic_cifar()
+    net = get_model("resnet18_v1", classes=8, layout="NHWC")
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9,
+                        "wd": 1e-4})
+    B = 48
+    x_all, y_all = nd.array(x_np), nd.array(y_np)
+    rng = np.random.RandomState(1)
+    accs, losses = [], []
+    for step in range(120):
+        sel = rng.randint(0, len(y_np), B)
+        xb, yb = nd.array(x_np[sel]), nd.array(y_np[sel])
+        with autograd.record():
+            loss = L(net(xb), yb)
+        loss.backward()
+        tr.step(B)
+        losses.append(float(loss.asnumpy().mean()))
+        if (step + 1) % 20 == 0:
+            pred = net(x_all).asnumpy().argmax(axis=1)
+            accs.append(float((pred == y_np).mean()))
+            print(f"resnet18 step {step + 1}: loss {losses[-1]:.4f} "
+                  f"acc {accs[-1]:.3f}", flush=True)
+    # high-accuracy plateau: ends high AND has stopped improving fast
+    assert accs[-1] > 0.95, f"final acc {accs[-1]:.3f} <= 0.95 ({accs})"
+    assert accs[-2] > 0.90, f"not a plateau: {accs}"
+    assert np.mean(losses[-10:]) < 0.2, losses[-10:]
+
+
+def _synthetic_language(vocab=24, n_seq=96, T=24, seed=0):
+    """Deterministic-ish markov language: token t+1 = (a*t + b) % vocab
+    per-sequence with 3 rules — learnable to low perplexity, not trivial."""
+    rng = np.random.RandomState(seed)
+    rules = [(1, 1), (2, 3), (3, 5)]
+    data = np.zeros((n_seq, T), np.int64)
+    for i in range(n_seq):
+        a, b = rules[i % len(rules)]
+        t = rng.randint(0, vocab)
+        # first token encodes the rule so the model can infer it
+        data[i, 0] = i % len(rules)
+        data[i, 1] = t
+        for j in range(2, T):
+            t = (a * t + b) % vocab
+            data[i, j] = t
+    return data
+
+
+def test_transformer_lm_perplexity_plateau():
+    from incubator_mxnet_tpu.models import TransformerLM
+    from incubator_mxnet_tpu.models.transformer_lm import lm_loss
+    mx.random.seed(0)
+    vocab, T = 24, 24
+    data = _synthetic_language(vocab=vocab, T=T)
+    net = TransformerLM(vocab_size=vocab, num_layers=2, units=64,
+                        hidden_size=128, num_heads=4, max_length=T)
+    net.initialize(init=mx.init.Normal(0.02))
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adamw",
+                       {"learning_rate": 3e-3})
+    B = 32
+    rng = np.random.RandomState(1)
+    ppls = []
+    for epoch in range(14):
+        ep_losses = []
+        for _ in range(len(data) // B):
+            xb = nd.array(data[rng.randint(0, len(data), B)])
+            with autograd.record():
+                loss = lm_loss(net(xb), xb).mean()
+            loss.backward()
+            tr.step(B)
+            ep_losses.append(float(loss.asnumpy()))
+        ppls.append(float(np.exp(np.mean(ep_losses))))
+        print(f"lm epoch {epoch}: ppl {ppls[-1]:.2f}", flush=True)
+    # perplexity curve: big early drop, low plateau at the end
+    assert ppls[0] > 2 * ppls[-1], ppls
+    assert ppls[-1] < 2.0, f"final ppl {ppls[-1]:.2f} (curve: {ppls})"
+    assert abs(ppls[-1] - ppls[-3]) < 0.35 * ppls[-1], \
+        f"not plateaued: {ppls[-3:]}"

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 guard reactor. The relay wedged at ~11:50 UTC during a fresh
+# k=5 scan compile (docs/AUTOSWEEP_r05.log); the cache already holds the
+# driver-default programs (22.6 MB step + k8 scan). If the tunnel heals,
+# the highest-value move is to CONFIRM the driver-default bench runs
+# from cache — one cheap run — and then leave the tunnel alone for the
+# driver's protected end-of-round bench. Unlike auto_sweep it launches
+# NO fresh large compiles (the k5 compile is what wedged the relay).
+LOG=${1:-/root/repo/docs/AUTOSWEEP_r05.log}
+cd /root/repo || exit 1
+echo "$(date -u +%F' '%T) auto_guard armed (pid $$)" >> "$LOG"
+while true; do
+  ts=$(date -u +%H:%M)
+  timeout 300 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+print(float((x @ x).sum()))
+" >/dev/null 2>&1
+  rc=$?
+  echo "$ts guard probe rc=$rc" >> "$LOG"
+  if [ "$rc" = "0" ]; then
+    echo "$ts TUNNEL HEALED -> one cached driver-default bench, then quiet" >> "$LOG"
+    timeout 1800 python bench.py >> "$LOG" 2>&1
+    echo "$(date -u +%F' '%T) guard bench rc=$?; auto_guard exiting (tunnel left alone)" >> "$LOG"
+    exit 0
+  fi
+  sleep 600
+done
