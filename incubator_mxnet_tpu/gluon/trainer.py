@@ -187,6 +187,25 @@ class Trainer:
             self._kvstore = (kvstore if isinstance(kvstore, kvs_mod.KVStore)
                              else kvs_mod.create(kvstore))
         self._scale = 1.0
+        # update_on_kvstore (parity: reference trainer's
+        # _update_on_kvstore): the optimizer runs SERVER-side — step()
+        # pushes gradients and pulls back updated weights; update() is
+        # then unsupported. Auto (None) resolves True only for
+        # `dist_async`, whose per-worker-update semantics only exist
+        # server-side; everywhere else the local fused update is the
+        # faster TPU-native path.
+        if update_on_kvstore is None:
+            update_on_kvstore = (self._kvstore is not None
+                                 and self._kvstore.type == "dist_async")
+        if update_on_kvstore and self._kvstore is None:
+            raise ValueError("update_on_kvstore=True requires a kvstore")
+        if update_on_kvstore and overlap_comm:
+            raise ValueError(
+                "overlap_comm schedules client-side aggregation; it is "
+                "incompatible with server-side updates "
+                "(update_on_kvstore)")
+        self._update_on_kvstore = bool(update_on_kvstore)
+        self._kv_params_init = False
         self._sched = None
         if overlap_comm:
             if self._kvstore is None:
@@ -245,10 +264,31 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kvstore:
+            self._kvstore_step()
+            return
         self.allreduce_grads()
         self._update()
 
+    def _kvstore_step(self):
+        """Server-side update round: push grads, pull updated weights
+        (reference kvstore_dist flow). For dist_async the push applies as
+        this worker's own arrival-order update on the rank-0 server; for
+        sync stores it is aggregate-then-update."""
+        kv = self._kvstore
+        keys = [f"param{i}" for i in range(len(self._params))]
+        if not self._kv_params_init:
+            kv.set_optimizer(self._optimizer)
+            kv.init(keys, [p.data() for p in self._params])
+            self._kv_params_init = True
+        kv.push(keys, [p.grad() for p in self._params])
+        kv.pull(keys, out=[p.data() for p in self._params])
+
     def update(self, batch_size, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            raise ValueError(
+                "update() is not supported when parameters are updated "
+                "on the kvstore (update_on_kvstore=True); call step()")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update()
 

@@ -1,0 +1,75 @@
+"""Worker for the REAL cross-process overlapped-Trainer test.
+
+Each process of a 2-process cluster trains the same net on its OWN half
+of the global batch via `Trainer(overlap_comm=True, kvstore='dist_sync')`
+— gradient buckets are issued mid-backward and aggregated by the REAL
+cross-process collective (`process_allgather` inside
+KVStore._batch_aggregate), in deterministic order on every process (the
+SPMD requirement). Final weights must be identical across ranks AND
+match the given single-process ground truth recomputed by the test.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import autograd, gluon, nd  # noqa: E402
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    steps = int(sys.argv[4])
+
+    mx.distributed.init(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=nproc, process_id=pid)
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=6, activation="relu"),
+            gluon.nn.Dense(3, in_units=8))
+    net.initialize(init=mx.init.Xavier())
+
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="dist_sync",
+                       overlap_comm=True)
+    assert tr._kvstore.num_workers == nproc
+    assert tr._sched._deterministic, "multi-process must issue in order"
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(8, 6).astype(np.float32)
+    Y = rng.randn(8, 3).astype(np.float32)
+    per = 8 // nproc
+    Xl = nd.array(X[pid * per:(pid + 1) * per])
+    Yl = nd.array(Y[pid * per:(pid + 1) * per])
+    L = gluon.loss.L2Loss()
+
+    for _ in range(steps):
+        with autograd.record():
+            loss = L(net(Xl), Yl).sum()   # local-shard SUM: psum = global
+        loss.backward()
+        assert tr._sched.issued_log, "buckets must issue mid-backward"
+        tr.step(len(X))                   # rescale by the GLOBAL batch
+        tr._sched.issued_log.clear()
+
+    for name, p in sorted(net.collect_params().items()):
+        flat = " ".join(f"{v:.6f}" for v in p.data().asnumpy().ravel())
+        print(f"PARAM {name} {flat}", flush=True)
+    mx.distributed.barrier()
+    mx.distributed.shutdown()
+    print("SHUTDOWN_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

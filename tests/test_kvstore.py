@@ -296,3 +296,54 @@ def test_async_sgd_converges_despite_staleness():
     final_loss = float(np.mean((X @ w_pull.asnumpy() - y) ** 2))
     assert final_loss < 1e-3, final_loss
     assert kv._async_queue.delayed_total > 0  # staleness actually happened
+
+
+def test_trainer_update_on_kvstore_dist_async():
+    """update_on_kvstore (auto-resolved for dist_async): the optimizer
+    runs SERVER-side — step() pushes grads and pulls updated weights;
+    with one worker this matches local-update training exactly, and
+    update() is refused (reference trainer semantics)."""
+    from incubator_mxnet_tpu import gluon
+
+    def build():
+        mx.random.seed(5)
+        np.random.seed(5)
+        net = gluon.nn.Dense(3, in_units=4)
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    def run(net, kvstore):
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore=kvstore)
+        x = _nd(np.random.RandomState(0).randn(6, 4))
+        for _ in range(4):
+            with mx.autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(6)
+        return tr
+
+    net_a, net_b = build(), build()
+    tr_a = run(net_a, "dist_async")
+    run(net_b, None)
+    assert tr_a._update_on_kvstore        # auto-resolved True
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6)
+    with pytest.raises(ValueError, match="update_on_kvstore"):
+        tr_a.update(6)
+
+
+def test_trainer_update_on_kvstore_conflicts():
+    from incubator_mxnet_tpu import gluon
+    net = gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    with pytest.raises(ValueError, match="kvstore"):
+        gluon.Trainer(net.collect_params(), "sgd", {}, kvstore=None,
+                      update_on_kvstore=True)
+    with pytest.raises(ValueError, match="incompatible"):
+        gluon.Trainer(net.collect_params(), "sgd", {},
+                      kvstore="dist_async", update_on_kvstore=True,
+                      overlap_comm=True)

@@ -134,6 +134,67 @@ def test_two_process_dist_async_push_crosses_process_boundary():
     assert all(_parse([o], "SHUTDOWN_OK") for o in outs)
 
 
+def test_two_process_overlap_trainer_matches_single_process():
+    """REAL cross-process overlapped gradient communication: buckets
+    issue mid-backward on both ranks in deterministic order and aggregate
+    through the actual process_allgather collective; finals must be
+    rank-identical AND equal single-process full-batch training."""
+    steps = 10
+    worker = os.path.join(_HERE, "mh_overlap_worker.py")
+    port = str(_free_port())
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", port, str(steps)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+
+    # per-param lines, identical across ranks
+    params = [dict((ln.split()[1], np.array([float(v) for v in
+                                             ln.split()[2:]]))
+                   for ln in out.splitlines() if ln.startswith("PARAM "))
+              for _, out, _ in outs]
+    assert params[0].keys() == params[1].keys() and params[0]
+    for k in params[0]:
+        np.testing.assert_allclose(params[0][k], params[1][k], rtol=1e-6)
+
+    # single-process ground truth: same net, full batch, plain Trainer
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    mx.random.seed(7)
+    np.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=6, activation="relu"),
+            gluon.nn.Dense(3, in_units=8))
+    net.initialize(init=mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    rng = np.random.RandomState(3)
+    X = nd.array(rng.randn(8, 6).astype(np.float32))
+    Y = nd.array(rng.randn(8, 3).astype(np.float32))
+    L = gluon.loss.L2Loss()
+    for _ in range(steps):
+        with autograd.record():
+            loss = L(net(X), Y).sum()
+        loss.backward()
+        tr.step(X.shape[0])
+    for name, p in sorted(net.collect_params().items()):
+        np.testing.assert_allclose(params[0][name],
+                                   p.data().asnumpy().ravel(),
+                                   rtol=1e-4, atol=1e-6)
+
+
 @pytest.mark.slow
 def test_four_process_cluster():
     outs = _run_cluster(4, 10)
