@@ -101,6 +101,70 @@ def _log(msg):
           flush=True)
 
 
+def _bench_profile_start():
+    """Arm the profiler for phase scopes around the bench run. Imperative
+    op timing stays OFF (it syncs per op and would distort the measured
+    rate); only layer/phase scopes are recorded. Returns the trace path,
+    or None when BENCH_TRACE=0."""
+    if os.environ.get("BENCH_TRACE", "1") != "1":
+        return None
+    from incubator_mxnet_tpu import profiler as prof
+    path = os.environ.get("BENCH_TRACE_FILE", "/tmp/mxtpu_bench_trace.json")
+    prof.reset()
+    prof.set_config(filename=path, profile_imperative=False)
+    prof.start()
+    return path
+
+
+def _profiled_compile_warmup(run_compile, run_warmup):
+    """Shared compile+warmup phase instrumentation for both bench paths:
+    arms the profiler, runs the compile under a bench.compile scope and
+    the usual phase deadline, times both phases. Returns
+    (trace_path, compile_s, warmup_s)."""
+    from incubator_mxnet_tpu import profiler as prof
+    trace_path = _bench_profile_start()
+    t_c = time.time()
+    with prof.record_function("bench.compile", "bench", sync=False), \
+            _phase_deadline(int(os.environ.get("BENCH_COMPILE_TIMEOUT",
+                                               "2400")),
+                            "train step compile"):
+        run_compile()
+    compile_s = time.time() - t_c
+    _log(f"compile done in {compile_s:.1f}s; warmup")
+    t_w = time.time()
+    run_warmup()
+    warmup_s = time.time() - t_w
+    return trace_path, compile_s, warmup_s
+
+
+def _finish_profile(result, trace_path, **phase_s):
+    """Publish per-phase wall times as profiler gauges, attach them to the
+    result JSON (-> BENCH_*.json), then dump the Chrome trace and schema-
+    check it with tools/trace_check — a malformed trace fails the bench
+    run loudly instead of shipping garbage."""
+    from incubator_mxnet_tpu import profiler as prof
+    phases = {k: round(float(v), 4) for k, v in phase_s.items()}
+    for k, v in phases.items():
+        prof.set_gauge("bench/" + k, v)
+    result.setdefault("extra", {})["phases"] = phases
+    if trace_path is None:
+        return
+    prof.stop()
+    prof.dump(filename=trace_path)
+    import importlib.util
+    tc_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "trace_check.py")
+    spec = importlib.util.spec_from_file_location("trace_check", tc_path)
+    tc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tc)
+    errors = tc.check_trace(trace_path)
+    if errors:
+        raise RuntimeError("bench trace failed schema check: "
+                           + "; ".join(errors[:5]))
+    result["extra"]["trace_file"] = trace_path
+    _log(f"trace OK: {trace_path} ({len(phases)} phases)")
+
+
 def acquire_backend(attempts=6, first_delay=3.0,
                     per_attempt_timeout=180):
     """Backend init through the axon relay is occasionally UNAVAILABLE or
@@ -407,22 +471,22 @@ def _record_data_bench(mode, batch, steps, dtype):
 
     _log("compiling fused train step (record path)")
     xb, yb = next_batch()
-    with _phase_deadline(int(os.environ.get("BENCH_COMPILE_TIMEOUT",
-                                            "2400")),
-                         "train step compile"):
-        float(step(xb, yb))
-    float(step(*next_batch()))                    # warmup
+    from incubator_mxnet_tpu import profiler as prof
+    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+        lambda: float(step(xb, yb)),
+        lambda: float(step(*next_batch())))
 
     _log(f"timing {steps} end-to-end steps @ batch {batch} ({mode})")
     t0 = time.time()
-    for _ in range(steps):
-        loss = step(*next_batch())
-    loss_val = float(loss)                        # host fetch = barrier
+    with prof.record_function("bench.steady", "bench", sync=False):
+        for _ in range(steps):
+            loss = step(*next_batch())
+        loss_val = float(loss)                    # host fetch = barrier
     dt = time.time() - t0
     e2e = batch * steps / dt
     bottleneck = ("input-bound (decode/host)" if data_rate < 1.2 * e2e
                   else "chip-bound")
-    return {
+    result = {
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
         "value": round(e2e, 2),
         "unit": "images/sec",
@@ -435,6 +499,10 @@ def _record_data_bench(mode, batch, steps, dtype):
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
     }
+    _finish_profile(result, trace_path, compile_s=compile_s,
+                    warmup_s=warmup_s, steady_s=dt,
+                    step_ms=dt / steps * 1e3)
+    return result
 
 
 def main():
@@ -518,11 +586,10 @@ def main():
     # not synchronize; a host value fetch is the only true barrier. Steps
     # chain through updated params, so fetching the final loss times them all.
     _log("compiling fused train step (first call)")
-    with _phase_deadline(int(os.environ.get("BENCH_COMPILE_TIMEOUT", "2400")),
-                         "train step compile"):
-        float(step(x, y))
-    _log("compile done; warmup")
-    float(step(x, y))
+    from incubator_mxnet_tpu import profiler as prof
+    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+        lambda: float(step(x, y)),
+        lambda: float(step(x, y)))
 
     # BENCH_K > 1: dispatch k micro-steps as ONE XLA program (lax.scan in
     # FusedTrainStep.run_k) — amortizes per-step relay/host dispatch
@@ -544,17 +611,19 @@ def main():
         _log(f"timing {chunks} chunks x {k} micro-steps @ batch {batch} "
              f"{dtype}")
         t0 = time.time()
-        for _ in range(chunks):
-            losses = step.run_k(xs, ys)
-        loss_val = float(losses[k - 1])             # host fetch = barrier
+        with prof.record_function("bench.steady", "bench", sync=False):
+            for _ in range(chunks):
+                losses = step.run_k(xs, ys)
+            loss_val = float(losses[k - 1])         # host fetch = barrier
         dt = time.time() - t0
         steps = chunks * k
     else:
         _log(f"timing {steps} steps @ batch {batch} {dtype}")
         t0 = time.time()
-        for _ in range(steps):
-            loss = step(x, y)
-        loss_val = float(loss)
+        with prof.record_function("bench.steady", "bench", sync=False):
+            for _ in range(steps):
+                loss = step(x, y)
+            loss_val = float(loss)
         dt = time.time() - t0
 
     img_s = batch * steps / dt
@@ -581,6 +650,9 @@ def main():
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
     }
+    _finish_profile(result, trace_path, compile_s=compile_s,
+                    warmup_s=warmup_s, steady_s=dt,
+                    step_ms=dt / steps * 1e3)
     # Self-check of the dispatch-latency hypothesis behind the K default:
     # time the ALREADY-COMPILED per-step path alongside, so every K>1
     # report carries its own k=1 control (the blind bet must measure

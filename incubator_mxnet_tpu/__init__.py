@@ -14,6 +14,7 @@ import sys as _sys
 
 from . import base, context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import profiler
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
@@ -53,7 +54,6 @@ from . import runtime
 from . import engine
 from . import test_utils
 from . import utils
-from .utils import profiler
 
 from .ndarray import NDArray
 from .ndarray import random as _ndrandom

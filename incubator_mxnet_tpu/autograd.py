@@ -20,6 +20,8 @@ from typing import List, Optional, Sequence
 import jax
 import numpy as np
 
+from . import profiler as _prof
+
 __all__ = [
     "record", "pause", "train_mode", "predict_mode", "is_recording",
     "is_training", "mark_variables", "backward", "grad", "Function",
@@ -179,6 +181,10 @@ def _is_float(dt) -> bool:
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Compute gradients of `heads` w.r.t. all leaves with grads attached,
     accumulating into each leaf's `.grad` per its grad_req."""
+    if _prof._ACTIVE:
+        with _prof.Scope("autograd.backward", "autograd", sync=False):
+            return _grad_impl(heads, head_grads, variables=None,
+                              create_graph=False)
     grads = _grad_impl(heads, head_grads, variables=None, create_graph=False)
     return grads
 
@@ -191,7 +197,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     from . import ndarray as _nd
     single = not isinstance(variables, (list, tuple))
     varlist = [variables] if single else list(variables)
-    out = _grad_impl(heads, head_grads, variables=varlist, create_graph=create_graph)
+    if _prof._ACTIVE:
+        with _prof.Scope("autograd.grad", "autograd", sync=False):
+            out = _grad_impl(heads, head_grads, variables=varlist,
+                             create_graph=create_graph)
+    else:
+        out = _grad_impl(heads, head_grads, variables=varlist, create_graph=create_graph)
     missing = [i for i, g in enumerate(out) if g is None]
     if missing:
         out = [g if g is not None else _nd.zeros_like(varlist[i])

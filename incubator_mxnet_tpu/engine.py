@@ -12,6 +12,7 @@ IO-grade tasks, plus the engine-selection switch:
 """
 from __future__ import annotations
 
+from . import profiler as _prof
 from . import runtime as _rt
 from . import ndarray as _nd
 from .runtime import engine_type, get_engine
@@ -26,15 +27,28 @@ def new_var() -> int:
 
 def push(fn, const_vars=(), mutable_vars=()):
     """Schedule fn once deps resolve: concurrent reads, exclusive writes."""
+    if _prof._ACTIVE:
+        with _prof.Scope("engine.push", "engine", sync=False):
+            get_engine().push(fn, const_vars, mutable_vars)
+        return
     get_engine().push(fn, const_vars, mutable_vars)
 
 
 def wait_for_var(var: int):
+    if _prof._ACTIVE:
+        with _prof.Scope("engine.wait_for_var", "engine", sync=False):
+            get_engine().wait_for_var(var)
+        return
     get_engine().wait_for_var(var)
 
 
 def wait_all():
     """Barrier on host-engine tasks AND device async work (mx.nd.waitall)."""
+    if _prof._ACTIVE:
+        with _prof.Scope("engine.wait_all", "engine", sync=False):
+            get_engine().wait_all()
+            _nd.waitall()
+        return
     get_engine().wait_all()
     _nd.waitall()
 
@@ -44,13 +58,23 @@ class bulk:
     engine ops into one bulk segment to cut scheduling overhead. Here XLA
     already batches device work per dispatch (and FusedTrainStep.run_k is
     the explicit bulk form), so the context manager is semantically a
-    no-op that preserves reference code shape."""
+    no-op that preserves reference code shape. When profiling is running
+    it records a `bulk(size)` trace scope, so reference-shaped code shows
+    up in traces; off, it stays a single-predicate no-op."""
 
     def __init__(self, size=15):
         self.size = int(size)
+        self._scope = None
 
     def __enter__(self):
+        if _prof._ACTIVE:
+            self._scope = _prof.Scope("bulk(%d)" % self.size, "engine",
+                                      sync=False)
+            self._scope.__enter__()
         return self
 
     def __exit__(self, *exc):
+        if self._scope is not None:
+            self._scope.__exit__(*exc)
+            self._scope = None
         return False

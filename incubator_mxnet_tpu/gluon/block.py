@@ -17,6 +17,7 @@ from collections import OrderedDict
 import jax
 
 from .. import autograd
+from .. import profiler as _prof
 from ..base import NameManager, camel_to_snake
 from ..ndarray import NDArray, _apply
 from ..ndarray import random as ndrandom
@@ -309,8 +310,20 @@ class HybridBlock(Block):
         sig = (tuple((tuple(a.shape), str(a._data.dtype)) for a in args), training)
         entry = self._cache.get(sig)
         if entry is None:
-            entry = self._build_cache(params, args, training)
+            if _prof._ACTIVE:
+                # jit compile-cache miss: the recorded span covers the
+                # trace/lower work in _build_cache; the device compile
+                # itself happens lazily inside the first dispatch, which
+                # the op hook times as the first `<name>_cachedop` event
+                _prof.counter("jit.cache_miss", "gluon").increment()
+                with _prof.Scope("jit.compile:" + self.name, "jit",
+                                 sync=False):
+                    entry = self._build_cache(params, args, training)
+            else:
+                entry = self._build_cache(params, args, training)
             self._cache[sig] = entry
+        elif _prof._ACTIVE:
+            _prof.counter("jit.cache_hit", "gluon").increment()
 
         key_raw = ndrandom._key()
         n_total = entry.n_real + entry.n_aux

@@ -17,6 +17,7 @@ Used by :func:`trace_symbol` (public), ``HybridBlock.export`` (writes
 ONNX exporter (``contrib/onnx``) for Gluon models.
 """
 from .parameter import DeferredInitializationError
+from .. import profiler as _prof
 
 __all__ = ["SymbolizeScope", "trace_symbol", "active_scope", "sym_call",
            "to_input"]
@@ -124,8 +125,13 @@ def trace_symbol(net, *input_names):
         id2name[id(nd_val)] = name
         values[name] = nd_val
 
-    with SymbolizeScope(id2name, values):
-        out = net(*[Variable(n) for n in input_names])
+    if _prof._ACTIVE:
+        with _prof.Scope("symbolize.trace:" + net.name, "symbolic",
+                         sync=False), SymbolizeScope(id2name, values):
+            out = net(*[Variable(n) for n in input_names])
+    else:
+        with SymbolizeScope(id2name, values):
+            out = net(*[Variable(n) for n in input_names])
 
     if isinstance(out, Symbol):
         sym = out

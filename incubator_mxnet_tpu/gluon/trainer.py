@@ -14,6 +14,7 @@ import numpy as np
 
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
+from .. import profiler as _prof
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -128,7 +129,12 @@ class _GradCommScheduler:
         members = self._buckets[b]
         grads = [self._params[i].grad() for i in members]
         keys = [f"grad{i}" for i in members]
-        self._kv.pushpull(keys, grads, out=grads)
+        if _prof._ACTIVE:
+            with _prof.Scope("overlap_comm.issue_bucket%d" % b, "trainer",
+                             sync=False):
+                self._kv.pushpull(keys, grads, out=grads)
+        else:
+            self._kv.pushpull(keys, grads, out=grads)
         self.issued_log.append(b)
         nbytes = sum(int(np.prod(g.shape)) * g._data.dtype.itemsize
                      for g in grads)
@@ -265,7 +271,23 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
         if self._update_on_kvstore:
-            self._kvstore_step()
+            if _prof._ACTIVE:
+                with _prof.Scope("trainer.kvstore_step", "trainer",
+                                 sync=False):
+                    self._kvstore_step()
+            else:
+                self._kvstore_step()
+            return
+        if _prof._ACTIVE:
+            # step phases as separate trace buckets: grad aggregation
+            # (incl. overlap-comm stragglers) vs the optimizer update
+            _prof.counter("trainer.steps").increment()
+            with _prof.Scope("trainer.allreduce_grads", "trainer",
+                             sync=False):
+                self.allreduce_grads()
+            with _prof.Scope("trainer.optimizer_update", "trainer",
+                             sync=False):
+                self._update()
             return
         self.allreduce_grads()
         self._update()

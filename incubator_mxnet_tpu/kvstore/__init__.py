@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
 from .. import optimizer as _opt
+from .. import profiler as _prof
 
 __all__ = ["KVStore", "create"]
 
@@ -336,6 +337,12 @@ class KVStore:
         return self._batch_aggregate([key], [values])[0]
 
     def push(self, key, value, priority=0):
+        if _prof._ACTIVE:
+            with _prof.Scope("kvstore.push", "kvstore", sync=False):
+                return self._push_impl(key, value, priority)
+        return self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority=0):
         if self._is_async:
             ps = self._ps()
             keys = key if isinstance(key, (list, tuple)) else [key]
@@ -399,9 +406,15 @@ class KVStore:
                 self._store[key] = agg.copy()
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if _prof._ACTIVE:
+            with _prof.Scope("kvstore.pull", "kvstore", sync=False):
+                return self._pull_impl(key, out, priority, ignore_sparse)
+        return self._pull_impl(key, out, priority, ignore_sparse)
+
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
-                self.pull(k, o, priority)
+                self._pull_impl(k, o, priority)
             return
         ps = self._ps()
         if ps is not None and ps.rank != 0:
@@ -423,10 +436,16 @@ class KVStore:
         push applies per-worker server updates and the pull returns the
         CURRENT server weights (which may not yet include delayed
         workers' pushes — the async contract)."""
+        if _prof._ACTIVE:
+            with _prof.Scope("kvstore.pushpull", "kvstore", sync=False):
+                return self._pushpull_impl(key, value, out, priority)
+        return self._pushpull_impl(key, value, out, priority)
+
+    def _pushpull_impl(self, key, value, out=None, priority=0):
         if self._is_async and self._optimizer is not None:
-            self.push(key, value)
+            self._push_impl(key, value)
             if out is not None:
-                self.pull(key, out=out)
+                self._pull_impl(key, out=out)
                 return None
             ps = self._ps()
             if ps is not None and ps.rank != 0:

@@ -11,6 +11,8 @@ import re
 
 import numpy as np
 
+from . import profiler as _prof
+
 __all__ = ["Monitor"]
 
 
@@ -57,7 +59,14 @@ class Monitor:
 
     def _collect(self, ex):
         rows = []
-        outs = {f"output{i}": o for i, o in enumerate(ex.outputs)}
+        # an executor may have no outputs (e.g. bound for backward only, or
+        # a partial bind mid-rebuild) — treat that as an empty output dict
+        # instead of indexing blindly
+        try:
+            outputs = ex.outputs or []
+        except Exception:
+            outputs = []
+        outs = {f"output{i}": o for i, o in enumerate(outputs)}
         for source in (ex.arg_dict, ex.aux_dict, ex.grad_dict, outs):
             for name, arr in source.items():
                 tag = name if source is not ex.grad_dict else name + "_grad"
@@ -68,7 +77,10 @@ class Monitor:
         return rows
 
     def toc(self):
-        """End-of-batch: collect stats from every installed executor."""
+        """End-of-batch: collect stats from every installed executor. Each
+        scalar stat is also published as a `monitor/<tag>` gauge in the
+        profiler counters registry — the single stats path shared with
+        bench/profiler consumers."""
         if not self.activated:
             return []
         res = []
@@ -76,6 +88,12 @@ class Monitor:
             res.extend(self._collect(ex))
         if self.sort:
             res.sort(key=lambda r: r[1])
+        for _step, tag, value in res:
+            v = np.asarray(value)
+            # only scalar numeric stats become gauges; custom stat funcs may
+            # return strings/arrays, which stay rows-only
+            if v.size == 1 and np.issubdtype(v.dtype, np.number):
+                _prof.set_gauge(tag, float(v.reshape(())), domain="monitor")
         self.queue = res
         return res
 

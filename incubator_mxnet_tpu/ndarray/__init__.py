@@ -32,7 +32,8 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concat", "concatenate", "stack", "split", "dot", "batch_dot",
            "save", "load", "waitall"]
 
-# utils/profiler installs a timing wrapper here while profiling is active
+# the profiler subsystem installs a timing wrapper here while imperative
+# profiling is active (profiler.set_state("run")); None = zero-overhead path
 _op_hook = None
 
 

@@ -1,0 +1,83 @@
+"""Counters/gauges registry (parity: mx.profiler.Counter).
+
+A :class:`Counter` is a named monotonically-adjustable value grouped under
+a domain. The registry is always live (reads/writes are plain attribute
+ops independent of whether tracing is running) so subsystems can share one
+stats path — `Monitor` publishes per-tensor stats here, `bench.py`
+publishes per-phase step-time breakdowns, the jit cache publishes
+hit/miss counts. `dump()` folds the registry into the Chrome trace as
+counter ('C') events so values show up in chrome://tracing."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Counter", "counter", "counters", "set_gauge", "reset_counters"]
+
+_registry: "dict[str, Counter]" = {}
+_lock = threading.Lock()
+
+
+class Counter:
+    """A named value in the registry. `increment`/`decrement` for counts,
+    `set_value` for gauges (latest-value semantics)."""
+
+    __slots__ = ("name", "domain", "value")
+
+    def __init__(self, name: str, domain: str = "mxtpu", value=0):
+        self.name = name
+        self.domain = domain
+        self.value = value
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.domain}/{self.name}"
+
+    def increment(self, delta=1):
+        self.value += delta
+        return self.value
+
+    def decrement(self, delta=1):
+        self.value -= delta
+        return self.value
+
+    def set_value(self, value):
+        self.value = value
+
+    def __repr__(self):
+        return f"Counter({self.full_name}={self.value})"
+
+
+def counter(name: str, domain: str = "mxtpu") -> Counter:
+    """Get-or-create the counter `domain/name`."""
+    key = f"{domain}/{name}"
+    c = _registry.get(key)
+    if c is None:
+        with _lock:
+            c = _registry.setdefault(key, Counter(name, domain))
+    return c
+
+
+def set_gauge(name: str, value, domain: str = "mxtpu") -> None:
+    """One-shot gauge write: get-or-create and set latest value."""
+    counter(name, domain).set_value(value)
+
+
+def counters() -> dict:
+    """Snapshot of the registry: {domain/name: value}."""
+    with _lock:
+        return {k: c.value for k, c in _registry.items()}
+
+
+def reset_counters():
+    with _lock:
+        _registry.clear()
+
+
+def _counter_events() -> list:
+    """Chrome 'C' events for every registered counter (called by dump)."""
+    from . import _now_us
+    ts = _now_us()
+    with _lock:
+        return [{"name": c.full_name, "cat": c.domain, "ph": "C", "pid": 0,
+                 "ts": ts, "args": {"value": c.value}}
+                for c in _registry.values()]

@@ -1,0 +1,63 @@
+"""TPU/XLA bridge for the profiler.
+
+Two jobs:
+
+* :func:`annotation` — when the default backend is a TPU, wrap host-side
+  :class:`~incubator_mxnet_tpu.profiler.Scope` regions in
+  ``jax.profiler.TraceAnnotation`` so they line up with the XLA device
+  trace (TensorBoard/Perfetto shows the host scope spanning the device
+  ops it dispatched). On CPU/GPU backends this returns None — the host
+  Chrome trace is the single source and the annotation would be dead
+  weight in the hot path.
+* :func:`start_device_trace` / :func:`stop_device_trace` — drive
+  ``jax.profiler`` for a full XLA capture when
+  ``set_config(profile_xla=True)``.
+
+Backend detection is done once and cached; everything degrades to a no-op
+if jax's profiler is unavailable (e.g. stripped builds)."""
+from __future__ import annotations
+
+_is_tpu = None          # tri-state: None = not yet probed
+_tracing = False
+
+
+def on_tpu() -> bool:
+    global _is_tpu
+    if _is_tpu is None:
+        try:
+            import jax
+            _is_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            _is_tpu = False
+    return _is_tpu
+
+
+def annotation(name: str):
+    """A TraceAnnotation context manager for `name` on TPU, else None."""
+    if not on_tpu():
+        return None
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+def start_device_trace(logdir: str):
+    global _tracing
+    try:
+        import jax
+        jax.profiler.start_trace(logdir)
+        _tracing = True
+    except Exception:
+        pass                      # already tracing / profiler unavailable
+
+
+def stop_device_trace():
+    global _tracing
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass                      # never started / profiler unavailable
+    _tracing = False

@@ -1,140 +1,8 @@
-"""Profiler (parity: python/mxnet/profiler.py — set_config / set_state /
-scope / dump / dumps, op-level timing, memory stats).
+"""Back-compat shim: the profiler grew into the
+:mod:`incubator_mxnet_tpu.profiler` subsystem. `utils.profiler` stays
+importable and IS that module (one code path, one state)."""
+import sys as _sys
 
-Two layers:
-- Op/scope timing: a hook on the ndarray `_apply` funnel times each eager op
-  (synchronizing on the outputs, so times are device-compute times, not
-  dispatch times) and `scope(name)` times user regions. `dumps()` prints the
-  reference-style aggregate table; `dump()` writes a Chrome trace JSON.
-- Device view: `device_memory_stats()` surfaces the XLA allocator counters
-  (the reference's GPU memory profile equivalent), and `set_config(
-  profile_xla=True)` additionally drives `jax.profiler` for a full XLA/TPU
-  trace viewable in TensorBoard/Perfetto.
-"""
-from __future__ import annotations
+from .. import profiler as _profiler
 
-import json
-import time
-from contextlib import contextmanager
-
-import jax
-
-from .. import ndarray as _nd
-
-__all__ = ["set_config", "set_state", "pause", "resume", "scope", "dump",
-           "dumps", "reset", "device_memory_stats"]
-
-_config = {"filename": "profile.json", "aggregate_stats": True,
-           "profile_xla": False, "xla_logdir": "/tmp/mxtpu_xla_trace"}
-_state = {"running": False, "paused": False}
-_records: list[dict] = []
-_t0 = time.perf_counter()
-
-
-def set_config(**kwargs):
-    """set_config(filename=..., aggregate_stats=..., profile_xla=...).
-    Unknown reference kwargs (profile_symbolic etc.) are accepted and
-    ignored — everything here runs through the same eager/jit funnel."""
-    for k, v in kwargs.items():
-        if k in _config:
-            _config[k] = v
-
-
-def _op_hook(fn, raws, name):
-    if any(isinstance(r, jax.core.Tracer) for r in raws):
-        # inside a jit/eval_shape trace of a hybridized block: not a device
-        # execution, don't record (times would be Python tracing time)
-        return fn(*raws)
-    start = time.perf_counter()
-    outs = fn(*raws)
-    jax.block_until_ready(outs)
-    dur = time.perf_counter() - start
-    _records.append({"name": name or getattr(fn, "__name__", "op"),
-                     "cat": "operator",
-                     "ts": (start - _t0) * 1e6, "dur": dur * 1e6})
-    return outs
-
-
-def set_state(state="stop"):
-    """'run' starts collection (installs the op hook), 'stop' ends it.
-    Idempotent: repeating the current state is a no-op."""
-    assert state in ("run", "stop")
-    was_running = _state["running"]
-    _state["running"] = state == "run"
-    _state["paused"] = False
-    _nd._op_hook = _op_hook if _state["running"] else None
-    if _config["profile_xla"] and was_running != _state["running"]:
-        if state == "run":
-            jax.profiler.start_trace(_config["xla_logdir"])
-        else:
-            try:
-                jax.profiler.stop_trace()
-            except RuntimeError:
-                pass
-
-
-def pause():
-    if _state["running"]:
-        _state["paused"] = True
-        _nd._op_hook = None
-
-
-def resume():
-    if _state["running"]:
-        _state["paused"] = False
-        _nd._op_hook = _op_hook
-
-
-@contextmanager
-def scope(name="<unk>"):
-    """Time a user region (reference: profiler scopes / frame markers).
-    Free when profiling is off: no sync, no record — scopes can stay in
-    production training loops."""
-    active = _state["running"] and not _state["paused"]
-    start = time.perf_counter()
-    try:
-        yield
-    finally:
-        if active:
-            _nd.waitall()
-            dur = time.perf_counter() - start
-            _records.append({"name": name, "cat": "scope",
-                             "ts": (start - _t0) * 1e6, "dur": dur * 1e6})
-
-
-def reset():
-    _records.clear()
-
-
-def dump(finished=True):
-    """Write a Chrome trace-event JSON to `filename`."""
-    events = [{"name": r["name"], "cat": r["cat"], "ph": "X", "pid": 0,
-               "tid": 0, "ts": r["ts"], "dur": r["dur"]} for r in _records]
-    with open(_config["filename"], "w") as f:
-        json.dump({"traceEvents": events}, f)
-
-
-def dumps(reset=False):
-    """Aggregate-stats table (reference `profiler.dumps()` format)."""
-    agg: dict[str, list[float]] = {}
-    for r in _records:
-        agg.setdefault(r["name"], []).append(r["dur"])
-    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(us)':>12}"
-             f"{'Max(us)':>12}"]
-    for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
-        lines.append(f"{name[:39]:<40}{len(durs):>8}"
-                     f"{sum(durs) / 1e3:>12.3f}"
-                     f"{sum(durs) / len(durs):>12.1f}"
-                     f"{max(durs):>12.1f}")
-    out = "\n".join(lines)
-    if reset:
-        _records.clear()
-    return out
-
-
-def device_memory_stats(device=None):
-    """XLA allocator counters for a device (bytes_in_use, peak_bytes_in_use,
-    ...). Reference analogue: gpu memory profile / storage stats."""
-    device = device or jax.local_devices()[0]
-    stats = device.memory_stats()
-    return dict(stats) if stats else {}
+_sys.modules[__name__] = _profiler

@@ -63,7 +63,7 @@ MODULES = [
     ("incubator_mxnet_tpu.test_utils", "mx.test_utils"),
     ("incubator_mxnet_tpu.util", "mx.util"),
     ("incubator_mxnet_tpu.runtime", "native runtime bindings"),
-    ("incubator_mxnet_tpu.utils.profiler", "mx.profiler"),
+    ("incubator_mxnet_tpu.profiler", "mx.profiler"),
 ]
 
 

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Validate a Chrome trace-event JSON file (as written by
+`incubator_mxnet_tpu.profiler.dump()` or any trace-event producer).
+
+Checks the subset of the Trace Event Format that chrome://tracing /
+Perfetto actually require to render:
+
+* top level is either a JSON array of events or an object whose
+  ``traceEvents`` is an array;
+* every event is an object with a string ``name`` and a string ``ph``;
+* complete events (``ph == "X"``) carry numeric, non-negative ``ts`` and
+  ``dur``;
+* instant/counter events (``ph in "iIC"``) carry a numeric ``ts``;
+* ``pid``/``tid``, when present, are integers.
+
+Usage:
+    python tools/trace_check.py trace.json [more.json ...]
+
+Exit status 0 iff every file validates; errors are printed one per line.
+bench.py imports :func:`check_trace` and fails the run on a malformed
+dump, so a broken profiler can't silently ship garbage traces.
+"""
+from __future__ import annotations
+
+import json
+import numbers
+import sys
+
+__all__ = ["check_trace", "check_events"]
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, numbers.Real) and not isinstance(x, bool)
+
+
+def check_events(events) -> list:
+    """Validate a list of trace events. Returns a list of error strings
+    (empty = valid)."""
+    errors = []
+    if not isinstance(events, list):
+        return [f"traceEvents must be a list, got {type(events).__name__}"]
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        name = ev.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: missing/empty 'name'")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or len(ph) != 1:
+            errors.append(f"{where} ({name!r}): missing 'ph'")
+            continue
+        if ph == "X":
+            if not _is_num(ev.get("ts")) or ev["ts"] < 0:
+                errors.append(f"{where} ({name!r}): 'X' event needs numeric "
+                              f"ts >= 0, got {ev.get('ts')!r}")
+            if not _is_num(ev.get("dur")) or ev["dur"] < 0:
+                errors.append(f"{where} ({name!r}): 'X' event needs numeric "
+                              f"dur >= 0, got {ev.get('dur')!r}")
+        elif ph in ("i", "I", "C", "B", "E"):
+            if not _is_num(ev.get("ts")):
+                errors.append(f"{where} ({name!r}): '{ph}' event needs "
+                              f"numeric ts, got {ev.get('ts')!r}")
+        for key in ("pid", "tid"):
+            if key in ev and not isinstance(ev[key], int):
+                errors.append(f"{where} ({name!r}): '{key}' must be int, "
+                              f"got {ev[key]!r}")
+    return errors
+
+
+def check_trace(path: str) -> list:
+    """Validate one trace file. Returns a list of error strings."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON: {e}"]
+    if isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict):
+        if "traceEvents" not in doc:
+            return [f"{path}: object form requires a 'traceEvents' key"]
+        events = doc["traceEvents"]
+    else:
+        return [f"{path}: top level must be a list or object, "
+                f"got {type(doc).__name__}"]
+    return [f"{path}: {e}" for e in check_events(events)]
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: python tools/trace_check.py trace.json [...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = check_trace(path)
+        if errors:
+            rc = 1
+            for e in errors:
+                print(e, file=sys.stderr)
+        else:
+            print(f"{path}: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
