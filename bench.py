@@ -147,6 +147,12 @@ def _finish_profile(result, trace_path, **phase_s):
     for k, v in phases.items():
         prof.set_gauge("bench/" + k, v)
     result.setdefault("extra", {})["phases"] = phases
+    # dispatch-overhead regression canary: host dispatches per train step
+    # (always-live counter gauge — FusedTrainStep reports 1, 1/k under
+    # run_k; the eager Trainer reports #params unfused / #(rule,dtype)
+    # groups with fused_update). Visible in BENCH_*.json without a TPU.
+    result["extra"]["dispatches_per_step"] = prof.counters().get(
+        "mxtpu/trainer.dispatches_per_step")
     if trace_path is None:
         return
     prof.stop()

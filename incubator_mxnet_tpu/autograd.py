@@ -211,8 +211,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
 
 
 def _grad_impl(heads, head_grads, variables, create_graph):
+    from . import bulk as _bulk
     from . import ndarray as _nd
 
+    # pending deferred segment: the tape's saved values and head data must
+    # be concrete before the reverse walk reads them. Unconditional (not
+    # gated on _bulk._ON): a segment may outlive its scope/auto-bulk mode
+    # on another thread, and flush() is a cheap thread-local check.
+    _bulk.flush("backward")
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
     if head_grads is None:

@@ -1,0 +1,447 @@
+"""Bulk-fused eager dispatch: deferred op segments compiled to one XLA call.
+
+Parity target: the reference engine's bulk mode (`Engine::StartBulk` /
+`MXEngineSetBulkSize`), which batches `size` consecutive async ops into one
+scheduling unit to amortize per-op engine overhead. Rebuilt TPU-native in
+the LazyTensor lineage (PyTorch/XLA): inside an `engine.bulk(size)` scope
+(or the opt-in auto-bulk mode) every eager NDArray dispatch appends to a
+deferred *segment* instead of launching its own XLA computation. The
+segment is flushed — replayed as a single `jax.jit`-compiled executable —
+when
+
+* it reaches `size` ops                                  (reason ``size``),
+* the scope exits                                        (reason ``exit``),
+* a value is read: ``asnumpy``/``wait_to_read``/``item``/
+  control flow on a deferred array                       (reason ``read``),
+* ``autograd.backward``/``grad`` starts a tape walk      (reason ``backward``),
+* ``Trainer.step`` begins an optimizer update            (reason ``step``).
+
+Compiled segments are cached by an *op/shape signature* so steady-state
+loops hit the compile cache: per op the signature is either the function
+object itself (module-level kernels like ``jnp.add``) or, for the closure
+lambdas the op layer builds around Python scalars/axes, the pair
+``(code object, closure values)`` — two segments share an executable only
+when every op's code AND captured constants match, which makes the cache
+sound (an `x + 2` segment can never answer for `x + 3`). Ops whose
+closures capture unhashable values mark the segment uncacheable; it still
+runs fused, it just recompiles (counted as a miss).
+
+Profiler counters (always-live registry, see profiler.counters):
+``mxtpu/bulk.segments``, ``mxtpu/bulk.ops``, ``mxtpu/bulk.segment_size``
+(gauge, last flush), ``mxtpu/bulk.flush.<reason>``, and
+``bulk/jit.cache_hit`` / ``bulk/jit.cache_miss`` for the segment compile
+cache.
+
+This module must not import `ndarray` (ndarray imports it); the NDArray
+wrapper factory is injected via `_WRAP` at ndarray import time.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+
+from . import profiler as _prof
+
+__all__ = ["DeferredArray", "defer", "flush", "materialize", "is_deferred",
+           "push_scope", "pop_scope", "set_auto_bulk", "auto_bulk_size",
+           "pending_ops"]
+
+# fast-path flag checked by ndarray._apply: True iff ANY thread has an open
+# bulk scope or auto-bulk is enabled. Per-thread truth lives in _tls.
+_ON = False
+_AUTO_SIZE = 0
+_scope_count = 0
+_lock = threading.Lock()
+_tls = threading.local()
+
+# installed by ndarray/__init__: raw-like -> NDArray (bypasses coercion)
+_WRAP = None
+
+# segment signature -> jitted replay fn. Bounded: cleared wholesale when it
+# outgrows _CACHE_MAX (steady-state loops use a handful of signatures).
+_COMPILE_CACHE: dict = {}
+_CACHE_MAX = 1024
+
+
+def _recompute_on():
+    global _ON
+    _ON = _scope_count > 0 or _AUTO_SIZE > 0
+
+
+def _st():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []      # open bulk-scope sizes, innermost last
+        _tls.seg = None      # current open segment
+    return _tls
+
+
+def _active_size() -> int:
+    st = _st()
+    if st.stack:
+        return st.stack[-1]
+    return _AUTO_SIZE
+
+
+# ---------------------------------------------------------------------------
+# scopes / auto-bulk
+# ---------------------------------------------------------------------------
+
+def push_scope(size: int):
+    """Enter a bulk scope (engine.bulk.__enter__)."""
+    global _scope_count
+    st = _st()
+    st.stack.append(max(1, int(size)))
+    with _lock:
+        _scope_count += 1
+        _recompute_on()
+
+
+def pop_scope():
+    """Leave a bulk scope: flush the pending segment (imperative semantics
+    — values escaping the scope are concrete)."""
+    global _scope_count
+    st = _st()
+    flush("exit")
+    if st.stack:
+        st.stack.pop()
+    with _lock:
+        _scope_count = max(0, _scope_count - 1)
+        _recompute_on()
+
+
+def set_auto_bulk(size: int) -> int:
+    """Opt-in ambient bulking: every eager dispatch on every thread defers
+    into segments of up to `size` ops without an explicit scope (parity:
+    MXEngineSetBulkSize). `size<=0` disables and flushes the CALLING
+    thread's pending segment; other threads' pending segments flush at
+    their next read/backward/waitall/step barrier (those flush points run
+    unconditionally). Returns the previous size. Env default:
+    MXTPU_AUTO_BULK."""
+    global _AUTO_SIZE
+    prev = _AUTO_SIZE
+    _AUTO_SIZE = max(0, int(size))
+    with _lock:
+        _recompute_on()
+    if _AUTO_SIZE == 0:
+        flush("exit")
+    return prev
+
+
+def auto_bulk_size() -> int:
+    return _AUTO_SIZE
+
+
+def pending_ops() -> int:
+    """Ops queued in the calling thread's open segment (tests/debug)."""
+    st = _st()
+    return 0 if st.seg is None or st.seg.done else len(st.seg.ops)
+
+
+# ---------------------------------------------------------------------------
+# deferred values
+# ---------------------------------------------------------------------------
+
+class DeferredArray:
+    """Placeholder for one output of a deferred op. Duck-types the shape/
+    dtype surface of jax.Array; ANY other attribute access materializes the
+    owning segment first (that is the flush-on-read contract)."""
+
+    __slots__ = ("_seg", "_slot", "_aval", "_concrete", "__weakref__")
+
+    def __init__(self, seg, slot, aval):
+        self._seg = seg
+        self._slot = slot
+        self._aval = aval
+        self._concrete = None
+
+    @property
+    def shape(self):
+        return tuple(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._aval.shape)) if self._aval.shape else 1
+
+    def _force(self):
+        if self._concrete is None:
+            _flush_segment(self._seg, "read")
+        return self._concrete
+
+    def __getattr__(self, name):
+        # only reached for names not defined above — a concrete-array API
+        # access (block_until_ready, reshape, astype, devices, ...)
+        return getattr(self._force(), name)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._force()
+
+    def __repr__(self):
+        state = "pending" if self._concrete is None else "done"
+        return (f"<DeferredArray {self.shape} {self.dtype} {state}>")
+
+    # arithmetic straight on the raw wrapper (grad accumulation et al.)
+    # materializes and delegates
+    def __add__(self, o): return self._force() + o
+    def __radd__(self, o): return o + self._force()
+    def __sub__(self, o): return self._force() - o
+    def __rsub__(self, o): return o - self._force()
+    def __mul__(self, o): return self._force() * o
+    def __rmul__(self, o): return o * self._force()
+    def __truediv__(self, o): return self._force() / o
+    def __rtruediv__(self, o): return o / self._force()
+    def __neg__(self): return -self._force()
+    def __getitem__(self, k): return self._force()[k]
+
+
+def is_deferred(x) -> bool:
+    return type(x) is DeferredArray
+
+
+def materialize_one(x):
+    """Concrete value of a possibly-deferred raw."""
+    if type(x) is DeferredArray:
+        return x._force()
+    return x
+
+
+def materialize(raws):
+    return [materialize_one(r) for r in raws]
+
+
+# ---------------------------------------------------------------------------
+# segments
+# ---------------------------------------------------------------------------
+
+class _Segment:
+    __slots__ = ("max_size", "ops", "sig_parts", "consts", "_const_idx",
+                 "deferred", "targets", "cacheable", "done")
+
+    def __init__(self, max_size):
+        self.max_size = max_size
+        self.ops = []          # (fn, in_refs, n_out, out_slots)
+        self.sig_parts = []    # per-op signature parts (while cacheable)
+        self.consts = []       # concrete segment inputs, deduped by id
+        self._const_idx = {}   # id(raw) -> index into consts
+        self.deferred = []     # slot -> DeferredArray
+        self.targets = []      # (DeferredArray, NDArray) write-back pairs
+        self.cacheable = True
+        self.done = False
+
+    def _const(self, raw):
+        i = self._const_idx.get(id(raw))
+        if i is None:
+            i = len(self.consts)
+            self.consts.append(raw)
+            self._const_idx[id(raw)] = i
+        return i
+
+
+def _val_key(v):
+    """Hashable identity of a closure-captured value, or None (unhashable
+    → the op poisons its segment's cache eligibility). Scalars key with
+    their type so `2` and `2.0` (equal, same hash) never collide — jnp
+    promotion treats them differently."""
+    if callable(v):
+        return _fn_key(v)
+    if isinstance(v, dict):
+        items = []
+        for k in sorted(v, key=repr):
+            kk = _val_key(v[k])
+            if kk is None:
+                return None
+            items.append((k, kk))
+        return ("d",) + tuple(items)
+    if isinstance(v, (list, tuple)):
+        out = []
+        for item in v:
+            ik = _val_key(item)
+            if ik is None:
+                return None
+            out.append(ik)
+        return ("t",) + tuple(out)
+    try:
+        hash(v)
+    except TypeError:
+        return None
+    return (type(v).__name__, v)
+
+
+def _fn_key(fn):
+    """Signature of an op function: the function object itself when it has
+    no closure (module-level kernels), else (code, closure values) — the
+    op layer recreates identical lambdas every loop iteration, and this
+    keys them by semantics instead of identity."""
+    try:
+        hash(fn)
+    except TypeError:
+        return None
+    closure = getattr(fn, "__closure__", None)
+    defaults = getattr(fn, "__defaults__", None)
+    if not closure and not defaults:
+        return fn
+    vals = []
+    for cell in closure or ():
+        k = _val_key(cell.cell_contents)
+        if k is None:
+            return None
+        vals.append(k)
+    dk = _val_key(tuple(defaults)) if defaults else None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    return (code, tuple(vals), dk)
+
+
+def defer(fn, raws, n_out, name):
+    """Append one op to the calling thread's segment. Returns the output
+    NDArrays (already wrapped + registered for write-back), or None when
+    bulking does not apply (no active scope, tracer inputs, profiler op
+    hook installed by the caller, abstract eval failure)."""
+    size = _active_size()
+    if size <= 0 or _WRAP is None:
+        return None
+    for r in raws:
+        if isinstance(r, jax.core.Tracer):
+            return None          # inside a jit trace: no dispatch to save
+    st = _st()
+    seg = st.seg
+    if seg is None or seg.done:
+        seg = st.seg = _Segment(size)
+    else:
+        seg.max_size = size      # innermost scope's size wins
+
+    in_refs = []
+    aval_args = []
+    for r in raws:
+        if type(r) is DeferredArray:
+            if r._seg is seg and r._concrete is None:
+                in_refs.append(("s", r._slot))
+                aval_args.append(r._aval)
+                continue
+            r = r._force()       # cross-segment / already-flushed input
+        in_refs.append(("c", seg._const(r)))
+        aval_args.append(r)
+    try:
+        out_aval = jax.eval_shape(fn, *aval_args)
+    except Exception:
+        if not seg.ops:
+            st.seg = None
+        return None              # data-dependent op: caller runs it eagerly
+    out_avals = (out_aval,) if n_out == 1 else tuple(out_aval)
+    if len(out_avals) != n_out:
+        return None
+
+    fk = _fn_key(fn) if seg.cacheable else None
+    if fk is None:
+        seg.cacheable = False
+        seg.sig_parts = None
+    else:
+        seg.sig_parts.append((fk, tuple(in_refs), n_out))
+
+    out_nds = []
+    out_slots = []
+    for av in out_avals:
+        slot = len(seg.deferred)
+        d = DeferredArray(seg, slot, av)
+        seg.deferred.append(d)
+        out_slots.append(slot)
+        ndarr = _WRAP(d)
+        seg.targets.append((d, ndarr))
+        out_nds.append(ndarr)
+    seg.ops.append((fn, tuple(in_refs), n_out, tuple(out_slots)))
+
+    if len(seg.ops) >= seg.max_size:
+        _flush_segment(seg, "size")
+        if st.seg is seg:
+            st.seg = None
+    return out_nds
+
+
+def _build_seg_fn(ops, n_slots):
+    def seg_fn(consts):
+        env = [None] * n_slots
+        for fn, in_refs, n_out, out_slots in ops:
+            args = [consts[i] if kind == "c" else env[i]
+                    for kind, i in in_refs]
+            o = fn(*args)
+            o = (o,) if n_out == 1 else tuple(o)
+            for s, v in zip(out_slots, o):
+                env[s] = v
+        return env
+    return seg_fn
+
+
+def _flush_segment(seg, reason):
+    if seg.done:
+        return
+    seg.done = True
+    n = len(seg.ops)
+    if n == 0:
+        return
+    sig = None
+    jitted = None
+    if seg.cacheable:
+        sig = (tuple(seg.sig_parts),
+               tuple((tuple(np.shape(c)), str(getattr(c, "dtype", type(c))))
+                     for c in seg.consts))
+        jitted = _COMPILE_CACHE.get(sig)
+    if jitted is None:
+        _prof.counter("jit.cache_miss", "bulk").increment()
+        jitted = jax.jit(_build_seg_fn(seg.ops, len(seg.deferred)))
+        if sig is not None:
+            if len(_COMPILE_CACHE) >= _CACHE_MAX:
+                _COMPILE_CACHE.clear()
+            _COMPILE_CACHE[sig] = jitted
+    else:
+        _prof.counter("jit.cache_hit", "bulk").increment()
+    outs = jitted(list(seg.consts))
+    for d, o in zip(seg.deferred, outs):
+        d._concrete = o
+        d._seg = None     # aliased wrappers (__setitem__/detach) may hold
+                          # the DeferredArray long-term: drop the segment
+                          # ref so it can't pin consts/ops/targets
+    for d, ndarr in seg.targets:
+        if ndarr._data is d:
+            ndarr._data = d._concrete
+    seg.ops = seg.sig_parts = seg.consts = None
+    seg.targets = seg.deferred = None
+    seg._const_idx = None
+    _prof.counter("bulk.segments").increment()
+    _prof.counter("bulk.ops").increment(n)
+    _prof.set_gauge("bulk.segment_size", n)
+    _prof.counter("bulk.flush.%s" % reason).increment()
+    if _prof._ACTIVE:
+        _prof._instant("bulk.flush(%s)" % reason, "engine",
+                       args={"ops": n, "reason": reason})
+
+
+def flush(reason="read"):
+    """Flush the calling thread's pending segment, if any."""
+    st = _st()
+    seg = st.seg
+    if seg is not None:
+        st.seg = None
+        _flush_segment(seg, reason)
+
+
+_env_auto = os.environ.get("MXTPU_AUTO_BULK")
+if _env_auto:
+    try:
+        set_auto_bulk(int(_env_auto))
+    except ValueError:
+        pass

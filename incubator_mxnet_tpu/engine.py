@@ -12,13 +12,14 @@ IO-grade tasks, plus the engine-selection switch:
 """
 from __future__ import annotations
 
+from . import bulk as _bulk_mod
 from . import profiler as _prof
 from . import runtime as _rt
 from . import ndarray as _nd
 from .runtime import engine_type, get_engine
 
 __all__ = ["push", "new_var", "wait_for_var", "wait_all", "engine_type",
-           "get_engine", "bulk"]
+           "get_engine", "bulk", "set_bulk_size", "bulk_size"]
 
 
 def new_var() -> int:
@@ -55,12 +56,16 @@ def wait_all():
 
 class bulk:
     """Parity: mx.engine.bulk(size) — the reference batches `size` async
-    engine ops into one bulk segment to cut scheduling overhead. Here XLA
-    already batches device work per dispatch (and FusedTrainStep.run_k is
-    the explicit bulk form), so the context manager is semantically a
-    no-op that preserves reference code shape. When profiling is running
-    it records a `bulk(size)` trace scope, so reference-shaped code shows
-    up in traces; off, it stays a single-predicate no-op."""
+    engine ops into one bulk segment to cut scheduling overhead. Here it
+    is REAL: inside the scope, eager NDArray dispatches append to a
+    deferred segment graph that is flushed as one jit-compiled XLA call —
+    when the segment reaches `size` ops, when the scope exits, or when a
+    value is read (`asnumpy`/`wait_to_read`/`item`/control flow) or a
+    backward walk starts, so imperative semantics are preserved (see
+    bulk.py; docs/engine.md). Compiled segments are cached by op/shape
+    signature, so steady-state loops reuse one executable per segment
+    shape. When profiling is running it additionally records a
+    `bulk(size)` trace scope."""
 
     def __init__(self, size=15):
         self.size = int(size)
@@ -71,10 +76,26 @@ class bulk:
             self._scope = _prof.Scope("bulk(%d)" % self.size, "engine",
                                       sync=False)
             self._scope.__enter__()
+        _bulk_mod.push_scope(self.size)
         return self
 
     def __exit__(self, *exc):
+        _bulk_mod.pop_scope()     # flushes the pending segment
         if self._scope is not None:
             self._scope.__exit__(*exc)
             self._scope = None
         return False
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity: mx.engine.set_bulk_size — opt-in AUTO-bulk: every eager
+    dispatch (any thread) defers into segments of up to `size` ops without
+    an explicit `bulk` scope; 0 disables (and flushes the calling thread's
+    pending segment; other threads flush at their next read/barrier).
+    Returns the previous size. Env default: MXTPU_AUTO_BULK=<n>."""
+    return _bulk_mod.set_auto_bulk(size)
+
+
+def bulk_size() -> int:
+    """Current auto-bulk segment size (0 = disabled)."""
+    return _bulk_mod.auto_bulk_size()

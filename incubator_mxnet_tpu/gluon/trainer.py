@@ -7,6 +7,7 @@ in parallel/ and is what bench/dryrun use.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import jax
@@ -151,8 +152,13 @@ class _GradCommScheduler:
     def flush(self):
         """step(): issue stragglers (whole-bucket, priority order) and
         drain the heap unconditionally; afterwards every param's .grad()
-        holds the aggregated value, as the batched path would."""
+        holds the aggregated value, as the batched path would.
+
+        issued_log is reset here (start of flush) so it never grows across
+        steps: after step() it holds exactly this flush's issuance order;
+        mid-backward issuance is readable between backward() and step()."""
         import heapq
+        self.issued_log.clear()
         if self._kv.num_workers <= 1:
             return
         # EVERY bucket not yet issued goes now — including ones whose
@@ -172,7 +178,7 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
                  overlap_comm=False, comm_bucket_bytes=0,
-                 comm_credit_bytes=4 << 20):
+                 comm_credit_bytes=4 << 20, fused_update=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -211,6 +217,12 @@ class Trainer:
                 "incompatible with server-side updates "
                 "(update_on_kvstore)")
         self._update_on_kvstore = bool(update_on_kvstore)
+        # fused multi-tensor apply: group params by (rule, dtype) and run
+        # each group's updates as ONE jitted call (vs one call per param).
+        # Default on; env MXTPU_FUSED_UPDATE=0 disables globally.
+        if fused_update is None:
+            fused_update = os.environ.get("MXTPU_FUSED_UPDATE", "1") != "0"
+        self._fused_update = bool(fused_update)
         self._kv_params_init = False
         self._sched = None
         if overlap_comm:
@@ -220,19 +232,22 @@ class Trainer:
                 self._kvstore, self._params,
                 bucket_bytes=comm_bucket_bytes,
                 credit_bytes=comm_credit_bytes)
-            self._hooked = [False] * len(self._params)
             self._ensure_grad_hooks()
 
     def _ensure_grad_hooks(self):
         """Attach readiness hooks to every initialized param; deferred-init
         params get theirs on a later call (their first backward simply
-        falls back to flush-time aggregation — numerics are unchanged)."""
+        falls back to flush-time aggregation — numerics are unchanged).
+        Keyed on the parameter's CURRENT storage, not a one-shot latch:
+        initialize(force_reinit=True) and cast() replace `p._data` (and
+        with it the hook slot), so hooks are re-attached whenever the live
+        storage has none — overlap survives re-init instead of silently
+        degrading to flush-time aggregation."""
         sched = self._sched
         for i, p in enumerate(self._params):
-            if not self._hooked[i] and p._data is not None:
+            if p._data is not None and p._data._grad_hook is None:
                 p.register_grad_hook(
                     lambda _p, _i=i: sched.notify(_i))
-                self._hooked[i] = True
 
     # -- properties -------------------------------------------------------
     @property
@@ -315,13 +330,48 @@ class Trainer:
         self._update()
 
     def _update(self):
+        from .. import bulk as _bulk
+        # grads/weights must be concrete before the optimizer reads them
+        # (unconditional: cheap thread-local check, and a pending segment
+        # can outlive its scope on this thread)
+        _bulk.flush("step")
         skip = getattr(self, "_amp_skip", None)  # on-device found-inf bool
+        opt = self._optimizer
+        dispatches = 0
+        if not (self._fused_update and opt.supports_fused()):
+            for i, p in enumerate(self._params):
+                self._init_state(i, p)
+                self._states[i] = opt.update(i, p.data(), p.grad(),
+                                             self._states[i], skip=skip)
+                dispatches += 1
+            _prof.set_gauge("optimizer.fused_groups", 0)
+            _prof.set_gauge("trainer.dispatches_per_step", dispatches)
+            _prof.counter("optimizer.dispatches").increment(dispatches)
+            return
+        from ..ndarray import sparse as _sparse
+        groups = {}   # dtype str -> param indices (one rule per Trainer)
         for i, p in enumerate(self._params):
             self._init_state(i, p)
-            w = p.data()
             g = p.grad()
-            self._states[i] = self._optimizer.update(i, w, g, self._states[i],
-                                                     skip=skip)
+            if isinstance(g, _sparse.RowSparseNDArray):
+                # sparse rules keep the per-param (lazy-row) path
+                self._states[i] = opt.update(i, p.data(), g,
+                                             self._states[i], skip=skip)
+                dispatches += 1
+            else:
+                groups.setdefault(str(p.data()._data.dtype), []).append(i)
+        for idxs in groups.values():
+            new_states = opt.fused_update(
+                idxs,
+                [self._params[i].data() for i in idxs],
+                [self._params[i].grad() for i in idxs],
+                [self._states[i] for i in idxs], skip=skip)
+            for i, s in zip(idxs, new_states):
+                self._states[i] = s
+            dispatches += 1
+        _prof.set_gauge("optimizer.fused_groups", len(groups))
+        _prof.set_gauge("trainer.dispatches_per_step", dispatches)
+        _prof.counter("optimizer.dispatches").increment(dispatches)
 
     # -- persistence ------------------------------------------------------
     def save_states(self, fname):

@@ -241,6 +241,9 @@ class KVStore:
         self._async_queue = (_AsyncQueue(self._async_apply)
                              if self._is_async else None)
         self._async_ps = None     # cross-process transport, created lazily
+        # dist_async flush deadline (seconds); None = transport default
+        # (MXTPU_APS_FLUSH_TIMEOUT env or 120 s)
+        self.async_flush_timeout = None
 
     def _ps(self):
         """Cross-process async transport (kvstore/async_ps.py), active
@@ -250,7 +253,8 @@ class KVStore:
             return None
         if self._async_ps is None:
             from .async_ps import AsyncPSTransport
-            self._async_ps = AsyncPSTransport(self)
+            self._async_ps = AsyncPSTransport(
+                self, flush_timeout=self.async_flush_timeout)
         return self._async_ps
 
     def _async_apply(self, key, grad):
@@ -543,8 +547,10 @@ class KVStore:
         ps = self._ps() if self._is_async else None
         if ps is not None:
             # wait until MY pushes are all server-applied, then rendezvous
-            # with the other workers (reference: Barrier on the server)
-            ps.flush()
+            # with the other workers (reference: Barrier on the server).
+            # The deadline is read here, not at transport construction, so
+            # adjusting kv.async_flush_timeout mid-run takes effect.
+            ps.flush(timeout=self.async_flush_timeout)
             from .. import distributed
             distributed.barrier("mxtpu_kv_barrier")
         if self._async_queue is not None:

@@ -19,6 +19,7 @@ nondeterminism: grpc delivery and poll timing decide it.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
@@ -31,10 +32,12 @@ _LIVE = []      # live transports; distributed.shutdown() stops them first
 
 def stop_all(timeout=5.0):
     """Stop every live server thread (joined, not abandoned): called by
-    mx.distributed.shutdown() before the coordination client dies."""
-    for t in list(_LIVE):
+    mx.distributed.shutdown() before the coordination client dies.
+    Snapshot first: stop() deregisters each transport from _LIVE."""
+    live = list(_LIVE)
+    for t in live:
         t.stop()
-    for t in list(_LIVE):
+    for t in live:
         if t._thread is not None:
             t._thread.join(timeout)
     _LIVE.clear()
@@ -54,7 +57,7 @@ def _client():
 class AsyncPSTransport:
     """One per dist_async KVStore when process_count > 1."""
 
-    def __init__(self, kv, poll_ms=2.0):
+    def __init__(self, kv, poll_ms=2.0, flush_timeout=None):
         import jax
         self._kv = kv
         self._c = _client()
@@ -63,6 +66,10 @@ class AsyncPSTransport:
         self._seq = 0                 # my push sequence (per-worker FIFO)
         self._pushed = 0
         self._poll_s = poll_ms / 1e3
+        if flush_timeout is None:
+            flush_timeout = float(os.environ.get(
+                "MXTPU_APS_FLUSH_TIMEOUT", "120"))
+        self.flush_timeout = float(flush_timeout)
         self._stop = threading.Event()
         self._applied = {}            # server: worker rank -> applied count
         self._touched = set()         # server: keys updated since publish
@@ -106,16 +113,19 @@ class AsyncPSTransport:
         except Exception:
             return None
 
-    def flush(self):
+    def flush(self, timeout=None):
         """Block until every push THIS worker issued has been applied
         server-side (the reference's per-worker Wait on the send queue).
-        Signals the server to force-drain any staleness-delayed entries."""
+        Signals the server to force-drain any staleness-delayed entries.
+        Deadline: `timeout` arg, else the transport's `flush_timeout`
+        (constructor arg / MXTPU_APS_FLUSH_TIMEOUT env, default 120 s)."""
         self._c.key_value_set_bytes(f"{_NS}/flushreq/{self.rank}", b"1",
                                     allow_overwrite=True)
         if self._pushed == 0:
             return   # nothing to wait for (the flushreq still releases
                      # any delayed peers' entries on the server)
-        deadline = time.time() + 120
+        limit = self.flush_timeout if timeout is None else float(timeout)
+        deadline = time.time() + limit
         while time.time() < deadline:
             blob = self._try_get(f"{_NS}/applied/{self.rank}")
             if blob is not None and int(blob) >= self._pushed:
@@ -123,13 +133,14 @@ class AsyncPSTransport:
             time.sleep(self._poll_s)
         raise TimeoutError(
             f"dist_async flush: rank {self.rank} pushed {self._pushed} "
-            "but the server did not acknowledge them in 120s")
+            f"but the server did not acknowledge them in {limit:g}s")
 
     def wait_outstanding(self, max_outstanding, timeout=60.0):
         """Block until at most `max_outstanding` of MY pushes are still
         unapplied — the worker-side pacing ps-lite gets implicitly from
         pulling updated weights after each push. Cross-worker staleness
         stays unbounded; only a worker's lead over ITSELF is capped."""
+        applied = 0   # a non-positive timeout must raise TimeoutError
         deadline = time.time() + timeout
         while time.time() < deadline:
             blob = self._try_get(f"{_NS}/applied/{self.rank}")
@@ -150,7 +161,14 @@ class AsyncPSTransport:
         return out
 
     def stop(self):
+        """Signal the server thread to exit and deregister from _LIVE so a
+        discarded dist_async store doesn't pin a 2 ms-poll daemon (and its
+        transport) for the life of the process."""
         self._stop.set()
+        try:
+            _LIVE.remove(self)
+        except ValueError:
+            pass
 
     # -- server side (rank 0 thread) --------------------------------------
     def _apply(self, tagged_key, grad):

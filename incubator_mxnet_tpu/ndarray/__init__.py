@@ -25,6 +25,7 @@ import numpy as np
 from jax import lax
 
 from .. import autograd
+from .. import bulk as _bulk
 from ..base import normalize_dtype
 from ..context import Context, ctx_from_device, current_context
 
@@ -42,10 +43,25 @@ def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] 
     """Run a pure jax function on NDArray inputs; record on the tape if
     autograd is recording. The single funnel for all eager ops.
 
+    Inside an engine.bulk scope (or auto-bulk mode) the op is appended to
+    a deferred segment instead of dispatching — one compiled XLA call per
+    segment (see bulk.py). Recording and the profiler's per-op timing hook
+    keep the eager path (the tape needs concrete values; the hook needs
+    per-op durations).
+
     fn_fwd: optional compiled variant used for execution (fn stays on the
     tape for differentiation); fn_vjp: optional precompiled pullback
     (primals..., out_cots...) -> input cots (HybridBlock CachedOp path).
     """
+    if _bulk._ON:
+        if _op_hook is None and not autograd.is_recording():
+            res = _bulk.defer(fn_fwd or fn, [x._data for x in inputs],
+                              n_out, name)
+            if res is not None:
+                return res[0] if n_out == 1 else tuple(res)
+        for x in inputs:                 # eager fallback: concrete inputs
+            if _bulk.is_deferred(x._data):
+                x._data = _bulk.materialize_one(x._data)
     raws = [x._data for x in inputs]
     if _op_hook is None:
         outs = (fn_fwd or fn)(*raws)
@@ -56,6 +72,20 @@ def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] 
     if autograd.is_recording():
         autograd._record_op(fn, inputs, raws, results, name, fn_vjp=fn_vjp)
     return results[0] if n_out == 1 else tuple(results)
+
+
+def _wrap_deferred(raw) -> "NDArray":
+    """NDArray around a bulk DeferredArray, bypassing __init__ coercion."""
+    out = NDArray.__new__(NDArray)
+    out._data = raw
+    out._node = None
+    out._grad = None
+    out._grad_req = None
+    out._grad_hook = None
+    return out
+
+
+_bulk._WRAP = _wrap_deferred
 
 
 def _as_nd(x, ref: Optional["NDArray"] = None):
@@ -120,6 +150,20 @@ class NDArray:
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None, _node=None):
         if isinstance(data, NDArray):
             data = data._data
+        if _bulk.is_deferred(data):
+            # keep the value deferred (detach/copy of a pending result)
+            # unless a dtype cast or an explicit device placement forces
+            # materialization (deferred outputs land on the segment's
+            # device, so honoring ctx needs a concrete array)
+            if dtype is not None or ctx is not None:
+                data = _bulk.materialize_one(data)
+            else:
+                self._data = data
+                self._node = _node
+                self._grad = None
+                self._grad_req = None
+                self._grad_hook = None
+                return
         if not isinstance(data, jax.Array) or dtype is not None:
             dt = None if dtype is None else normalize_dtype(dtype)
             data = jnp.asarray(data, dtype=dt)
@@ -194,8 +238,9 @@ class NDArray:
         return self
 
     def jax(self) -> jax.Array:
-        """Raw backing jax.Array (escape hatch for interop)."""
-        return self._data
+        """Raw backing jax.Array (escape hatch for interop); flushes any
+        pending bulk segment so the result is always concrete."""
+        return _bulk.materialize_one(self._data)
 
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req: str = "write"):
@@ -441,11 +486,14 @@ def _device_of(arr: jax.Array):
 
 
 def _fix_index(key):
-    """Unwrap NDArray indices to raw arrays."""
+    """Unwrap NDArray indices to raw arrays. Index arrays materialize any
+    pending bulk value: they are captured in the indexing op's closure
+    (not passed as segment inputs), so a deferred key must be concrete."""
     if isinstance(key, NDArray):
-        return key._data
+        return _bulk.materialize_one(key._data)
     if isinstance(key, tuple):
-        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return tuple(_bulk.materialize_one(k._data)
+                     if isinstance(k, NDArray) else k for k in key)
     return key
 
 
@@ -1312,7 +1360,10 @@ def load(fname):
 
 
 def waitall():
-    """Parity: mx.nd.waitall — barrier on all outstanding async work."""
+    """Parity: mx.nd.waitall — barrier on all outstanding async work
+    (flushes this thread's pending bulk segment first; unconditional so a
+    segment left pending after its scope/auto-bulk ended still runs)."""
+    _bulk.flush("read")
     (jax.device_put(0.0) + 0).block_until_ready()
 
 

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import profiler as _prof
 from ..base import _Registry
 from ..ndarray import NDArray
 from . import lr_scheduler  # noqa: F401
@@ -141,6 +142,73 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         return self.update(index, weight, grad, state)
+
+    # -- fused multi-tensor apply (Trainer fused_update=True) -------------
+    def supports_fused(self) -> bool:
+        """Dense rules whose eager `update` is the stock jitted wrapper can
+        run N parameters in ONE compiled call. Rules that override the
+        eager entry (SGLD's per-call host RNG) keep the per-param path."""
+        return (type(self).update is Optimizer.update
+                and type(self).update_multi_precision
+                is Optimizer.update_multi_precision)
+
+    def fused_update(self, indices, weights, grads, states, skip=None):
+        """Multi-tensor apply: every (index, weight, grad, state) in the
+        group updates inside ONE jit-compiled XLA computation (the
+        multi_tensor_apply / LazyTensor-fusion lineage), with weight and
+        optimizer-state buffers DONATED on accelerators so the update is
+        in-place at the XLA level. Per-param bookkeeping (update counts,
+        lr/wd multipliers, per-param t) matches the eager `update` exactly;
+        results are bit-identical to calling `update` per parameter.
+
+        Caller contract: dense grads only (route RowSparse through
+        `update`), and all weights share a dtype (the Trainer groups by
+        (rule, dtype)). Returns the list of new states; weights are
+        updated in place. Donation caveat: on TPU/GPU the previous weight
+        and state buffers are invalidated by the call — stale NDArray
+        references to pre-update weights must not be read afterwards."""
+        for i in indices:
+            self._update_count(i)
+        lws = [self._get_lr_wd(i) for i in indices]
+        ts = [self._index_update_count[i] for i in indices]
+        has_clip = self.clip_gradient is not None
+        has_skip = skip is not None
+        key = ("fused",
+               tuple((w.shape, str(w._data.dtype)) for w in weights),
+               bool(self.multi_precision), has_clip, has_skip)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            n = len(indices)
+
+            def fused_step(ws, gs, ss, lr_, wd_, t_, rs_, cl_, sk_):
+                new_ws, new_ss = [], []
+                for j in range(n):
+                    nw, ns = self.update_step(ws[j], gs[j], ss[j], lr_[j],
+                                              wd_[j], t_[j], rs_, cl_, sk_)
+                    new_ws.append(nw)
+                    new_ss.append(ns)
+                return new_ws, new_ss
+
+            # donate weight+state buffers where XLA implements donation
+            # (grads are NOT donated: grad_req='add' re-reads them)
+            donate = ((0, 2) if jax.default_backend() in ("tpu", "gpu")
+                      else ())
+            fn = jax.jit(fused_step, donate_argnums=donate)
+            self._jit_cache[key] = fn
+            _prof.counter("jit.cache_miss", "optimizer").increment()
+        else:
+            _prof.counter("jit.cache_hit", "optimizer").increment()
+        cl = jnp.float32(self.clip_gradient) if has_clip else None
+        new_ws, new_ss = fn(
+            [w._data for w in weights], [g._data for g in grads],
+            list(states),
+            [jnp.float32(lr) for lr, _ in lws],
+            [jnp.float32(wd) for _, wd in lws],
+            [jnp.int32(t) for t in ts],
+            jnp.float32(self.rescale_grad), cl, skip)
+        for w, nw in zip(weights, new_ws):
+            w._data = nw
+        return list(new_ss)
 
     def _update_sparse(self, index, weight, grad, state, skip=None):
         """RowSparse gradient. Optimizers with no lazy rule densify — the

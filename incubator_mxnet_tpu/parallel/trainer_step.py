@@ -15,6 +15,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import autograd
+from .. import profiler as _prof
 from ..gluon.parameter import _ParamTraceScope, _trace
 from ..gluon.trainer import Trainer
 from ..ndarray import NDArray
@@ -262,6 +263,9 @@ class FusedTrainStep:
         for j, i in enumerate(self.aux_idx):
             self.params[i]._data._data = new_aux[j]
         self._states = new_states
+        # fully-fused path: forward+backward+collective+update is ONE XLA
+        # dispatch per step (bench.py surfaces this in BENCH_*.json)
+        _prof.set_gauge("trainer.dispatches_per_step", 1)
         return NDArray(loss)
 
     def run_k(self, xs, ys):
@@ -314,4 +318,6 @@ class FusedTrainStep:
         for j, i in enumerate(self.aux_idx):
             self.params[i]._data._data = new_aux[j]
         self._states = new_states
+        # one dispatch drives k micro-steps
+        _prof.set_gauge("trainer.dispatches_per_step", round(1.0 / k, 4))
         return NDArray(losses)

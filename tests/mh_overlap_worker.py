@@ -61,7 +61,7 @@ def main():
         loss.backward()
         assert tr._sched.issued_log, "buckets must issue mid-backward"
         tr.step(len(X))                   # rescale by the GLOBAL batch
-        tr._sched.issued_log.clear()
+        # (flush() resets issued_log at the start of every step)
 
     for name, p in sorted(net.collect_params().items()):
         flat = " ".join(f"{v:.6f}" for v in p.data().asnumpy().ravel())

@@ -1,0 +1,450 @@
+"""Bulk-fused dispatch (ISSUE 2): multi-tensor optimizer apply parity and
+real engine.bulk deferred segments.
+
+Fused apply contract: Trainer groups params by (rule, dtype) and runs each
+group's updates in ONE jitted call — bit-identical to per-param update(),
+including multi_precision and AMP skip. engine.bulk contract: deferred
+segments flush on size/exit/read/backward/step with imperative semantics
+preserved, and steady-state segments hit the compile cache.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import bulk, engine, gluon, nd
+from incubator_mxnet_tpu import optimizer as opt
+from incubator_mxnet_tpu import profiler as prof
+
+
+def _ctr(name):
+    return prof.counters().get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor apply: bit-exact parity vs per-param update()
+# ---------------------------------------------------------------------------
+
+DENSE_RULES = [
+    ("sgd", dict(learning_rate=0.1)),
+    ("sgd", dict(learning_rate=0.1, momentum=0.9, wd=0.01)),
+    ("nag", dict(learning_rate=0.1, momentum=0.9)),
+    ("signum", dict(learning_rate=0.05, momentum=0.9, wd_lh=0.01)),
+    ("adam", dict(learning_rate=0.01, wd=0.01)),
+    ("adamw", dict(learning_rate=0.01, wd=0.1)),
+    ("adagrad", dict(learning_rate=0.1)),
+    ("adadelta", dict(rho=0.9)),
+    ("rmsprop", dict(learning_rate=0.01)),
+    ("rmsprop", dict(learning_rate=0.01, centered=True)),
+    ("ftrl", dict(learning_rate=0.1, lamda1=0.001)),
+    ("lamb", dict(learning_rate=0.01, wd=0.01)),
+    ("lars", dict(learning_rate=0.01, wd=0.001)),
+    ("adamax", dict(learning_rate=0.002)),
+    ("nadam", dict(learning_rate=0.001)),
+    ("ftml", dict(learning_rate=0.0025)),
+    ("dcasgd", dict(learning_rate=0.01, momentum=0.9)),
+]
+
+_SHAPES = [(3, 2), (5,), (2, 2, 2), (4, 3)]
+
+
+def _tensors(seed=0, dtype="float32"):
+    rng = np.random.RandomState(seed)
+    ws = [rng.randn(*s).astype(np.float32) for s in _SHAPES]
+    gsteps = [[rng.randn(*s).astype(np.float32) for s in _SHAPES]
+              for _ in range(3)]
+    if dtype != "float32":
+        ws = [nd.array(w).astype(dtype).asnumpy() for w in ws]
+    return ws, gsteps
+
+
+def _mk(name, kwargs, ws, dtype="float32", **extra):
+    o = opt.create(name, **dict(kwargs, **extra))
+    W = [nd.array(w).astype(dtype) for w in ws]
+    S = [o.create_state_multi_precision(i, W[i]._data)
+         for i in range(len(W))]
+    return o, W, S
+
+
+def _assert_same(Wa, Sa, Wb, Sb):
+    for a, b in zip(Wa, Wb):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    la = jax.tree_util.tree_leaves(Sa)
+    lb = jax.tree_util.tree_leaves(Sb)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("name,kwargs", DENSE_RULES,
+                         ids=[f"{n}-{i}" for i, (n, _) in
+                              enumerate(DENSE_RULES)])
+def test_fused_update_bit_exact(name, kwargs):
+    ws, gsteps = _tensors()
+    o_u, W_u, S_u = _mk(name, kwargs, ws)
+    o_f, W_f, S_f = _mk(name, kwargs, ws)
+    assert o_f.supports_fused()
+    idxs = list(range(len(ws)))
+    for gs in gsteps:
+        for i in idxs:
+            S_u[i] = o_u.update(i, W_u[i], nd.array(gs[i]), S_u[i])
+        S_f = o_f.fused_update(idxs, W_f, [nd.array(g) for g in gs], S_f)
+    _assert_same(W_u, S_u, W_f, S_f)
+    # per-param bookkeeping advanced identically
+    assert o_u._index_update_count == o_f._index_update_count
+    assert o_u.num_update == o_f.num_update
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam"])
+def test_fused_update_clip_rescale_parity(name):
+    kw = dict(learning_rate=0.1, rescale_grad=0.5, clip_gradient=0.4)
+    ws, gsteps = _tensors(seed=7)
+    o_u, W_u, S_u = _mk(name, kw, ws)
+    o_f, W_f, S_f = _mk(name, kw, ws)
+    idxs = list(range(len(ws)))
+    for gs in gsteps:
+        for i in idxs:
+            S_u[i] = o_u.update(i, W_u[i], nd.array(gs[i]), S_u[i])
+        S_f = o_f.fused_update(idxs, W_f, [nd.array(g) for g in gs], S_f)
+    _assert_same(W_u, S_u, W_f, S_f)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "lamb"])
+def test_fused_update_multi_precision_parity(name):
+    """bf16 weights + float32 master copies through the fused path."""
+    ws, gsteps = _tensors(seed=3, dtype="bfloat16")
+    kw = dict(learning_rate=0.01, multi_precision=True)
+    o_u, W_u, S_u = _mk(name, kw, ws, dtype="bfloat16")
+    o_f, W_f, S_f = _mk(name, kw, ws, dtype="bfloat16")
+    assert S_u[0][0].dtype == jnp.float32   # master weights exist
+    idxs = list(range(len(ws)))
+    for gs in gsteps:
+        gnds_u = [nd.array(g).astype("bfloat16") for g in gs]
+        gnds_f = [nd.array(g).astype("bfloat16") for g in gs]
+        for i in idxs:
+            S_u[i] = o_u.update(i, W_u[i], gnds_u[i], S_u[i])
+        S_f = o_f.fused_update(idxs, W_f, gnds_f, S_f)
+    _assert_same(W_u, S_u, W_f, S_f)
+
+
+@pytest.mark.parametrize("skip_val", [False, True])
+def test_fused_update_amp_skip_parity(skip_val):
+    """AMP found-inf `skip` select: both paths keep/skip identically; with
+    skip=True the weights and states are untouched."""
+    ws, gsteps = _tensors(seed=5)
+    skip = jnp.asarray(skip_val)
+    o_u, W_u, S_u = _mk("adam", dict(learning_rate=0.01), ws)
+    o_f, W_f, S_f = _mk("adam", dict(learning_rate=0.01), ws)
+    idxs = list(range(len(ws)))
+    for gs in gsteps:
+        for i in idxs:
+            S_u[i] = o_u.update(i, W_u[i], nd.array(gs[i]), S_u[i],
+                                skip=skip)
+        S_f = o_f.fused_update(idxs, W_f, [nd.array(g) for g in gs], S_f,
+                               skip=skip)
+    _assert_same(W_u, S_u, W_f, S_f)
+    if skip_val:
+        for w0, w in zip(ws, W_f):
+            np.testing.assert_array_equal(w.asnumpy(), w0)
+
+
+def test_sgld_does_not_support_fused():
+    # SGLD overrides the eager entry (host RNG per call) -> per-param path
+    assert not opt.create("sgld").supports_fused()
+    assert opt.create("sgd").supports_fused()
+
+
+def test_fused_group_compile_cached():
+    """Same (shapes, dtypes) group on later steps reuses the jitted fused
+    step (hit/miss counters from PR 1)."""
+    ws, gsteps = _tensors(seed=11)
+    o, W, S = _mk("sgd", dict(learning_rate=0.1), ws)
+    idxs = list(range(len(ws)))
+    miss0 = _ctr("optimizer/jit.cache_miss")
+    hit0 = _ctr("optimizer/jit.cache_hit")
+    for gs in gsteps:
+        S = o.fused_update(idxs, W, [nd.array(g) for g in gs], S)
+    assert _ctr("optimizer/jit.cache_miss") - miss0 == 1
+    assert _ctr("optimizer/jit.cache_hit") - hit0 == len(gsteps) - 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: grouping, dispatch counts, fallbacks
+# ---------------------------------------------------------------------------
+
+def _mlp(n_layers, width=4, seed=0):
+    net = gluon.nn.HybridSequential()
+    for _ in range(n_layers):
+        net.add(gluon.nn.Dense(width, in_units=width))
+    net.initialize(init=mx.init.Xavier())
+    rng = np.random.RandomState(seed)
+    for p in net.collect_params().values():
+        p.set_data(nd.array(rng.randn(*p.shape).astype(np.float32)))
+    return net
+
+
+def _backward(net, width=4, seed=1):
+    x = nd.array(np.random.RandomState(seed).randn(2, width)
+                 .astype(np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+
+
+def test_trainer_fused_matches_unfused():
+    net_a, net_b = _mlp(4, seed=2), _mlp(4, seed=2)
+    tr_a = gluon.Trainer(net_a.collect_params(), "adam",
+                         {"learning_rate": 0.01}, fused_update=False)
+    tr_b = gluon.Trainer(net_b.collect_params(), "adam",
+                         {"learning_rate": 0.01}, fused_update=True)
+    for step in range(3):
+        _backward(net_a, seed=step)
+        _backward(net_b, seed=step)
+        tr_a.step(2)
+        tr_b.step(2)
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_array_equal(pa.data().asnumpy(),
+                                      pb.data().asnumpy())
+
+
+def test_dispatches_per_step_50_params():
+    """Acceptance: a 50-param model goes from >=50 optimizer dispatches
+    per step to <= #(rule,dtype) groups (here 1) with fused_update."""
+    net = _mlp(25)   # 25 x (weight, bias) = 50 params
+    params = net.collect_params()
+    assert len([p for p in params.values() if p.grad_req != "null"]) == 50
+
+    tr_u = gluon.Trainer(params, "sgd", {"learning_rate": 0.0},
+                         fused_update=False)
+    _backward(net)
+    tr_u.step(1)
+    assert _ctr("mxtpu/trainer.dispatches_per_step") == 50
+    assert _ctr("mxtpu/optimizer.fused_groups") == 0
+
+    tr_f = gluon.Trainer(params, "sgd", {"learning_rate": 0.0},
+                         fused_update=True)
+    _backward(net)
+    tr_f.step(1)
+    assert _ctr("mxtpu/trainer.dispatches_per_step") == 1
+    assert _ctr("mxtpu/optimizer.fused_groups") == 1
+
+
+def test_trainer_groups_by_dtype():
+    """Mixed f32/bf16 params fuse into one group per dtype."""
+    net32, net16 = _mlp(2, seed=4), _mlp(2, seed=5)
+    net16.cast("bfloat16")
+    _backward(net32, seed=0)
+    x16 = nd.array(np.random.RandomState(0).randn(2, 4)
+                   .astype(np.float32)).astype("bfloat16")
+    with mx.autograd.record():
+        loss = (net16(x16) ** 2).sum()
+    loss.backward()
+    params = (list(net32.collect_params().values())
+              + list(net16.collect_params().values()))
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.01},
+                       fused_update=True)
+    tr.step(1)
+    assert _ctr("mxtpu/optimizer.fused_groups") == 2
+    assert _ctr("mxtpu/trainer.dispatches_per_step") == 2
+
+
+def test_trainer_sgld_falls_back_per_param():
+    net = _mlp(3)
+    tr = gluon.Trainer(net.collect_params(), "sgld",
+                       {"learning_rate": 0.01}, fused_update=True)
+    _backward(net)
+    tr.step(1)   # supports_fused() False -> per-param path
+    assert _ctr("mxtpu/trainer.dispatches_per_step") == 6
+    assert _ctr("mxtpu/optimizer.fused_groups") == 0
+
+
+def test_trainer_sparse_grad_falls_back_per_param():
+    """RowSparse grads keep the lazy-row per-param path next to a fused
+    dense group."""
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    dense = gluon.nn.Dense(2, in_units=4)
+    emb.initialize()
+    dense.initialize()
+    x = nd.array(np.array([[1, 2], [3, 4]], np.int32))
+    with mx.autograd.record():
+        loss = (dense(emb(x).reshape((2, -1))[:, :4]) ** 2).sum()
+    loss.backward()
+    from incubator_mxnet_tpu.ndarray import sparse as _sparse
+    params = (list(emb.collect_params().values())
+              + list(dense.collect_params().values()))
+    assert isinstance(params[0].grad(), _sparse.RowSparseNDArray)
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                       fused_update=True)
+    tr.step(1)
+    # 1 sparse per-param dispatch + 1 fused dense group
+    assert _ctr("mxtpu/trainer.dispatches_per_step") == 2
+    assert _ctr("mxtpu/optimizer.fused_groups") == 1
+
+
+def test_fused_update_env_override(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "0")
+    net = _mlp(2)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert tr._fused_update is False
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1")
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert tr._fused_update is True
+
+
+# ---------------------------------------------------------------------------
+# engine.bulk deferred segments
+# ---------------------------------------------------------------------------
+
+def test_bulk_defers_and_is_bit_exact():
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    eager = ((x + 1.5) * 2.0 - x).asnumpy()
+    with engine.bulk(10):
+        r = (x + 1.5) * 2.0 - x
+        assert bulk.pending_ops() == 3
+        assert bulk.is_deferred(r._data)
+    assert bulk.pending_ops() == 0       # scope exit flushed
+    assert not bulk.is_deferred(r._data)
+    np.testing.assert_array_equal(r.asnumpy(), eager)
+
+
+def test_bulk_flush_on_read_midscope():
+    x = nd.array(np.arange(6.0, dtype=np.float32))
+    with engine.bulk(10):
+        y = x * 3.0
+        assert bulk.pending_ops() == 1
+        reads0 = _ctr("mxtpu/bulk.flush.read")
+        got = y.asnumpy()                # read forces the flush
+        assert bulk.pending_ops() == 0
+        assert _ctr("mxtpu/bulk.flush.read") - reads0 == 1
+        np.testing.assert_array_equal(got, np.arange(6.0) * 3)
+        z = y + 1.0                      # new segment after the flush
+        assert bulk.pending_ops() == 1
+    np.testing.assert_array_equal(z.asnumpy(), np.arange(6.0) * 3 + 1)
+
+
+def test_bulk_flush_on_size():
+    x = nd.array(np.ones(3, np.float32))
+    size0 = _ctr("mxtpu/bulk.flush.size")
+    with engine.bulk(2):
+        a = x + 1.0
+        b = a + 1.0                      # hits size=2 -> auto flush
+        assert bulk.pending_ops() == 0
+        assert _ctr("mxtpu/bulk.flush.size") - size0 == 1
+        c = b + 1.0
+        assert bulk.pending_ops() == 1
+    np.testing.assert_array_equal(c.asnumpy(), np.full(3, 4.0))
+
+
+def test_bulk_flush_on_backward():
+    x = nd.array(np.ones((2, 2), np.float32))
+    w = nd.array(np.random.RandomState(1).randn(2, 2).astype(np.float32))
+    w.attach_grad()
+    with engine.bulk(10):
+        t = x + 2.0                      # deferred, pending
+        assert bulk.pending_ops() == 1
+        bwd0 = _ctr("mxtpu/bulk.flush.backward")
+        with mx.autograd.record():       # recording ops run eagerly
+            loss = (w * w).sum()
+        loss.backward()
+        assert bulk.pending_ops() == 0
+        assert _ctr("mxtpu/bulk.flush.backward") - bwd0 == 1
+        np.testing.assert_allclose(w.grad.asnumpy(), 2 * w.asnumpy(),
+                                   rtol=1e-6)
+    np.testing.assert_array_equal(t.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_bulk_segment_compile_cache_reuse():
+    """Acceptance: identical segments compile once, then cache-hit."""
+    x = nd.array(np.random.RandomState(2).randn(7, 11).astype(np.float32))
+    miss0 = _ctr("bulk/jit.cache_miss")
+    hit0 = _ctr("bulk/jit.cache_hit")
+    for _ in range(4):
+        with engine.bulk(10):
+            r = (x + 0.25) * 1.5
+        r.wait_to_read()
+    assert _ctr("bulk/jit.cache_miss") - miss0 == 1
+    assert _ctr("bulk/jit.cache_hit") - hit0 == 3
+    np.testing.assert_allclose(r.asnumpy(), (x.asnumpy() + 0.25) * 1.5,
+                               rtol=1e-6)
+
+
+def test_bulk_cache_distinguishes_captured_scalars():
+    """x+2 and x+3 recreate the same lambda code; captured constants are
+    part of the signature so the cache can never serve the wrong one."""
+    x = nd.array(np.ones(5, np.float32))
+    with engine.bulk(10):
+        a = x + 2.0
+    with engine.bulk(10):
+        b = x + 3.0
+    np.testing.assert_array_equal(a.asnumpy(), np.full(5, 3.0))
+    np.testing.assert_array_equal(b.asnumpy(), np.full(5, 4.0))
+
+
+def test_bulk_waitall_flushes():
+    x = nd.array(np.ones(4, np.float32))
+    with engine.bulk(10):
+        y = x * 2.0
+        assert bulk.pending_ops() == 1
+        nd.waitall()
+        assert bulk.pending_ops() == 0
+    np.testing.assert_array_equal(y.asnumpy(), np.full(4, 2.0))
+
+
+def test_bulk_trainer_step_flushes():
+    net = _mlp(2)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.array(np.ones(3, np.float32))
+    step0 = _ctr("mxtpu/bulk.flush.step")
+    with engine.bulk(10):
+        y = x + 1.0                      # pending segment
+        _backward(net)                   # flushes via backward first
+        z = y * 2.0                      # re-defer after backward flush
+        assert bulk.pending_ops() >= 1
+        tr.step(1)
+        assert bulk.pending_ops() == 0
+        assert _ctr("mxtpu/bulk.flush.step") - step0 == 1
+    np.testing.assert_array_equal(z.asnumpy(), np.full(3, 4.0))
+
+
+def test_auto_bulk_mode():
+    prev = engine.set_bulk_size(8)
+    try:
+        assert engine.bulk_size() == 8
+        x = nd.array(np.arange(4.0, dtype=np.float32))
+        y = x + 4.0                      # defers without an explicit scope
+        assert bulk.pending_ops() == 1
+        np.testing.assert_array_equal(y.asnumpy(), np.arange(4.0) + 4)
+    finally:
+        assert engine.set_bulk_size(prev) == 8
+    assert engine.bulk_size() == prev
+    z = x + 5.0                          # disabled again: eager
+    assert not bulk.is_deferred(z._data)
+
+
+def test_bulk_nested_scopes():
+    x = nd.array(np.ones(2, np.float32))
+    with engine.bulk(10):
+        a = x + 1.0
+        with engine.bulk(5):
+            b = a + 1.0
+            assert bulk.pending_ops() == 2
+        # inner exit flushed everything
+        assert bulk.pending_ops() == 0
+        c = b + 1.0
+        assert bulk.pending_ops() == 1
+    np.testing.assert_array_equal(c.asnumpy(), np.full(2, 4.0))
+
+
+def test_bulk_recording_ops_stay_eager():
+    """Ops on the autograd tape need concrete values; inside record() the
+    dispatch funnel must not defer."""
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with engine.bulk(10):
+        with mx.autograd.record():
+            y = (x * 3.0).sum()
+            assert not bulk.is_deferred(y._data)
+        y.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), np.full((2, 2), 3.0))

@@ -75,11 +75,14 @@ def test_overlap_issues_during_backward_and_matches_batched_step(
     for step in range(3):
         _backward(net_a, seed=step)
         _backward(net_b, seed=step)
-        # hooks issued every bucket mid-backward, before step()
+        # hooks issued every bucket mid-backward: read BEFORE step() —
+        # flush() resets the log at the start of every step
         assert len(tr_b._sched.issued_log) == len(tr_b._sched._buckets)
         tr_a.step(2)
         tr_b.step(2)
-        tr_b._sched.issued_log.clear()
+        # all buckets issued mid-backward -> flush had no stragglers, and
+        # the log no longer accumulates across steps
+        assert tr_b._sched.issued_log == []
 
     for pa, pb in zip(net_a.collect_params().values(),
                       net_b.collect_params().values()):
@@ -102,11 +105,13 @@ def test_priority_overtaking_under_zero_credit(monkeypatch):
     # zero credit: first bucket issues (heap drained before any inflight),
     # everything after queues -- so mid-backward issuance is at most 1
     assert len(sched.issued_log) <= 1
+    mid_backward = list(sched.issued_log)
     tr.step(2)
-    # flush ordering: strictly ascending bucket priority among the queued
-    queued = sched.issued_log[1:] if sched.issued_log[:1] else \
-        sched.issued_log
-    assert queued == sorted(queued), sched.issued_log
+    # flush() resets the log, then drains the queued buckets in strictly
+    # ascending bucket priority; mid-backward buckets are not re-issued
+    queued = sched.issued_log
+    assert queued == sorted(queued), queued
+    assert not set(mid_backward) & set(queued)
 
 
 def test_bucketing_groups_consecutive_params(monkeypatch):
@@ -119,6 +124,7 @@ def test_bucketing_groups_consecutive_params(monkeypatch):
     _backward(net)
     assert tr._sched.issued_log == [0]   # issued once, mid-backward
     tr.step(2)
+    assert tr._sched.issued_log == []    # flush reset; no stragglers
 
 
 def test_overlap_noop_on_single_worker():
