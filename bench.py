@@ -116,6 +116,27 @@ def _bench_profile_start():
     return path
 
 
+def _bench_diag_start():
+    """Arm the always-on diagnostics layer for the bench run. The memory
+    ledger is on unconditionally (its peaks land in BENCH_*.json so
+    memory regressions show up in the perf trajectory); BENCH_DIAG=1
+    additionally runs the metrics sampler (BENCH_DIAG_INTERVAL_MS,
+    default 100) and the flight recorder, whose outputs are validated by
+    tools/trace_check at the end of the run."""
+    from incubator_mxnet_tpu import diagnostics as diag
+    diag.enable_memory()
+    if os.environ.get("BENCH_DIAG", "0") != "1":
+        return None
+    diag_dir = os.environ.get("MXTPU_DIAG_DIR", "/tmp/mxtpu_bench_diag")
+    os.makedirs(diag_dir, exist_ok=True)
+    diag.enable_flight_recorder(dump_dir=diag_dir)
+    jsonl = os.path.join(diag_dir, "metrics.jsonl")  # sampler truncates it
+    diag.start_sampler(
+        interval_ms=int(os.environ.get("BENCH_DIAG_INTERVAL_MS", "100")),
+        jsonl_path=jsonl, prom_path=os.path.join(diag_dir, "metrics.prom"))
+    return diag_dir
+
+
 def _profiled_compile_warmup(run_compile, run_warmup):
     """Shared compile+warmup phase instrumentation for both bench paths:
     arms the profiler, runs the compile under a bench.compile scope and
@@ -137,11 +158,23 @@ def _profiled_compile_warmup(run_compile, run_warmup):
     return trace_path, compile_s, warmup_s
 
 
+def _load_trace_check():
+    import importlib.util
+    tc_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "trace_check.py")
+    spec = importlib.util.spec_from_file_location("trace_check", tc_path)
+    tc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tc)
+    return tc
+
+
 def _finish_profile(result, trace_path, **phase_s):
     """Publish per-phase wall times as profiler gauges, attach them to the
-    result JSON (-> BENCH_*.json), then dump the Chrome trace and schema-
-    check it with tools/trace_check — a malformed trace fails the bench
-    run loudly instead of shipping garbage."""
+    result JSON (-> BENCH_*.json), then dump the Chrome trace (and any
+    diagnostics artifacts) and schema-check everything with
+    tools/trace_check — malformed telemetry fails the bench run loudly
+    instead of shipping garbage."""
+    from incubator_mxnet_tpu import diagnostics as diag
     from incubator_mxnet_tpu import profiler as prof
     phases = {k: round(float(v), 4) for k, v in phase_s.items()}
     for k, v in phases.items():
@@ -153,22 +186,42 @@ def _finish_profile(result, trace_path, **phase_s):
     # groups with fused_update). Visible in BENCH_*.json without a TPU.
     result["extra"]["dispatches_per_step"] = prof.counters().get(
         "mxtpu/trainer.dispatches_per_step")
-    if trace_path is None:
-        return
-    prof.stop()
-    prof.dump(filename=trace_path)
-    import importlib.util
-    tc_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "tools", "trace_check.py")
-    spec = importlib.util.spec_from_file_location("trace_check", tc_path)
-    tc = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(tc)
-    errors = tc.check_trace(trace_path)
+    # memory-regression canary: the allocation ledger's peaks + the final
+    # counters snapshot ride along in BENCH_*.json so drift shows up in
+    # the perf trajectory next to step times
+    mem = diag.memory_summary(include_reconcile=False)
+    result["extra"]["memory"] = {
+        "peak_bytes": mem["peak_bytes"],
+        "current_bytes": mem["current_bytes"],
+        "live_arrays": mem["live_arrays"],
+        "by_context": mem["by_context"],
+    }
+    result["extra"]["counters"] = prof.counters()
+    tc = _load_trace_check()
+    errors = []
+    if trace_path is not None:
+        prof.stop()
+        prof.dump(filename=trace_path)
+        errors += tc.check_trace(trace_path)
+        result["extra"]["trace_file"] = trace_path
+    if diag.flight_enabled() or diag.sampler_running():
+        diag.stop_sampler()
+        flight_path = diag.dump_flight(reason="bench_end")
+        if flight_path:
+            errors += tc.check_flight(flight_path)
+            result["extra"]["flight_file"] = flight_path
+        diag_dir = os.environ.get("MXTPU_DIAG_DIR", "/tmp/mxtpu_bench_diag")
+        for name, checker in (("metrics.jsonl", tc.check_metrics_jsonl),
+                              ("metrics.prom", tc.check_prom)):
+            p = os.path.join(diag_dir, name)
+            if os.path.exists(p):
+                errors += checker(p)
+                result["extra"]["diag_" + name.split(".")[1]] = p
     if errors:
-        raise RuntimeError("bench trace failed schema check: "
+        raise RuntimeError("bench telemetry failed schema check: "
                            + "; ".join(errors[:5]))
-    result["extra"]["trace_file"] = trace_path
-    _log(f"trace OK: {trace_path} ({len(phases)} phases)")
+    if trace_path is not None:
+        _log(f"trace OK: {trace_path} ({len(phases)} phases)")
 
 
 def acquire_backend(attempts=6, first_delay=3.0,
@@ -560,6 +613,10 @@ def main():
         _pallas._KERNELS_OK = False
         os.environ["MXTPU_NO_PALLAS"] = "1"
         _log(f"pallas self-test timed out ({e}); using the XLA path")
+    # before model build so parameter allocations land in the ledger
+    diag_dir = _bench_diag_start()
+    if diag_dir:
+        _log(f"diagnostics armed (sampler + flight recorder) -> {diag_dir}")
     np.random.seed(0)
     mx.random.seed(0)
 

@@ -52,6 +52,7 @@ from . import image
 from . import amp
 from . import runtime
 from . import engine
+from . import diagnostics
 from . import test_utils
 from . import utils
 
@@ -65,3 +66,7 @@ __version__ = "0.1.0"
 
 # Short import alias, torch-style: `import mxtpu as mx`.
 _sys.modules.setdefault("mxtpu", _sys.modules[__name__])
+
+# MXTPU_DIAG=1: arm the always-on observability layer (memory ledger,
+# flight recorder, optional sampler — see docs/diagnostics.md) at import.
+diagnostics.enable_from_env()

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from . import profiler as _prof
+from .diagnostics import flight as _flight
 
 __all__ = ["DeferredArray", "defer", "flush", "materialize", "is_deferred",
            "push_scope", "pop_scope", "set_auto_bulk", "auto_bulk_size",
@@ -428,6 +429,9 @@ def _flush_segment(seg, reason):
     if _prof._ACTIVE:
         _prof._instant("bulk.flush(%s)" % reason, "engine",
                        args={"ops": n, "reason": reason})
+    if _flight._REC is not None:
+        _flight.record("engine", "bulk.flush",
+                       {"ops": n, "reason": reason})
 
 
 def flush(reason="read"):

@@ -1,0 +1,246 @@
+"""Metrics export: sampler thread + Prometheus / newline-JSON backends.
+
+The always-live counters registry (profiler.counters) plus the memory
+ledger are the framework's time-series surface; this module makes them
+scrapeable:
+
+* :func:`sample` — one consistent snapshot: wall timestamp, every
+  registered counter/gauge (with its kind), and the memory ledger
+  headline numbers.
+* :func:`prometheus_text` — the snapshot in Prometheus text exposition
+  format (`# TYPE` lines from counter kinds, `_bytes` gauges labeled by
+  context/block), servable from a file (textfile collector) or the
+  built-in HTTP endpoint.
+* :class:`MetricsSampler` — a daemon thread that snapshots every
+  `interval_ms`, appends newline-JSON to `jsonl_path` and atomically
+  rewrites `prom_path`. Counters are monotonic across samples by the
+  registry contract, which `tools/trace_check.py` validates.
+* :func:`start_http` — stdlib HTTP server exposing `/metrics`
+  (Prometheus), `/json` (latest sample) and `/memory` (full
+  memory_summary), for pull-based scraping during live runs.
+
+The reference stack's counterpart is MXBoard/monitoring riding on
+mx.profiler counters; the pull/push split follows Prometheus practice.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from ..profiler.counters import registry_snapshot as _registry_snapshot
+from . import memory as _memory
+
+__all__ = ["sample", "prometheus_text", "MetricsSampler", "start_sampler",
+           "stop_sampler", "sampler_running", "start_http", "stop_http"]
+
+_SAMPLER = None
+_HTTP = None
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _prom_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def sample() -> dict:
+    """One snapshot of everything scrapeable: counters (+kinds) and the
+    memory ledger headline."""
+    snap = _registry_snapshot()
+    mem = _memory.memory_summary(include_reconcile=False) \
+        if _memory.memory_enabled() else None
+    out = {
+        "ts": time.time(),
+        "counters": {k: v for k, (v, _) in snap.items()},
+        "kinds": {k: kind for k, (_, kind) in snap.items()},
+    }
+    if mem is not None:
+        out["memory"] = {"current_bytes": mem["current_bytes"],
+                         "peak_bytes": mem["peak_bytes"],
+                         "live_arrays": mem["live_arrays"],
+                         "by_context": mem["by_context"]}
+    return out
+
+
+def prometheus_text(snapshot: dict | None = None) -> str:
+    """Render a snapshot (default: a fresh one) as Prometheus text
+    exposition format."""
+    s = snapshot or sample()
+    lines = []
+    for name in sorted(s["counters"]):
+        v = s["counters"][name]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue               # non-numeric gauges are not scrapeable
+        pn = _prom_name(name)
+        kind = s["kinds"].get(name, "gauge")
+        lines.append(f"# TYPE {pn} "
+                     f"{'counter' if kind == 'counter' else 'gauge'}")
+        # shortest round-trip repr: %g's 6 significant digits would
+        # flatten large byte counters into identical consecutive scrapes
+        lines.append(f"{pn} {float(v)!r}")
+    mem = s.get("memory")
+    if mem:
+        by_ctx = sorted(mem.get("by_context", {}).items())
+        # one contiguous sample group per metric family (exposition-format
+        # rule; strict parsers reject a reopened family)
+        lines.append("# TYPE mxtpu_memory_current_bytes gauge")
+        for ctx, e in by_ctx:
+            lines.append(f'mxtpu_memory_current_bytes'
+                         f'{{context="{_prom_label(ctx)}"}} '
+                         f"{float(e['current_bytes'])!r}")
+        lines.append("# TYPE mxtpu_memory_peak_bytes gauge")
+        for ctx, e in by_ctx:
+            lines.append(f'mxtpu_memory_peak_bytes'
+                         f'{{context="{_prom_label(ctx)}"}} '
+                         f"{float(e['peak_bytes'])!r}")
+        lines.append("# TYPE mxtpu_memory_live_arrays gauge")
+        lines.append(f"mxtpu_memory_live_arrays "
+                     f"{float(mem['live_arrays'])!r}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsSampler(threading.Thread):
+    """Daemon sampling loop. `samples` keeps the last `keep` snapshots in
+    memory for tests/inspection; files are optional."""
+
+    def __init__(self, interval_ms: int = 1000, jsonl_path: str | None = None,
+                 prom_path: str | None = None, keep: int = 512,
+                 truncate: bool = True):
+        super().__init__(name="mxtpu-metrics-sampler", daemon=True)
+        self.interval_s = max(0.001, interval_ms / 1000.0)
+        self.jsonl_path = jsonl_path
+        if truncate and jsonl_path and os.path.exists(jsonl_path):
+            # a fresh sampler means a fresh series: counters restart at 0
+            # in a new process, and appending across runs would make the
+            # file fail the monotonic-counter validation it must satisfy
+            os.remove(jsonl_path)
+        self.prom_path = prom_path
+        import collections
+        self.samples = collections.deque(maxlen=keep)
+        self._stop_ev = threading.Event()
+        self.ticks = 0
+
+    def tick(self):
+        s = sample()
+        self.samples.append(s)
+        self.ticks += 1
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(s) + "\n")
+        if self.prom_path:
+            tmp = self.prom_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(prometheus_text(s))
+            os.replace(tmp, self.prom_path)
+
+    def run(self):
+        while not self._stop_ev.is_set():
+            try:
+                self.tick()
+            except Exception:
+                pass               # sampling must never kill the host run
+            self._stop_ev.wait(self.interval_s)
+
+    def stop(self, final_tick: bool = True):
+        self._stop_ev.set()
+        self.join(timeout=10)
+        if final_tick:
+            try:
+                self.tick()        # always leave a closing sample on disk
+            except Exception:
+                pass
+
+
+def start_sampler(interval_ms: int = 1000, jsonl_path: str | None = None,
+                  prom_path: str | None = None, keep: int = 512,
+                  truncate: bool = True) -> MetricsSampler:
+    """Start (or restart) the module-level sampler thread. `truncate`
+    (default) starts a fresh jsonl series; pass False to append to an
+    existing same-process series."""
+    global _SAMPLER
+    if _SAMPLER is not None:
+        _SAMPLER.stop(final_tick=False)
+    _SAMPLER = MetricsSampler(interval_ms, jsonl_path, prom_path, keep,
+                              truncate)
+    _SAMPLER.start()
+    return _SAMPLER
+
+
+def stop_sampler():
+    global _SAMPLER
+    if _SAMPLER is not None:
+        _SAMPLER.stop()
+        _SAMPLER = None
+
+
+def sampler_running() -> bool:
+    return _SAMPLER is not None and _SAMPLER.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (pull-based scraping)
+# ---------------------------------------------------------------------------
+
+def start_http(port: int = 0, host: str = "127.0.0.1"):
+    """Serve /metrics (Prometheus), /json (latest sample), /memory
+    (memory_summary). Returns (server, bound_port); port 0 picks a free
+    one. The server runs in a daemon thread."""
+    global _HTTP
+    stop_http()        # a forgotten prior server must not leak its port
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                if self.path.startswith("/metrics"):
+                    body = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/json"):
+                    body = json.dumps(sample()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/memory"):
+                    body = json.dumps(_memory.memory_summary()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception:
+                try:
+                    self.send_response(500)
+                    self.end_headers()
+                except Exception:
+                    pass
+
+        def log_message(self, *a):   # stay quiet on stderr
+            pass
+
+    server = ThreadingHTTPServer((host, int(port)), _Handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="mxtpu-metrics-http", daemon=True)
+    t.start()
+    _HTTP = server
+    return server, server.server_address[1]
+
+
+def stop_http():
+    global _HTTP
+    if _HTTP is not None:
+        _HTTP.shutdown()
+        _HTTP.server_close()
+        _HTTP = None

@@ -1,0 +1,288 @@
+"""Black-box flight recorder: a bounded ring of recent runtime events.
+
+The Dapper/black-box pattern: always-on, cheap, bounded recording of what
+the framework just did — op dispatches, bulk-segment flushes, collective
+launches, jit compile spans, trainer steps — so that when a training job
+dies, the dump answers "what happened in the seconds before the crash"
+without anyone having had a trace session open. The reference analogue is
+MXNet's engine audit logging + the process-state dumps its launcher
+collects on failure.
+
+Design:
+
+* **ring** — `collections.deque(maxlen=capacity)`; append is O(1) and
+  GIL-atomic, so hot-path recording takes no lock (the lock is only held
+  to snapshot at dump time).
+* **hooks** — subsystems check one module global (`_REC is not None`)
+  before calling :func:`record`; the ndarray funnel gets a dedicated
+  `_flight_hook` global installed only while the recorder runs, same
+  zero-overhead-off discipline as the profiler.
+* **dump** — JSON with a versioned schema (``mxtpu.flight/1``): env +
+  config snapshot captured at enable time, a consistent counters-registry
+  snapshot, the (ts-sorted) events, and the exception when dumped from
+  the crash path. `tools/trace_check.py` validates it; `tools/mxdiag.py`
+  pretty-prints it.
+* **crash path** — `enable_flight_recorder(dump_on_crash=True)` chains a
+  `sys.excepthook` wrapper (and a SIGTERM handler when installable) that
+  writes ONE dump per process — repeated invocations are idempotent and
+  return the same path, so a cascade of handlers can't shred the file.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from ..profiler.counters import counters as _counters_snapshot
+from ..profiler.counters import counter_kinds as _counter_kinds
+
+
+def _snapshot_registry(best_effort: bool):
+    """Counters + kinds. `best_effort` is the signal-handler path: the
+    interrupted main thread may HOLD the registry lock (Counter.increment
+    takes it on hot paths), so a blocking acquire would deadlock the
+    process inside its own SIGTERM handler. Read lock-free instead —
+    worker threads may mutate the dict mid-iteration, so retry on the
+    RuntimeError and settle for an empty snapshot rather than a hang."""
+    if not best_effort:
+        return _counters_snapshot(), _counter_kinds()
+    from ..profiler.counters import _registry
+    for _ in range(3):
+        try:
+            items = list(_registry.items())
+            return ({k: c.value for k, c in items},
+                    {k: c.kind for k, c in items})
+        except RuntimeError:
+            continue
+    return {}, {}
+
+__all__ = ["FlightRecorder", "enable_flight_recorder",
+           "disable_flight_recorder", "flight_enabled", "record",
+           "dump", "crash_dump", "last_dump_path", "SCHEMA"]
+
+SCHEMA = "mxtpu.flight/1"
+
+# module-global: None = recorder off (THE fast-path predicate)
+_REC = None
+
+_prev_excepthook = None
+_prev_sigterm = None
+
+
+def _env_snapshot() -> dict:
+    """Config-relevant environment at enable time (crash dumps must carry
+    enough to reproduce the run's knobs)."""
+    keep = {k: v for k, v in os.environ.items()
+            if k.startswith(("MXTPU_", "BENCH_", "JAX_", "XLA_"))}
+    snap = {"argv": list(sys.argv), "pid": os.getpid(),
+            "python": sys.version.split()[0], "env": keep}
+    try:
+        import jax
+        snap["jax_backend"] = jax.default_backend()
+        snap["jax_device_count"] = jax.device_count()
+    except Exception:
+        pass
+    try:
+        from .. import __version__
+        snap["mxtpu_version"] = __version__
+    except Exception:
+        pass
+    return snap
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 4096, dump_dir: str | None = None):
+        self.capacity = int(capacity)
+        self.events = collections.deque(maxlen=self.capacity)
+        self.dump_dir = dump_dir or os.environ.get("MXTPU_DIAG_DIR", "/tmp")
+        self.config = {"capacity": self.capacity, "dump_dir": self.dump_dir}
+        self.env = _env_snapshot()
+        self.started_at = time.time()
+        self.dump_count = 0
+        self._lock = threading.Lock()
+        self._once = {}            # once-key -> path (crash idempotence)
+        self._last_path = None
+
+    # -- recording (hot path: no lock, deque append is atomic) ------------
+    def append(self, kind: str, name: str, args=None):
+        ev = {"ts": time.time(), "kind": kind, "name": name}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def op_event(self, name):
+        """Minimal per-dispatch event (installed as ndarray._flight_hook)."""
+        self.events.append({"ts": time.time(), "kind": "op",
+                            "name": name or "op"})
+
+    # -- dumping -----------------------------------------------------------
+    def default_path(self) -> str:
+        return os.path.join(self.dump_dir,
+                            f"mxtpu_flight_{os.getpid()}.json")
+
+    def dump(self, reason: str = "manual", path: str | None = None,
+             exc=None, once_key: str | None = None,
+             best_effort: bool = False) -> str:
+        """Write the ring to disk. With `once_key` (the crash path), the
+        first call wins and later calls return the same path untouched.
+        `best_effort` (signal-handler context) never blocks on a lock the
+        interrupted thread might hold: it bounds the lock wait and falls
+        back to lock-free snapshots — a slightly torn dump beats a
+        process that hangs inside its own SIGTERM handler."""
+        locked = self._lock.acquire(timeout=2.0) if best_effort \
+            else self._lock.acquire()
+        try:
+            if once_key is not None and once_key in self._once:
+                return self._once[once_key]
+            path = path or self.default_path()
+            counters, kinds = _snapshot_registry(best_effort)
+            events = sorted(self.events, key=lambda e: e["ts"])
+            payload = {
+                "schema": SCHEMA,
+                "dumped_at": time.time(),
+                "started_at": self.started_at,
+                "reason": reason,
+                "env": self.env,
+                "config": self.config,
+                "counters": counters,
+                "counter_kinds": kinds,
+                "n_events": len(events),
+                "capacity": self.capacity,
+                "events": events,
+            }
+            if exc is not None:
+                tp, val = exc[0], exc[1]
+                payload["exception"] = {
+                    "type": getattr(tp, "__name__", str(tp)),
+                    "message": str(val)[:2000],
+                }
+                if len(exc) > 2 and exc[2] is not None:
+                    import traceback
+                    payload["exception"]["traceback"] = \
+                        traceback.format_tb(exc[2])[-20:]
+            # unique tmp name: an unlocked best-effort dump must not race
+            # another dumper over the same staging file
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)     # crash dumps must never be half-files
+            self.dump_count += 1
+            self._last_path = path
+            if once_key is not None:
+                self._once[once_key] = path
+            return path
+        finally:
+            if locked:
+                self._lock.release()
+
+
+# ---------------------------------------------------------------------------
+# module surface
+# ---------------------------------------------------------------------------
+
+def record(kind: str, name: str, args=None):
+    """Append one event if the recorder is on (cheap no-op otherwise).
+    Subsystems on genuinely hot paths should guard with
+    ``if flight._REC is not None:`` to skip even this call."""
+    rec = _REC
+    if rec is not None:
+        rec.append(kind, name, args)
+
+
+def flight_enabled() -> bool:
+    return _REC is not None
+
+
+def last_dump_path():
+    rec = _REC
+    return rec._last_path if rec is not None else None
+
+
+def _crash_excepthook(tp, val, tb):
+    try:
+        crash_dump((tp, val, tb), reason=f"uncaught:{tp.__name__}")
+    except Exception:
+        pass                       # the crash path must never mask the crash
+    prev = _prev_excepthook or sys.__excepthook__
+    prev(tp, val, tb)
+
+
+def _sigterm_handler(signum, frame):
+    try:
+        crash_dump(None, reason="SIGTERM", best_effort=True)
+    except Exception:
+        pass
+    prev = _prev_sigterm
+    if prev is signal.SIG_IGN:
+        return                     # the process chose to survive SIGTERM;
+                                   # dumping must not change that
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def enable_flight_recorder(capacity: int = 4096, dump_on_crash: bool = True,
+                           dump_dir: str | None = None,
+                           record_ops: bool = True) -> FlightRecorder:
+    """Arm the recorder. Installs the ndarray dispatch hook (unless
+    `record_ops=False`) and, with `dump_on_crash`, the excepthook +
+    SIGTERM chain. Idempotent-ish: re-enabling replaces the ring."""
+    global _REC, _prev_excepthook, _prev_sigterm
+    rec = FlightRecorder(capacity=capacity, dump_dir=dump_dir)
+    rec.config["dump_on_crash"] = bool(dump_on_crash)
+    rec.config["record_ops"] = bool(record_ops)
+    _REC = rec
+    if record_ops:
+        from .. import ndarray as _nd
+        _nd._flight_hook = rec.op_event
+    rec.append("lifecycle", "flight_recorder.enable")
+    if dump_on_crash:
+        if sys.excepthook is not _crash_excepthook:
+            _prev_excepthook = sys.excepthook
+            sys.excepthook = _crash_excepthook
+        try:
+            if threading.current_thread() is threading.main_thread():
+                prev = signal.signal(signal.SIGTERM, _sigterm_handler)
+                if prev is not _sigterm_handler:
+                    _prev_sigterm = prev
+        except (ValueError, OSError):
+            pass                   # non-main thread / restricted env
+    return rec
+
+
+def disable_flight_recorder():
+    """Stop recording and unhook (the excepthook chain stays installed but
+    becomes a pass-through once `_REC` is None)."""
+    global _REC
+    _REC = None
+    try:
+        from .. import ndarray as _nd
+        _nd._flight_hook = None
+    except Exception:
+        pass
+
+
+def dump(reason: str = "manual", path: str | None = None) -> str | None:
+    """Manually flush the ring to disk; returns the path (None if off)."""
+    rec = _REC
+    if rec is None:
+        return None
+    return rec.dump(reason=reason, path=path)
+
+
+def crash_dump(exc=None, reason: str = "crash",
+               best_effort: bool = False) -> str | None:
+    """The crash-path dump: one per process, idempotent — repeated calls
+    (excepthook then signal handler then atexit cascades) return the same
+    already-written path."""
+    rec = _REC
+    if rec is None:
+        return None
+    return rec.dump(reason=reason, exc=exc, once_key="crash",
+                    best_effort=best_effort)

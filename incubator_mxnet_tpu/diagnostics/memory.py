@@ -1,0 +1,322 @@
+"""Device-memory accounting: a per-Context allocation ledger.
+
+The reference MXNet's GPU memory profiler attributes allocations to the
+operator/layer that requested them (src/storage/ + the gpu_memory_profiler
+env knobs). Rebuilt TPU-native: NDArray creation funnels through one hook
+(`ndarray._mem_hook`, installed only while the ledger is enabled) that
+registers every wrapper with this ledger; a `weakref.finalize` on the
+wrapper retires the same bytes when it dies, so the ledger is balanced by
+construction — whatever enters must leave, and `current_bytes` returning
+to baseline after `del model` is the no-leak invariant the tests assert.
+
+Accounting semantics (documented contract, see docs/diagnostics.md):
+
+* **unit** — logical NDArray storage: shape x itemsize at registration.
+  Buffers shared by several wrappers (detach/copyto aliases) are deduped
+  by buffer identity with a refcount, so an alias costs nothing until the
+  last wrapper dies.
+* **attribution** — three axes, all at creation time: the owning Context
+  (`cpu(0)` / `tpu(0)`), the dtype, and the innermost live Gluon Block
+  scope (`Block.__call__` pushes its name while the ledger is active), so
+  `memory_summary()` can answer "which layer holds the bytes".
+* **approximation** — in-place mutation (`x[...] = v`) swaps the backing
+  buffer but keeps the wrapper's registered size (shapes are preserved by
+  the mutation ops, so the byte count stays truthful); deferred bulk
+  outputs are attributed to the current default Context at defer time.
+  Physical truth lives in the XLA allocator — `memory_summary()` carries
+  a `reconcile` section from `jax.Device.memory_stats()` and
+  `jax.live_arrays()` where the backend exposes them.
+
+Off-path cost: one module-global check in `NDArray.__init__` and one in
+`Block.__call__` (`_ACTIVE`), same discipline as the profiler hooks.
+
+This module must not import `ndarray` at module scope (it is imported
+from gluon/bulk layers during package init); the hook is installed
+lazily in :func:`enable_memory`.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+from ..context import current_context
+from ..profiler.counters import set_gauge as _set_gauge
+
+__all__ = ["enable_memory", "disable_memory", "memory_enabled",
+           "reset_memory", "memory_summary", "format_memory_summary",
+           "push_block", "pop_block", "reconcile", "logical_nbytes"]
+
+
+def logical_nbytes(raw) -> int:
+    """Logical storage bytes of an array-like (shape x itemsize) — THE
+    byte formula for every accounting surface (ledger, kvstore payload
+    counters), so dtype/packing changes have one place to land."""
+    n = getattr(raw.dtype, "itemsize", 4)
+    for s in raw.shape:
+        n *= int(s)
+    return n
+
+# fast-path predicate: read by Block.__call__ on every forward
+_ACTIVE = False
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+
+def _block_stack():
+    st = getattr(_tls, "blocks", None)
+    if st is None:
+        st = _tls.blocks = []
+    return st
+
+
+def push_block(name: str):
+    """Enter a Block attribution scope (called by Block.__call__ while
+    the ledger is active)."""
+    _block_stack().append(name)
+
+
+def pop_block():
+    st = _block_stack()
+    if st:
+        st.pop()
+
+
+class _Ledger:
+    """The accounting state. All mutation under the module lock."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.current = {}        # ctx -> live bytes
+        self.peak = {}           # ctx -> high-water bytes
+        self.by_dtype = {}       # (ctx, dtype) -> live bytes
+        self.by_block = {}       # block name -> live bytes
+        self.total_bytes = 0     # live bytes across contexts
+        self.peak_total = 0      # high-water of total_bytes
+        self.live_arrays = 0
+        self.total_registered = 0
+        # buffer dedup: entries are keyed by an opaque token (finalizers
+        # hold the token), with a secondary id(raw) -> token map for alias
+        # lookup. The entry carries a weakref to the raw buffer so a
+        # RECYCLED id (CPython reuses addresses the moment a buffer is
+        # freed, e.g. after an in-place __setitem__ swaps NDArray._data)
+        # is detected as "not the same buffer" instead of silently
+        # swallowing the new allocation as an alias of a dead one.
+        self._entries = {}       # token -> [count, nbytes, ctx, dt, blk, wref]
+        self._by_id = {}         # id(raw) -> token
+        self._next_tok = 0
+
+    # -- registration ------------------------------------------------------
+    def register(self, nd):
+        """Account one NDArray wrapper; pairs with a weakref finalizer."""
+        raw = nd._data
+        tname = type(raw).__name__
+        if tname == "DeferredArray":
+            ctx = str(current_context())
+        else:
+            import jax
+            if isinstance(raw, jax.core.Tracer):
+                return                       # inside a jit trace: no storage
+            try:
+                dev = next(iter(raw.devices()))
+            except Exception:
+                return                       # exotic backing, don't account
+            from ..context import ctx_from_device
+            ctx = str(ctx_from_device(dev))
+        nbytes = logical_nbytes(raw)
+        dt_s = str(raw.dtype)
+        st = getattr(_tls, "blocks", None)
+        blk = st[-1] if st else "<unscoped>"
+        key = id(raw)
+        with _lock:
+            self.total_registered += 1
+            self.live_arrays += 1
+            tok = self._by_id.get(key)
+            ent = self._entries.get(tok) if tok is not None else None
+            same = ent is not None and \
+                (ent[5]() is raw if ent[5] is not None else True)
+            if same:
+                ent[0] += 1                  # aliased buffer: refcount only
+            else:
+                try:
+                    wref = weakref.ref(raw)
+                except TypeError:
+                    wref = None
+                self._next_tok += 1
+                tok = self._next_tok
+                self._entries[tok] = [1, nbytes, ctx, dt_s, blk, wref]
+                self._by_id[key] = tok       # dead entry keeps its token
+                self._add(ctx, dt_s, blk, nbytes)
+        weakref.finalize(nd, self._unregister, tok, key)
+
+    def _add(self, ctx, dt_s, blk, nbytes):
+        self.current[ctx] = self.current.get(ctx, 0) + nbytes
+        if self.current[ctx] > self.peak.get(ctx, 0):
+            self.peak[ctx] = self.current[ctx]
+        self.total_bytes += nbytes
+        if self.total_bytes > self.peak_total:
+            self.peak_total = self.total_bytes
+        k = (ctx, dt_s)
+        self.by_dtype[k] = self.by_dtype.get(k, 0) + nbytes
+        self.by_block[blk] = self.by_block.get(blk, 0) + nbytes
+
+    def _unregister(self, tok, key):
+        with _lock:
+            ent = self._entries.get(tok)
+            if ent is None:
+                return                       # ledger reset since register
+            self.live_arrays -= 1
+            ent[0] -= 1
+            if ent[0] > 0:
+                return
+            del self._entries[tok]
+            if self._by_id.get(key) == tok:
+                del self._by_id[key]
+            _, nbytes, ctx, dt_s, blk, _ = ent
+            self.current[ctx] = self.current.get(ctx, 0) - nbytes
+            self.total_bytes -= nbytes
+            k = (ctx, dt_s)
+            self.by_dtype[k] = self.by_dtype.get(k, 0) - nbytes
+            self.by_block[blk] = self.by_block.get(blk, 0) - nbytes
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        with _lock:
+            by_dtype = {}
+            for (ctx, dt_s), b in self.by_dtype.items():
+                by_dtype.setdefault(ctx, {})[dt_s] = b
+            return {
+                "current_bytes": self.total_bytes,
+                "peak_bytes": self.peak_total,
+                "live_arrays": self.live_arrays,
+                "total_registered": self.total_registered,
+                "by_context": {c: {"current_bytes": b,
+                                   "peak_bytes": self.peak.get(c, 0)}
+                               for c, b in self.current.items()},
+                "by_dtype": by_dtype,
+                "by_block": {b: n for b, n in self.by_block.items()
+                             if n != 0},
+            }
+
+
+_ledger = _Ledger()
+
+
+def enable_memory(reset: bool = False) -> None:
+    """Turn the allocation ledger on: installs the NDArray creation hook
+    and arms Block-scope attribution. Idempotent."""
+    global _ACTIVE
+    if reset:
+        _ledger.reset()
+    from .. import ndarray as _nd
+    _nd._mem_hook = _ledger.register
+    _ACTIVE = True
+    _publish_gauges()
+
+
+def disable_memory() -> None:
+    """Stop accounting new arrays (already-registered finalizers keep
+    retiring their bytes so the ledger stays balanced)."""
+    global _ACTIVE
+    _ACTIVE = False
+    try:
+        from .. import ndarray as _nd
+        _nd._mem_hook = None
+    except Exception:
+        pass
+
+
+def memory_enabled() -> bool:
+    return _ACTIVE
+
+
+def reset_memory() -> None:
+    _ledger.reset()
+
+
+def reconcile() -> dict:
+    """Ground truth from the runtime: per-device XLA allocator stats and
+    the jax live-array census, for checking the ledger against physical
+    reality. Empty dict entries where the backend exposes nothing (CPU)."""
+    out = {"devices": {}, "jax_live_arrays": None,
+           "jax_live_bytes": None}
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out["devices"][str(d)] = {
+                    k: stats[k] for k in ("bytes_in_use",
+                                          "peak_bytes_in_use",
+                                          "bytes_limit")
+                    if k in stats}
+        try:
+            live = jax.live_arrays()
+            out["jax_live_arrays"] = len(live)
+            out["jax_live_bytes"] = int(sum(
+                getattr(a, "nbytes", 0) or 0 for a in live))
+        except Exception:
+            pass
+    except Exception:
+        pass
+    return out
+
+
+def _publish_gauges(s: dict | None = None):
+    """Mirror the headline numbers into the always-live counters registry
+    so the sampler/Prometheus exporter picks them up with everything else."""
+    s = s or _ledger.summary()
+    _set_gauge("current_bytes", s["current_bytes"], "memory")
+    _set_gauge("peak_bytes", s["peak_bytes"], "memory")
+    _set_gauge("live_arrays", s["live_arrays"], "memory")
+
+
+def memory_summary(include_reconcile: bool = True) -> dict:
+    """The memory report: current/peak bytes overall, per Context, per
+    dtype, per Gluon Block, plus the XLA-side reconciliation."""
+    s = _ledger.summary()
+    _publish_gauges(s)
+    if include_reconcile:
+        s["reconcile"] = reconcile()
+    return s
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n} B"
+
+
+def format_memory_summary(s: dict | None = None) -> str:
+    """Human-readable rendering of :func:`memory_summary`."""
+    s = s or memory_summary()
+    lines = [f"current {_fmt_bytes(s['current_bytes'])}   "
+             f"peak {_fmt_bytes(s['peak_bytes'])}   "
+             f"live arrays {s['live_arrays']}"]
+    for ctx, e in sorted(s["by_context"].items()):
+        lines.append(f"  {ctx:<12} current {_fmt_bytes(e['current_bytes']):>12}"
+                     f"  peak {_fmt_bytes(e['peak_bytes']):>12}")
+        for dt, b in sorted(s["by_dtype"].get(ctx, {}).items()):
+            if b:
+                lines.append(f"    {dt:<12} {_fmt_bytes(b):>12}")
+    blocks = sorted(s["by_block"].items(), key=lambda kv: -kv[1])
+    if blocks:
+        lines.append("  by block:")
+        for b, n in blocks[:20]:
+            lines.append(f"    {b:<28} {_fmt_bytes(n):>12}")
+    rec = s.get("reconcile") or {}
+    for dev, st in (rec.get("devices") or {}).items():
+        lines.append(f"  xla {dev}: in_use "
+                     f"{_fmt_bytes(st.get('bytes_in_use', 0))} peak "
+                     f"{_fmt_bytes(st.get('peak_bytes_in_use', 0))}")
+    if rec.get("jax_live_arrays") is not None:
+        lines.append(f"  jax.live_arrays: {rec['jax_live_arrays']} "
+                     f"({_fmt_bytes(rec.get('jax_live_bytes') or 0)})")
+    return "\n".join(lines)

@@ -16,6 +16,7 @@ from . import bulk as _bulk_mod
 from . import profiler as _prof
 from . import runtime as _rt
 from . import ndarray as _nd
+from .diagnostics import flight as _flight
 from .runtime import engine_type, get_engine
 
 __all__ = ["push", "new_var", "wait_for_var", "wait_all", "engine_type",
@@ -28,6 +29,8 @@ def new_var() -> int:
 
 def push(fn, const_vars=(), mutable_vars=()):
     """Schedule fn once deps resolve: concurrent reads, exclusive writes."""
+    if _flight._REC is not None:
+        _flight.record("engine", "engine.push")
     if _prof._ACTIVE:
         with _prof.Scope("engine.push", "engine", sync=False):
             get_engine().push(fn, const_vars, mutable_vars)
@@ -45,6 +48,8 @@ def wait_for_var(var: int):
 
 def wait_all():
     """Barrier on host-engine tasks AND device async work (mx.nd.waitall)."""
+    if _flight._REC is not None:
+        _flight.record("engine", "engine.wait_all")
     if _prof._ACTIVE:
         with _prof.Scope("engine.wait_all", "engine", sync=False):
             get_engine().wait_all()

@@ -18,6 +18,8 @@ import jax
 
 from .. import autograd
 from .. import profiler as _prof
+from ..diagnostics import memory as _dmem
+from ..diagnostics import flight as _flight
 from ..base import NameManager, camel_to_snake
 from ..ndarray import NDArray, _apply
 from ..ndarray import random as ndrandom
@@ -178,7 +180,16 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self._invoke(*args, **kwargs)
+        if _dmem._ACTIVE:
+            # attribute arrays created during this forward to this block
+            # (innermost scope wins) for memory_summary()'s by-block view
+            _dmem.push_block(self.name)
+            try:
+                out = self._invoke(*args, **kwargs)
+            finally:
+                _dmem.pop_block()
+        else:
+            out = self._invoke(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
@@ -310,6 +321,9 @@ class HybridBlock(Block):
         sig = (tuple((tuple(a.shape), str(a._data.dtype)) for a in args), training)
         entry = self._cache.get(sig)
         if entry is None:
+            if _flight._REC is not None:
+                _flight.record("compile", "jit.compile:" + self.name,
+                               {"signature": repr(sig)})
             if _prof._ACTIVE:
                 # jit compile-cache miss: the recorded span covers the
                 # trace/lower work in _build_cache; the device compile

@@ -16,6 +16,7 @@ import numpy as np
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
 from .. import profiler as _prof
+from ..diagnostics import flight as _flight
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -284,6 +285,9 @@ class Trainer:
             self._kvstore.pushpull(keys, grads, out=grads)
 
     def step(self, batch_size, ignore_stale_grad=False):
+        if _flight._REC is not None:
+            _flight.record("trainer", "trainer.step",
+                           {"batch_size": int(batch_size)})
         self._optimizer.rescale_grad = self._scale / batch_size
         if self._update_on_kvstore:
             if _prof._ACTIVE:

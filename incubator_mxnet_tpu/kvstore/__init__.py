@@ -44,6 +44,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ndarray import NDArray
 from .. import optimizer as _opt
 from .. import profiler as _prof
+from ..diagnostics import flight as _flight
+from ..diagnostics.memory import logical_nbytes as _logical_nbytes
+
+
+def _value_nbytes(value) -> int:
+    """Logical bytes of an NDArray / (nested) list of NDArrays — the
+    always-live `kvstore.*_bytes` counters the metrics exporter scrapes."""
+    total = 0
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        elif isinstance(v, NDArray):
+            total += _logical_nbytes(v._data)
+    return total
+
+
+def _account(op: str, value) -> None:
+    """Count one collective-surface call + its payload bytes, and drop a
+    flight-recorder breadcrumb when the ring is live."""
+    nb = _value_nbytes(value)
+    _prof.counter("kvstore.%s_calls" % op).increment()
+    _prof.counter("kvstore.%s_bytes" % op).increment(nb)
+    if _flight._REC is not None:
+        _flight.record("collective", "kvstore.%s" % op, {"bytes": nb})
 
 __all__ = ["KVStore", "create"]
 
@@ -341,6 +367,7 @@ class KVStore:
         return self._batch_aggregate([key], [values])[0]
 
     def push(self, key, value, priority=0):
+        _account("push", value)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.push", "kvstore", sync=False):
                 return self._push_impl(key, value, priority)
@@ -410,6 +437,7 @@ class KVStore:
                 self._store[key] = agg.copy()
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        _account("pull", out)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.pull", "kvstore", sync=False):
                 return self._pull_impl(key, out, priority, ignore_sparse)
@@ -440,6 +468,7 @@ class KVStore:
         push applies per-worker server updates and the pull returns the
         CURRENT server weights (which may not yet include delayed
         workers' pushes — the async contract)."""
+        _account("pushpull", value)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.pushpull", "kvstore", sync=False):
                 return self._pushpull_impl(key, value, out, priority)

@@ -37,6 +37,13 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 # profiling is active (profiler.set_state("run")); None = zero-overhead path
 _op_hook = None
 
+# diagnostics hooks, same zero-overhead-off discipline (one module-global
+# check each): _mem_hook registers every new NDArray with the allocation
+# ledger (diagnostics.memory); _flight_hook records each op dispatch in
+# the flight-recorder ring (diagnostics.flight)
+_mem_hook = None
+_flight_hook = None
+
 
 def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None,
            fn_fwd=None, fn_vjp=None):
@@ -53,6 +60,8 @@ def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] 
     tape for differentiation); fn_vjp: optional precompiled pullback
     (primals..., out_cots...) -> input cots (HybridBlock CachedOp path).
     """
+    if _flight_hook is not None:
+        _flight_hook(name)
     if _bulk._ON:
         if _op_hook is None and not autograd.is_recording():
             res = _bulk.defer(fn_fwd or fn, [x._data for x in inputs],
@@ -82,6 +91,8 @@ def _wrap_deferred(raw) -> "NDArray":
     out._grad = None
     out._grad_req = None
     out._grad_hook = None
+    if _mem_hook is not None:
+        _mem_hook(out)
     return out
 
 
@@ -163,6 +174,8 @@ class NDArray:
                 self._grad = None
                 self._grad_req = None
                 self._grad_hook = None
+                if _mem_hook is not None:
+                    _mem_hook(self)
                 return
         if not isinstance(data, jax.Array) or dtype is not None:
             dt = None if dtype is None else normalize_dtype(dtype)
@@ -179,6 +192,8 @@ class NDArray:
         # during a backward walk (not at the end) — the readiness signal
         # overlapped gradient communication schedules on
         self._grad_hook = None
+        if _mem_hook is not None:
+            _mem_hook(self)
 
     # -- basic properties -------------------------------------------------
     @property

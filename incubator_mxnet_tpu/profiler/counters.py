@@ -1,17 +1,31 @@
 """Counters/gauges registry (parity: mx.profiler.Counter).
 
 A :class:`Counter` is a named monotonically-adjustable value grouped under
-a domain. The registry is always live (reads/writes are plain attribute
-ops independent of whether tracing is running) so subsystems can share one
-stats path — `Monitor` publishes per-tensor stats here, `bench.py`
-publishes per-phase step-time breakdowns, the jit cache publishes
-hit/miss counts. `dump()` folds the registry into the Chrome trace as
-counter ('C') events so values show up in chrome://tracing."""
+a domain. The registry is always live (reads/writes are independent of
+whether tracing is running) so subsystems can share one stats path —
+`Monitor` publishes per-tensor stats here, `bench.py` publishes per-phase
+step-time breakdowns, the jit cache publishes hit/miss counts. `dump()`
+folds the registry into the Chrome trace as counter ('C') events so
+values show up in chrome://tracing.
+
+Thread-safety contract: the diagnostics sampler thread reads the registry
+while engine worker threads and the training loop write it, so every
+mutation (`increment`/`decrement`/`set_value`) and every snapshot takes
+the ONE module lock — a single uncontended lock acquire per op, which is
+cheap enough for the always-on path (verified by the concurrency test in
+tests/test_diagnostics.py: N threads x M increments land exactly N*M).
+
+Each counter carries a `kind`: "counter" (monotonic, incremented) or
+"gauge" (latest-value, written via `set_value`/`set_gauge`). Exporters
+(diagnostics/export.py) use the kind for Prometheus TYPE lines and
+validators use it to check monotonicity of time series.
+"""
 from __future__ import annotations
 
 import threading
 
-__all__ = ["Counter", "counter", "counters", "set_gauge", "reset_counters"]
+__all__ = ["Counter", "counter", "counters", "set_gauge", "reset_counters",
+           "registry_snapshot", "counter_kinds"]
 
 _registry: "dict[str, Counter]" = {}
 _lock = threading.Lock()
@@ -19,29 +33,35 @@ _lock = threading.Lock()
 
 class Counter:
     """A named value in the registry. `increment`/`decrement` for counts,
-    `set_value` for gauges (latest-value semantics)."""
+    `set_value` for gauges (latest-value semantics). All mutations are
+    atomic under the registry lock."""
 
-    __slots__ = ("name", "domain", "value")
+    __slots__ = ("name", "domain", "value", "kind")
 
     def __init__(self, name: str, domain: str = "mxtpu", value=0):
         self.name = name
         self.domain = domain
         self.value = value
+        self.kind = "counter"
 
     @property
     def full_name(self) -> str:
         return f"{self.domain}/{self.name}"
 
     def increment(self, delta=1):
-        self.value += delta
-        return self.value
+        with _lock:
+            self.value += delta
+            return self.value
 
     def decrement(self, delta=1):
-        self.value -= delta
-        return self.value
+        with _lock:
+            self.value -= delta
+            return self.value
 
     def set_value(self, value):
-        self.value = value
+        with _lock:
+            self.value = value
+            self.kind = "gauge"
 
     def __repr__(self):
         return f"Counter({self.full_name}={self.value})"
@@ -66,6 +86,19 @@ def counters() -> dict:
     """Snapshot of the registry: {domain/name: value}."""
     with _lock:
         return {k: c.value for k, c in _registry.items()}
+
+
+def registry_snapshot() -> dict:
+    """Consistent snapshot with kinds: {domain/name: (value, kind)} —
+    the exporter-facing view (one lock acquire for the whole registry)."""
+    with _lock:
+        return {k: (c.value, c.kind) for k, c in _registry.items()}
+
+
+def counter_kinds() -> dict:
+    """{domain/name: 'counter'|'gauge'} for every registered metric."""
+    with _lock:
+        return {k: c.kind for k, c in _registry.items()}
 
 
 def reset_counters():

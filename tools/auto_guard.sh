@@ -9,6 +9,14 @@
 LOG=${1:-/root/repo/docs/AUTOSWEEP_r05.log}
 cd /root/repo || exit 1
 echo "$(date -u +%F' '%T) auto_guard armed (pid $$)" >> "$LOG"
+# CPU-side observability smoke BEFORE touching the tunnel: if the
+# diagnostics/telemetry pipeline is broken, find out here (cheap) rather
+# than after burning tunnel time on an unmeasurable bench run.
+if timeout 900 bash tools/diag_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) diag smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) diag smoke FAILED (continuing; bench telemetry suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -9,6 +9,14 @@
 LOG=${1:-/root/repo/docs/AUTOSWEEP_r04.log}
 cd /root/repo || exit 1
 echo "$(date -u +%F' '%T) auto_sweep armed (pid $$)" >> "$LOG"
+# CPU-side observability smoke BEFORE touching the tunnel (see
+# tools/diag_smoke.sh): a broken telemetry pipeline should fail here,
+# not midway through the on-chip sweep.
+if timeout 900 bash tools/diag_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) diag smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) diag smoke FAILED (continuing; sweep telemetry suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Pretty-print mxtpu diagnostics artifacts.
+
+Flight-recorder dumps (`diagnostics.flight` / `mxtpu_flight_*.json`):
+header, env/config snapshot, exception (when the dump came from the crash
+path), counter table, and the tail of the event ring with relative
+timestamps — the "what happened in the seconds before the crash" view.
+
+Sampler time series (`metrics.jsonl`): first/last sample, counter deltas
+and rates over the covered window.
+
+Usage:
+    python tools/mxdiag.py DUMP.json [--events N]
+    python tools/mxdiag.py metrics.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+
+
+def _fmt_ts(epoch) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(float(epoch)))
+    except (TypeError, ValueError):
+        return str(epoch)
+
+
+def print_flight(doc: dict, n_events: int) -> None:
+    print(f"flight dump  schema={doc.get('schema')}  "
+          f"reason={doc.get('reason')!r}")
+    print(f"  dumped at {_fmt_ts(doc.get('dumped_at'))}  "
+          f"(recorder started {_fmt_ts(doc.get('started_at'))})")
+    env = doc.get("env") or {}
+    print(f"  pid {env.get('pid')}  python {env.get('python')}  "
+          f"jax backend {env.get('jax_backend')} "
+          f"x{env.get('jax_device_count')}  "
+          f"mxtpu {env.get('mxtpu_version')}")
+    if env.get("argv"):
+        print(f"  argv: {' '.join(env['argv'])}")
+    for k, v in sorted((env.get("env") or {}).items()):
+        print(f"    {k}={v}")
+    cfg = doc.get("config") or {}
+    if cfg:
+        print("  config: " + ", ".join(f"{k}={v}"
+                                       for k, v in sorted(cfg.items())))
+    exc = doc.get("exception")
+    if exc:
+        print(f"\n  EXCEPTION: {exc.get('type')}: {exc.get('message')}")
+        for frame in exc.get("traceback") or []:
+            for ln in frame.rstrip().splitlines():
+                print("    " + ln)
+    counters = doc.get("counters") or {}
+    kinds = doc.get("counter_kinds") or {}
+    if counters:
+        print(f"\n  counters ({len(counters)}):")
+        width = max(len(k) for k in counters)
+        for k in sorted(counters):
+            v = counters[k]
+            tag = kinds.get(k, "?")[0]
+            shown = _fmt_bytes(v) if k.endswith("_bytes") or \
+                k.endswith("/current_bytes") or "bytes" in k else v
+            print(f"    [{tag}] {k:<{width}}  {shown}")
+    events = doc.get("events") or []
+    tail = events[-n_events:]
+    t_end = doc.get("dumped_at") or (tail[-1]["ts"] if tail else 0)
+    print(f"\n  events: {len(events)} in ring "
+          f"(capacity {doc.get('capacity')}), last {len(tail)}:")
+    for ev in tail:
+        dt = ev.get("ts", 0) - t_end
+        args = ev.get("args")
+        extra = "  " + json.dumps(args) if args else ""
+        print(f"    {dt:>+9.3f}s  {ev.get('kind', '?'):<10} "
+              f"{ev.get('name', '?')}{extra}")
+
+
+def print_metrics(path: str) -> None:
+    samples = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                samples.append(json.loads(ln))
+    if not samples:
+        print(f"{path}: no samples")
+        return
+    first, last = samples[0], samples[-1]
+    span = last["ts"] - first["ts"]
+    print(f"metrics series: {len(samples)} samples over {span:.2f}s "
+          f"({_fmt_ts(first['ts'])} .. {_fmt_ts(last['ts'])})")
+    kinds = last.get("kinds") or {}
+    names = sorted(set(first.get("counters", {})) |
+                   set(last.get("counters", {})))
+    width = max((len(n) for n in names), default=4)
+    for name in names:
+        a = first.get("counters", {}).get(name)
+        b = last.get("counters", {}).get(name)
+        kind = kinds.get(name, "?")
+        if kind == "counter" and isinstance(a, (int, float)) \
+                and isinstance(b, (int, float)):
+            rate = (b - a) / span if span > 0 else 0.0
+            print(f"  [c] {name:<{width}}  {a} -> {b}  "
+                  f"(+{b - a}, {rate:.2f}/s)")
+        else:
+            print(f"  [{kind[0]}] {name:<{width}}  {b}")
+    mem = last.get("memory")
+    if mem:
+        print(f"  memory: current {_fmt_bytes(mem.get('current_bytes'))}  "
+              f"peak {_fmt_bytes(mem.get('peak_bytes'))}  "
+              f"live {mem.get('live_arrays')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="flight dump .json or metrics .jsonl")
+    ap.add_argument("--events", type=int, default=40,
+                    help="how many trailing ring events to print")
+    args = ap.parse_args(argv)
+    if args.path.endswith(".jsonl"):
+        print_metrics(args.path)
+        return 0
+    try:
+        with open(args.path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"{args.path}: {e}", file=sys.stderr)
+        return 1
+    if isinstance(doc, dict) and str(doc.get("schema", "")).startswith(
+            "mxtpu.flight/"):
+        print_flight(doc, args.events)
+        return 0
+    print(f"{args.path}: not a flight dump (schema="
+          f"{doc.get('schema') if isinstance(doc, dict) else None!r}); "
+          f"for Chrome traces use chrome://tracing", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

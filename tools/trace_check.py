@@ -1,37 +1,46 @@
 #!/usr/bin/env python
-"""Validate a Chrome trace-event JSON file (as written by
-`incubator_mxnet_tpu.profiler.dump()` or any trace-event producer).
+"""Validate observability artifacts produced by this framework:
 
-Checks the subset of the Trace Event Format that chrome://tracing /
-Perfetto actually require to render:
-
-* top level is either a JSON array of events or an object whose
-  ``traceEvents`` is an array;
-* every event is an object with a string ``name`` and a string ``ph``;
-* complete events (``ph == "X"``) carry numeric, non-negative ``ts`` and
-  ``dur``;
-* instant/counter events (``ph in "iIC"``) carry a numeric ``ts``;
-* ``pid``/``tid``, when present, are integers.
+* **Chrome trace-event JSON** (`profiler.dump()`) — the subset of the
+  Trace Event Format that chrome://tracing / Perfetto require to render;
+* **flight-recorder dumps** (`diagnostics.flight`) — versioned schema
+  (``mxtpu.flight/1``), required header fields, events with monotonic
+  non-decreasing timestamps;
+* **Prometheus text exposition** (`diagnostics.export.prometheus_text`)
+  — metric-name/label/value syntax, `# TYPE` declarations;
+* **metrics newline-JSON** (`diagnostics` sampler `metrics.jsonl`) —
+  per-line schema, non-decreasing sample timestamps, and MONOTONIC
+  counters: any metric declared `kind == "counter"` must never decrease
+  across samples (a decrease means a broken registry or a torn read).
 
 Usage:
-    python tools/trace_check.py trace.json [more.json ...]
+    python tools/trace_check.py FILE [more files ...]
 
-Exit status 0 iff every file validates; errors are printed one per line.
-bench.py imports :func:`check_trace` and fails the run on a malformed
-dump, so a broken profiler can't silently ship garbage traces.
+File kind is auto-detected (extension, then content). Exit status 0 iff
+every file validates; errors are printed one per line. bench.py imports
+:func:`check_trace` / :func:`check_file` and fails the run on malformed
+output, so a broken exporter can't silently ship garbage telemetry.
 """
 from __future__ import annotations
 
 import json
 import numbers
+import re
 import sys
 
-__all__ = ["check_trace", "check_events"]
+__all__ = ["check_trace", "check_events", "check_flight", "check_prom",
+           "check_metrics_jsonl", "check_file"]
+
+FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 
 
 def _is_num(x) -> bool:
     return isinstance(x, numbers.Real) and not isinstance(x, bool)
 
+
+# ---------------------------------------------------------------------------
+# Chrome trace events
+# ---------------------------------------------------------------------------
 
 def check_events(events) -> list:
     """Validate a list of trace events. Returns a list of error strings
@@ -70,7 +79,7 @@ def check_events(events) -> list:
 
 
 def check_trace(path: str) -> list:
-    """Validate one trace file. Returns a list of error strings."""
+    """Validate one Chrome trace file. Returns a list of error strings."""
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -88,14 +97,200 @@ def check_trace(path: str) -> list:
     return [f"{path}: {e}" for e in check_events(events)]
 
 
+# ---------------------------------------------------------------------------
+# flight-recorder dumps
+# ---------------------------------------------------------------------------
+
+def check_flight(path: str) -> list:
+    """Validate a diagnostics flight-recorder dump."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON: {e}"]
+    errors = []
+    if not isinstance(doc, dict):
+        return [f"{path}: flight dump must be a JSON object"]
+    schema = doc.get("schema")
+    if not isinstance(schema, str) or \
+            not schema.startswith(FLIGHT_SCHEMA_PREFIX):
+        errors.append(f"schema must start with {FLIGHT_SCHEMA_PREFIX!r}, "
+                      f"got {schema!r}")
+    for key, typ in (("dumped_at", numbers.Real), ("reason", str),
+                     ("env", dict), ("config", dict), ("counters", dict),
+                     ("counter_kinds", dict), ("events", list)):
+        if not isinstance(doc.get(key), typ):
+            errors.append(f"missing/mistyped {key!r} "
+                          f"(want {typ.__name__}, "
+                          f"got {type(doc.get(key)).__name__})")
+    events = doc.get("events")
+    if isinstance(events, list):
+        last_ts = None
+        for i, ev in enumerate(events):
+            if not isinstance(ev, dict):
+                errors.append(f"events[{i}]: not an object")
+                continue
+            if not _is_num(ev.get("ts")):
+                errors.append(f"events[{i}]: needs numeric 'ts', "
+                              f"got {ev.get('ts')!r}")
+                continue
+            for key in ("kind", "name"):
+                if not isinstance(ev.get(key), str) or not ev[key]:
+                    errors.append(f"events[{i}]: missing/empty {key!r}")
+            if last_ts is not None and ev["ts"] < last_ts:
+                errors.append(f"events[{i}]: ts went backwards "
+                              f"({ev['ts']} < {last_ts})")
+            last_ts = ev["ts"]
+        n = doc.get("n_events")
+        if isinstance(n, int) and n != len(events):
+            errors.append(f"n_events={n} but {len(events)} events present")
+    kinds = doc.get("counter_kinds")
+    if isinstance(kinds, dict):
+        bad = [k for k, v in kinds.items()
+               if v not in ("counter", "gauge")]
+        if bad:
+            errors.append(f"counter_kinds values must be counter|gauge: "
+                          f"{bad[:3]}")
+    return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_PROM_METRIC = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(\{[^}]*\})?"                          # optional label set
+    r"\s+(-?[0-9.eE+-]+|NaN|[+-]?Inf)\s*$")  # value
+_PROM_LABELS = re.compile(
+    r'^\{([a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*,?\}$')
+_PROM_TYPE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def check_prom(path: str) -> list:
+    """Validate a Prometheus text-format file."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    errors = []
+    typed = {}
+    n_samples = 0
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if line.startswith("# TYPE"):
+                m = _PROM_TYPE.match(line)
+                if not m:
+                    errors.append(f"line {i}: malformed TYPE comment: "
+                                  f"{line!r}")
+                else:
+                    if m.group(1) in typed:
+                        errors.append(f"line {i}: duplicate TYPE for "
+                                      f"{m.group(1)}")
+                    typed[m.group(1)] = m.group(2)
+            continue
+        m = _PROM_METRIC.match(line)
+        if not m:
+            errors.append(f"line {i}: malformed sample line: {line!r}")
+            continue
+        n_samples += 1
+        labels = m.group(2)
+        if labels and not _PROM_LABELS.match(labels):
+            errors.append(f"line {i}: malformed label set: {labels!r}")
+        try:
+            float(m.group(3).replace("Inf", "inf"))
+        except ValueError:
+            errors.append(f"line {i}: unparseable value {m.group(3)!r}")
+        if m.group(1) not in typed:
+            errors.append(f"line {i}: sample {m.group(1)!r} has no "
+                          f"preceding # TYPE declaration")
+    if n_samples == 0:
+        errors.append("no metric samples present")
+    return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# metrics newline-JSON (sampler time series)
+# ---------------------------------------------------------------------------
+
+def check_metrics_jsonl(path: str) -> list:
+    """Validate a sampler metrics.jsonl: per-line schema, non-decreasing
+    timestamps, and monotonic non-decreasing values for every metric of
+    kind 'counter'."""
+    try:
+        with open(path) as f:
+            raw_lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    errors = []
+    if not raw_lines:
+        return [f"{path}: empty metrics file"]
+    last_ts = None
+    last_counter_vals = {}
+    for i, ln in enumerate(raw_lines, 1):
+        try:
+            s = json.loads(ln)
+        except ValueError as e:
+            errors.append(f"line {i}: invalid JSON: {e}")
+            continue
+        if not isinstance(s, dict) or not _is_num(s.get("ts")) \
+                or not isinstance(s.get("counters"), dict):
+            errors.append(f"line {i}: sample needs numeric 'ts' and "
+                          f"object 'counters'")
+            continue
+        if last_ts is not None and s["ts"] < last_ts:
+            errors.append(f"line {i}: ts went backwards "
+                          f"({s['ts']} < {last_ts})")
+        last_ts = s["ts"]
+        kinds = s.get("kinds") or {}
+        for name, v in s["counters"].items():
+            if kinds.get(name) != "counter" or not _is_num(v):
+                continue
+            prev = last_counter_vals.get(name)
+            if prev is not None and v < prev:
+                errors.append(f"line {i}: counter {name!r} decreased "
+                              f"({prev} -> {v})")
+            last_counter_vals[name] = v
+    return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def check_file(path: str) -> list:
+    """Validate one file, auto-detecting its kind: `.prom`/`.txt` →
+    Prometheus, `.jsonl` → metrics time series, JSON object with a
+    flight `schema` → flight dump, anything else → Chrome trace."""
+    low = path.lower()
+    if low.endswith((".prom", ".txt")):
+        return check_prom(path)
+    if low.endswith(".jsonl"):
+        return check_metrics_jsonl(path)
+    try:
+        with open(path) as f:
+            head = f.read(4096)
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    if f'"{FLIGHT_SCHEMA_PREFIX}' in head:
+        return check_flight(path)
+    return check_trace(path)
+
+
 def main(argv) -> int:
     if not argv:
         print(__doc__.strip().splitlines()[0])
-        print("usage: python tools/trace_check.py trace.json [...]")
+        print("usage: python tools/trace_check.py FILE [...]")
         return 2
     rc = 0
     for path in argv:
-        errors = check_trace(path)
+        errors = check_file(path)
         if errors:
             rc = 1
             for e in errors:
