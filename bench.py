@@ -391,6 +391,159 @@ _BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
                  "lenet": _build_lenet, "ssd": _build_ssd,
                  "transformer_lm": _build_transformer_lm}
 
+# per-sample input shapes for the serving bench (BENCH_MODEL=serving)
+_SERVING_SHAPES = {"lenet": (1, 28, 28), "resnet50_v1": (224, 224, 3)}
+
+
+def _serving_bench():
+    """BENCH_MODEL=serving: the inference-path benchmark. Freezes a
+    model_zoo network (AOT per-bucket compile + warmup), starts the
+    ModelServer, fires BENCH_SERVING_CLIENTS concurrent HTTP clients
+    each sending BENCH_SERVING_REQS single-sample requests, and reports
+    QPS + latency percentiles + batch-fill. Hard-fails (so the smoke
+    and the driver see it) on any dropped request or any response that
+    is not bit-exact against direct eager `net(x)`."""
+    import threading
+    import urllib.request
+
+    from incubator_mxnet_tpu import profiler as prof
+    from incubator_mxnet_tpu import serving
+
+    name = os.environ.get("BENCH_SERVING_MODEL", "lenet")
+    if name not in _SERVING_SHAPES:
+        raise ValueError(f"BENCH_SERVING_MODEL={name!r} has no serving "
+                         f"shape; choose from {sorted(_SERVING_SHAPES)}")
+    shape = _SERVING_SHAPES[name]
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "64"))
+    per_client = int(os.environ.get("BENCH_SERVING_REQS", "4"))
+    max_delay_ms = float(os.environ.get("BENCH_SERVING_MAX_DELAY_MS", "25"))
+
+    kwargs = {"layout": "NHWC"} if name.startswith("resnet") else {}
+    net = get_model(name, classes=10 if name == "lenet" else 1000, **kwargs)
+    net.initialize(init=mx.init.Xavier())
+
+    frozen = [None]
+    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+        lambda: frozen.__setitem__(0, net.freeze(input_shape=shape)),
+        lambda: None)           # freeze() warms every bucket itself
+    srv = serving.ModelServer(frozen[0], max_delay_ms=max_delay_ms,
+                              queue_limit=max(256, clients * per_client))
+    host, port = srv.start()
+    _log(f"serving {name} at {srv.address} buckets={frozen[0].buckets}")
+
+    n_req = clients * per_client
+    rng = np.random.RandomState(0)
+    X = rng.rand(n_req, *shape).astype(np.float32)
+    outputs = [None] * n_req
+    failures = []
+
+    def client(c):
+        for j in range(per_client):
+            i = c * per_client + j
+            body = json.dumps({"data": X[i].tolist(),
+                               "timeout_ms": 60000}).encode()
+            try:
+                r = urllib.request.urlopen(urllib.request.Request(
+                    f"http://{host}:{port}/predict", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=120)
+                outputs[i] = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, f"{type(e).__name__}: {e}"))
+
+    _log(f"firing {clients} clients x {per_client} requests")
+    t0 = time.time()
+    with prof.record_function("bench.steady", "bench", sync=False):
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    serve_s = time.time() - t0
+    stats = srv.stats()
+    srv.stop()                      # graceful drain
+
+    if failures:
+        raise RuntimeError(f"{len(failures)}/{n_req} requests failed; "
+                           f"first: {failures[0]}")
+    # bit-exactness: reconstruct each dispatched batch (batch_id /
+    # batch_index from the responses) and run net() — HYBRIDIZED, i.e.
+    # the compiled CachedOp forward, the only path any compiled serving
+    # stack can promise bit-identity with (per-op eager may differ by
+    # ~1 ULP from any fused program; docs/serving.md) — on the SAME
+    # padded batch: every served row must be bit-identical. The eager
+    # per-request diff is reported as a number, not asserted.
+    by_batch = {}
+    for i in range(n_req):
+        by_batch.setdefault(outputs[i]["batch_id"], []).append(i)
+    eager_diff = 0.0
+    for i in range(0, n_req, max(1, n_req // 16)):
+        got = np.asarray(outputs[i]["output"], np.float32)
+        ref1 = net(nd.array(X[i:i + 1])).asnumpy()[0]
+        eager_diff = max(eager_diff, float(np.abs(got - ref1).max()))
+    net.hybridize()
+    for bid, idxs in by_batch.items():
+        rows = sorted(idxs, key=lambda i: outputs[i]["batch_index"])
+        bsz = outputs[rows[0]]["batch_size"]
+        if len(rows) != bsz:
+            raise RuntimeError(f"batch {bid}: {len(rows)} responses but "
+                               f"batch_size={bsz}")
+        xb = X[rows]
+        bucket = frozen[0].bucket_for(bsz)
+        if bucket != bsz:
+            xb = np.concatenate(
+                [xb, np.zeros((bucket - bsz,) + xb.shape[1:], xb.dtype)])
+        ref = net(nd.array(xb)).asnumpy()
+        for row_pos, i in enumerate(rows):
+            got = np.asarray(outputs[i]["output"], np.float32)
+            if not np.array_equal(got, ref[row_pos]):
+                raise RuntimeError(
+                    f"batch {bid} row {row_pos} (request {i}) diverges "
+                    f"from the compiled net() forward on the same batch: "
+                    f"max abs diff {np.abs(got - ref[row_pos]).max()}")
+    dropped = n_req - int(stats.get("serving.responses", 0))
+    if dropped:
+        raise RuntimeError(f"{dropped} requests dropped "
+                           f"(responses != submitted)")
+
+    qps = n_req / serve_s
+    hist = prof.counters().get("serving/serving.latency_ms") or {}
+    extra_serving = {
+        "model": name, "clients": clients, "per_client": per_client,
+        "requests": n_req,
+        "responses": int(stats.get("serving.responses", 0)),
+        "batches": int(stats.get("serving.batches", 0)),
+        "batch_fill": round(stats.get("batch_fill", 0.0), 3),
+        "rejected_queue_full": int(stats.get("serving.rejected_queue_full",
+                                             0)),
+        "rejected_deadline": int(stats.get("serving.rejected_deadline", 0)),
+        "rejected_invalid": int(stats.get("serving.rejected_invalid", 0)),
+        "qps": round(qps, 2),
+        "p50_ms": stats.get("p50_ms"),
+        "p95_ms": stats.get("p95_ms"),
+        "p99_ms": stats.get("p99_ms"),
+        "latency_ms": hist,
+        "max_delay_ms": max_delay_ms,
+        "buckets": list(frozen[0].buckets),
+        "bit_exact": True,        # vs compiled net() on the same batch
+        "max_abs_diff_vs_single_eager": eager_diff,
+        "n_dispatch_batches": len(by_batch),
+    }
+    result = {
+        "metric": f"serving_{name}_requests_per_sec",
+        "value": round(qps, 2),
+        "unit": "requests/sec",
+        "vs_baseline": None,
+        "extra": {"model": f"serving_{name}", "batch": None,
+                  "dtype": "float32", "steps": n_req,
+                  "serving": extra_serving,
+                  "device": str(jax.devices()[0])},
+    }
+    _finish_profile(result, trace_path, compile_s=compile_s,
+                    warmup_s=warmup_s, steady_s=serve_s)
+    return result
+
 
 class _CastNorm(gluon.nn.HybridBlock):
     """Device-side input finishing: cast to the compute dtype and, for raw
@@ -568,12 +721,13 @@ def main():
     global _CURRENT_METRIC
     _main_t0 = time.time()
     model = os.environ.get("BENCH_MODEL", "resnet50")
-    if model not in _BENCH_MODELS:
+    if model not in _BENCH_MODELS and model != "serving":
         raise ValueError(f"unknown BENCH_MODEL {model!r}; choose from "
-                         f"{sorted(_BENCH_MODELS)}")
+                         f"{sorted(_BENCH_MODELS) + ['serving']}")
     try:
         default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
-                         "ssd": "16", "transformer_lm": "16"}[model]
+                         "ssd": "16", "transformer_lm": "16",
+                         "serving": "1"}[model]
     except KeyError:
         raise ValueError(f"BENCH_MODEL {model!r} has no default batch; "
                          f"set BENCH_BATCH explicitly")
@@ -623,6 +777,14 @@ def main():
     _CURRENT_METRIC = ("resnet50_imagenet_images_per_sec_per_chip"
                        if model == "resnet50"
                        else f"bench_{model}_samples_per_sec_per_chip")
+    if model == "serving":
+        _CURRENT_METRIC = (
+            f"serving_{os.environ.get('BENCH_SERVING_MODEL', 'lenet')}"
+            f"_requests_per_sec")
+        result = _serving_bench()
+        watchdog.cancel()
+        print(json.dumps(result))
+        return
     data_mode = os.environ.get("BENCH_DATA", "synthetic")
     if data_mode in ("record", "record_cached"):
         if model != "resnet50":

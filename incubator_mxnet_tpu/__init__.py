@@ -53,6 +53,7 @@ from . import amp
 from . import runtime
 from . import engine
 from . import diagnostics
+from . import serving
 from . import test_utils
 from . import utils
 

@@ -79,10 +79,20 @@ def prometheus_text(snapshot: dict | None = None) -> str:
     lines = []
     for name in sorted(s["counters"]):
         v = s["counters"][name]
-        if not isinstance(v, (int, float)) or isinstance(v, bool):
-            continue               # non-numeric gauges are not scrapeable
         pn = _prom_name(name)
         kind = s["kinds"].get(name, "gauge")
+        if kind == "histogram" and isinstance(v, dict):
+            # full exposition-format histogram family: cumulative
+            # `_bucket{le=...}` series + `_sum` + `_count`
+            lines.append(f"# TYPE {pn} histogram")
+            for le, c in (v.get("buckets") or {}).items():
+                lines.append(f'{pn}_bucket{{le="{_prom_label(le)}"}} '
+                             f"{float(c)!r}")
+            lines.append(f"{pn}_sum {float(v.get('sum', 0.0))!r}")
+            lines.append(f"{pn}_count {float(v.get('count', 0))!r}")
+            continue
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue               # non-numeric gauges are not scrapeable
         lines.append(f"# TYPE {pn} "
                      f"{'counter' if kind == 'counter' else 'gauge'}")
         # shortest round-trip repr: %g's 6 significant digits would

@@ -29,7 +29,7 @@ _state = {"initialized": False}
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None,
-         local_device_ids=None):
+         local_device_ids=None, initialization_timeout=None):
     """Form the multi-host cluster (parity: the reference launcher's
     scheduler rendezvous). No-op when already initialized or single-host
     with no coordinator given.
@@ -38,7 +38,12 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
     MXTPU_PROCESS_ID environment (set by tools/launch.py, the analogue of
     the reference launcher's DMLC_* variables), so an unmodified training
     script that calls ``mx.distributed.init()`` works under the
-    launcher."""
+    launcher.
+
+    ``initialization_timeout`` (seconds; env MXTPU_INIT_TIMEOUT) bounds
+    the rendezvous wait — widen it on loaded machines where sibling
+    processes start staggered (CI under full-suite load), shrink it in
+    fail-fast launchers."""
     if _state["initialized"]:
         return
     import os
@@ -54,11 +59,27 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
             coordinator_address = env_vals[0]
             num_processes = int(env_vals[1])
             process_id = int(env_vals[2])
+    if initialization_timeout is None and os.environ.get(
+            "MXTPU_INIT_TIMEOUT"):
+        initialization_timeout = int(os.environ["MXTPU_INIT_TIMEOUT"])
+    timeout_kw = ({} if initialization_timeout is None
+                  else {"initialization_timeout": int(initialization_timeout)})
+    if coordinator_address is not None:
+        # Cross-process computations on the CPU backend (loopback test
+        # clusters, CPU fleets) need a collectives implementation; jax
+        # does not default one on this version, and without it every
+        # process_allgather dies with "Multiprocess computations aren't
+        # implemented on the CPU backend". Must be set BEFORE the first
+        # backend materialization; harmless for TPU (per-backend knob).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older/newer jax: keep going
+            pass
     if coordinator_address is None and num_processes is None:
         # single-host or TPU-pod auto-discovery; jax treats absent args as
         # "use the runtime's own metadata" and works standalone too
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(**timeout_kw)
         except Exception as e:  # noqa: BLE001
             # plain single-process runs land here by design; on a real pod
             # a swallowed rendezvous error would strand the OTHER hosts in
@@ -75,7 +96,8 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
-            local_device_ids=local_device_ids)
+            local_device_ids=local_device_ids,
+            **timeout_kw)
     _state["initialized"] = True
 
 

@@ -413,6 +413,17 @@ class HybridBlock(Block):
         nd_mod.save(f"{path}-{epoch:04d}.params", save_dict)
         return sym, arg_params, aux_params
 
+    def freeze(self, input_shape, dtype="float32", **kwargs):
+        """Export→serve handoff without the disk round trip: snapshot
+        this block's parameters and AOT-compile per-bucket inference
+        executables (see serving.FrozenModel). `input_shape` is the
+        PER-SAMPLE shape (no batch dim). The returned FrozenModel is
+        immutable — further training of this block does not affect it.
+        For the on-disk flow, pair `export()` with
+        `serving.FrozenModel.from_exported(prefix, input_shape)`."""
+        from ..serving import FrozenModel
+        return FrozenModel(self, input_shape, dtype=dtype, **kwargs)
+
     def forward(self, *args, **kwargs):
         raise NotImplementedError
 

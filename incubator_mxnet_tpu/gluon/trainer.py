@@ -110,11 +110,12 @@ class _GradCommScheduler:
             # across workers. Real overlapped schedulers (BytePS) share
             # this one-push-per-iteration contract.
             raise RuntimeError(
-                "overlap_comm saw a second backward pass before step(); "
-                "gradient accumulation across multiple backwards is not "
-                "compatible with mid-backward aggregation — call step() "
-                "after each backward, or construct the Trainer with "
-                "overlap_comm=False")
+                "overlap_comm saw a second backward pass before the "
+                "scheduler was flushed; gradient accumulation across "
+                "multiple backwards is not compatible with mid-backward "
+                "aggregation — after each backward call step(), or "
+                "allreduce_grads() followed by update(), or construct "
+                "the Trainer with overlap_comm=False")
         self._ready.add(i)
         if all(j in self._ready for j in self._buckets[b]):
             heapq.heappush(self._heap, (self._buckets[b][0], b))
@@ -173,6 +174,19 @@ class _GradCommScheduler:
         self._ready.clear()
         self._issued.clear()
         self._inflight.clear()
+
+    def reset(self):
+        """Drop all per-pass state WITHOUT issuing anything. update()
+        calls this when the user skipped allreduce_grads(): whatever was
+        already issued mid-backward stays aggregated (that money is
+        spent), but nothing further is launched — crucially the next
+        backward starts from a clean slate instead of tripping notify()'s
+        second-backward guard with a misleading error."""
+        self._ready.clear()
+        self._issued.clear()
+        self._heap.clear()
+        self._inflight.clear()
+        self.issued_log.clear()
 
 
 class Trainer:
@@ -330,6 +344,14 @@ class Trainer:
             raise ValueError(
                 "update() is not supported when parameters are updated "
                 "on the kvstore (update_on_kvstore=True); call step()")
+        if self._sched is not None:
+            # update() without allreduce_grads() must not leave the
+            # overlap scheduler's _ready/_issued sets stale — the next
+            # backward's first grad hook would raise the (misleading)
+            # second-backward error. A correct allreduce_grads()+update()
+            # sequence already flushed, so this reset is a no-op there;
+            # re-flushing here instead would double-aggregate.
+            self._sched.reset()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update()
 

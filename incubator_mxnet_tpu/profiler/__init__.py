@@ -39,16 +39,17 @@ import json
 import threading
 import time
 
-from .counters import (Counter, counter, counters, reset_counters,
-                       set_gauge, registry_snapshot, counter_kinds,
-                       _counter_events)
+from .counters import (Counter, Histogram, counter, histogram, observe,
+                       counters, reset_counters, set_gauge,
+                       registry_snapshot, counter_kinds, _counter_events)
 from . import tpu as _tpu
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "reset", "aggregate_stats", "Scope", "scope",
-           "record_function", "Counter", "counter", "counters",
-           "set_gauge", "reset_counters", "registry_snapshot",
-           "counter_kinds", "device_memory_stats"]
+           "record_function", "Counter", "Histogram", "counter",
+           "histogram", "observe", "counters", "set_gauge",
+           "reset_counters", "registry_snapshot", "counter_kinds",
+           "device_memory_stats"]
 
 # --------------------------------------------------------------------------
 # State. `_ACTIVE` is THE fast-path predicate: hot layers guard their

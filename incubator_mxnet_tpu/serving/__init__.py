@@ -1,0 +1,49 @@
+"""mxtpu.serving — AOT-compiled inference serving with dynamic batching.
+
+The inference path the training-side subsystems were missing (reference:
+`mxnet-model-server` over exported symbol+params checkpoints), rebuilt
+TPU-native in three layers:
+
+* :class:`FrozenModel` (:mod:`.frozen`) — freeze a trained
+  `HybridBlock`/`SymbolBlock` (or a `HybridBlock.export()` checkpoint,
+  via :meth:`FrozenModel.from_exported`) and ahead-of-time compile one
+  donated executable per batch-size bucket, warmed up before traffic;
+* :class:`DynamicBatcher` (:mod:`.batcher`) — bounded thread-safe queue
+  coalescing single requests into padded bucket batches under a
+  max-latency/max-batch policy, with fail-fast backpressure, per-request
+  deadlines, and graceful drain;
+* :class:`ModelServer` (:mod:`.server`) — stdlib HTTP front end
+  (`/predict`, `/healthz`, `/stats`) with drain-aware shutdown.
+
+Quick start::
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import serving
+
+    net = mx.gluon.model_zoo.get_model("lenet", classes=10)
+    net.initialize(init=mx.init.Xavier())
+    frozen = net.freeze(input_shape=(1, 28, 28))      # AOT compile+warmup
+    srv = serving.ModelServer(frozen)
+    host, port = srv.start()
+    # POST {"data": [[...28x28...]]} to http://host:port/predict
+    srv.stop()                                        # graceful drain
+
+All serving telemetry (QPS, batch-fill, queue depth, latency histograms)
+rides the `profiler.counters` registry, so the diagnostics sampler, the
+Prometheus/JSON exporters, and the flight recorder pick it up with zero
+extra wiring. See docs/serving.md.
+"""
+from __future__ import annotations
+
+from .errors import (ServingError, InvalidInputError, QueueFullError,
+                     DeadlineExceededError, ServerClosedError)
+from .frozen import FrozenModel, default_buckets
+from .batcher import DynamicBatcher, Request
+from .server import ModelServer
+
+__all__ = [
+    "FrozenModel", "default_buckets", "DynamicBatcher", "Request",
+    "ModelServer",
+    "ServingError", "InvalidInputError", "QueueFullError",
+    "DeadlineExceededError", "ServerClosedError",
+]

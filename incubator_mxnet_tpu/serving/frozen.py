@@ -1,0 +1,260 @@
+"""FrozenModel — ahead-of-time-compiled inference executables.
+
+The serving counterpart of `HybridBlock.hybridize()`: where hybridize
+compiles lazily on first call per signature (fine for training, fatal for
+tail latency), FrozenModel **freezes** a trained block and precompiles —
+at construction time, before traffic arrives — one XLA executable per
+batch-size bucket:
+
+* **freeze** — parameters are snapshotted (and optionally `device_put`
+  onto an explicit Context) at construction; later training updates to
+  the source block do not leak into serving, and no autograd state is
+  ever touched (the trace runs with recording off, training=False, so
+  BatchNorm uses running stats and dropout is identity);
+* **AOT compile** — the forward is traced ONCE (`jax.eval_shape`, no
+  device work) to learn the output tree, then `jit.lower(...).compile()`
+  builds a concrete executable per bucket — compile cost is paid at
+  deploy time, with an explicit warmup execution per bucket so first
+  requests never see allocator/runtime lazy-init either;
+* **donation** — the padded input batch buffer is donated to the
+  executable on backends that support it (TPU/GPU), so steady-state
+  serving does not hold two copies of every in-flight batch; params are
+  passed (not donated) and live on-device for the model's lifetime.
+
+The reference lineage is `mxnet-model-server`'s frozen
+symbol+params checkpoint; `FrozenModel.from_exported` loads exactly that
+artifact (`prefix-symbol.json` + `prefix-0000.params`, via SymbolBlock).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from .. import autograd
+from .. import profiler as _prof
+from ..diagnostics import flight as _flight
+from ..gluon.block import HybridBlock, _flatten_out, _unflatten_out
+from ..gluon.parameter import DeferredInitializationError, _ParamTraceScope
+from ..ndarray import NDArray
+from ..ndarray import random as ndrandom
+from .errors import InvalidInputError
+
+__all__ = ["FrozenModel", "default_buckets"]
+
+
+def default_buckets(max_batch: int | None = None):
+    """Power-of-two bucket ladder, overridable via MXTPU_SERVING_BUCKETS
+    (comma-separated batch sizes)."""
+    env = os.environ.get("MXTPU_SERVING_BUCKETS")
+    if env:
+        sizes = sorted({int(s) for s in env.split(",") if s.strip()})
+    else:
+        sizes, b = [], 1
+        cap = int(max_batch or 32)
+        while b < cap:
+            sizes.append(b)
+            b *= 2
+        sizes.append(cap)
+        sizes = sorted(set(sizes))
+    if not sizes or sizes[0] < 1:
+        raise ValueError(f"invalid serving buckets {sizes!r}")
+    return tuple(sizes)
+
+
+class FrozenModel:
+    """An immutable, serving-ready snapshot of a Gluon block.
+
+    Parameters
+    ----------
+    block : HybridBlock (SymbolBlock included)
+        Trained model; params must be initialized (or initializable from
+        `input_shape` via one deferred-shape inference pass).
+    input_shape : tuple
+        PER-SAMPLE input shape (no batch dimension).
+    dtype : str
+        Input dtype requests must match.
+    batch_buckets : sequence of int, optional
+        Batch sizes to precompile; default `default_buckets()`.
+    ctx : Context, optional
+        Freeze params onto this device (default: wherever they live).
+    warmup : bool
+        Execute each compiled bucket once at construction (default True).
+    donate : bool, optional
+        Donate the input buffer to the executable. Default: only on
+        backends that support donation (not CPU, where XLA would warn
+        and ignore it).
+    """
+
+    def __init__(self, block, input_shape, dtype="float32",
+                 batch_buckets=None, ctx=None, warmup=True, donate=None):
+        if not isinstance(block, HybridBlock):
+            raise TypeError("FrozenModel requires a HybridBlock (or "
+                            f"SymbolBlock), got {type(block).__name__}")
+        self._block = block
+        self._input_shape = tuple(int(d) for d in input_shape)
+        self._dtype = np.dtype(dtype)
+        self._ctx = ctx
+        self.buckets = tuple(sorted(batch_buckets)) if batch_buckets \
+            else default_buckets()
+
+        params = self._frozen_params(block)
+        self._param_ids = [id(p) for p in params]
+        self._param_raws = tuple(p.data()._data if ctx is None
+                                 else jax.device_put(p.data()._data,
+                                                     ctx.device)
+                                 for p in params)
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
+        self.donate = bool(donate)
+
+        self._key = jax.random.PRNGKey(0)  # inference: dropout is identity
+        self._out_tree = None
+        raw_fn = self._make_raw_fn()
+        self._jit = jax.jit(raw_fn,
+                            donate_argnums=(2,) if self.donate else ())
+        self._exec = {}
+        for b in self.buckets:
+            self._compile_bucket(b, warmup)
+        _prof.set_gauge("serving.compiled_buckets", len(self._exec),
+                        "serving")
+
+    # -- freezing ---------------------------------------------------------
+    def _frozen_params(self, block):
+        params = list(block.collect_params().values())
+        try:
+            for p in params:
+                p.data()
+        except DeferredInitializationError:
+            # one shape-inference forward on a zero sample completes
+            # deferred init (same move as HybridBlock._call_cached)
+            from .. import ndarray as nd_mod
+            with autograd.pause(False):
+                block(nd_mod.zeros((1,) + self._input_shape,
+                                   dtype=self._dtype.name))
+            params = list(block.collect_params().values())
+            for p in params:
+                p.data()
+        return params
+
+    # -- tracing / compilation -------------------------------------------
+    def _make_raw_fn(self):
+        block = self._block
+        param_ids = self._param_ids
+        info = {}
+
+        def raw_fn(key_raw, p_raws, x_raw):
+            sub = dict(zip(param_ids, p_raws))
+            # recording=False, training=False: pure inference semantics —
+            # BN running stats are read, never written; dropout passes
+            # through; nothing lands on any autograd tape
+            with _ParamTraceScope(sub), autograd._Scope(False, False), \
+                    ndrandom._TraceKeyScope(key_raw):
+                out = block.forward(NDArray(x_raw))
+                leaves, tree = _flatten_out(out)
+            info["tree"] = tree
+            return tuple(x._data for x in leaves)
+
+        self._raw_info = info
+        return raw_fn
+
+    def _compile_bucket(self, b, warmup):
+        shape = (b,) + self._input_shape
+        x_spec = jax.ShapeDtypeStruct(shape, self._dtype)
+        if _flight._REC is not None:
+            _flight.record("compile", f"serving.freeze:b{b}",
+                           {"shape": list(shape), "dtype": str(self._dtype)})
+        with _prof.Scope(f"serving.compile:b{b}", "serving", sync=False):
+            lowered = self._jit.lower(self._key, self._param_raws, x_spec)
+            self._exec[b] = lowered.compile()
+        if self._out_tree is None:
+            self._out_tree = self._raw_info["tree"]
+        _prof.counter("serving.compiles", "serving").increment()
+        if warmup:
+            x0 = np.zeros(shape, self._dtype)
+            outs = self._exec[b](self._key, self._param_raws,
+                                 jax.numpy.asarray(x0))
+            jax.block_until_ready(outs)
+            _prof.counter("serving.warmup_runs", "serving").increment()
+
+    # -- execution --------------------------------------------------------
+    @property
+    def input_shape(self):
+        return self._input_shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest compiled bucket that fits n samples."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise InvalidInputError(
+            f"batch of {n} exceeds the largest compiled bucket "
+            f"({self.buckets[-1]}); recompile with larger batch_buckets")
+
+    def validate(self, x: np.ndarray):
+        """Shape/dtype admission check for ONE sample (no batch dim)."""
+        if tuple(x.shape) != self._input_shape:
+            raise InvalidInputError(
+                f"sample shape {tuple(x.shape)} != expected "
+                f"{self._input_shape}")
+        if np.dtype(x.dtype) != self._dtype:
+            raise InvalidInputError(
+                f"sample dtype {x.dtype} != expected {self._dtype.name}")
+
+    def run_raw(self, x) -> tuple:
+        """Execute the bucket exactly matching `x.shape[0]`. Returns the
+        flat tuple of raw output arrays (still batched/padded)."""
+        n = int(x.shape[0])
+        ex = self._exec.get(n)
+        if ex is None:
+            raise InvalidInputError(
+                f"no compiled bucket for batch {n}; buckets={self.buckets}")
+        return ex(self._key, self._param_raws, jax.numpy.asarray(x))
+
+    def predict_batch(self, x: np.ndarray) -> list:
+        """Serve a host batch of n <= max_batch samples: pad up to the
+        bucket, execute, slice back to n. Returns the per-output list of
+        np arrays (length n each). Rows are independent in inference
+        graphs, so padding rows never changes real rows' values."""
+        n = int(x.shape[0])
+        b = self.bucket_for(n)
+        if b != n:
+            pad = np.zeros((b - n,) + self._input_shape, self._dtype)
+            x = np.concatenate([np.ascontiguousarray(x), pad], axis=0)
+        outs = self.run_raw(x)
+        return [np.asarray(o)[:n] for o in outs]
+
+    def __call__(self, x):
+        """NDArray-level convenience matching `block(x)`: accepts an
+        NDArray or np array WITH batch dim, returns NDArray(s) in the
+        block's output structure."""
+        x_np = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        outs = self.predict_batch(x_np.astype(self._dtype, copy=False))
+        leaves = [NDArray(jax.numpy.asarray(o)) for o in outs]
+        return _unflatten_out(self._out_tree, leaves)
+
+    # -- checkpoints ------------------------------------------------------
+    @staticmethod
+    def from_exported(prefix, input_shape, epoch=0, input_name="data",
+                      ctx=None, **kwargs):
+        """Load a `HybridBlock.export()` checkpoint
+        (`prefix-symbol.json` + `prefix-{epoch:04d}.params`) straight
+        into a serving-ready FrozenModel — the mxnet-model-server flow."""
+        from ..gluon.block import SymbolBlock
+        block = SymbolBlock.imports(f"{prefix}-symbol.json", [input_name],
+                                    f"{prefix}-{epoch:04d}.params", ctx=ctx)
+        return FrozenModel(block, input_shape, ctx=ctx, **kwargs)
+
+    def __repr__(self):
+        return (f"FrozenModel(input={self._input_shape}, "
+                f"dtype={self._dtype.name}, buckets={self.buckets}, "
+                f"donate={self.donate})")

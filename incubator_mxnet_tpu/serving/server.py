@@ -1,0 +1,201 @@
+"""ModelServer — stdlib HTTP front end over FrozenModel + DynamicBatcher.
+
+Mirrors `diagnostics/export.py`'s server pattern (ThreadingHTTPServer in
+a daemon thread, quiet logs, JSON bodies) so the whole serving stack —
+like the rest of the observability layer — needs nothing outside the
+standard library. The reference analogue is `mxnet-model-server`'s
+frontend, collapsed to its essentials:
+
+* ``POST /predict`` — body ``{"data": <nested list>, "timeout_ms": N?}``;
+  200 with ``{"output": ..., "batch_size": n, "latency_ms": t}``, or the
+  admission error's HTTP code (400 invalid, 429 queue full, 504
+  deadline, 503 draining) with ``{"error": ..., "message": ...}``;
+* ``GET /healthz`` — ``{"status": "ok"|"draining", ...}`` (200 while
+  serving, 503 once draining: load balancers stop routing before the
+  listener goes away);
+* ``GET /stats`` — serving counters, batch-fill ratio, latency
+  percentiles, queue depth, uptime and QPS.
+
+Shutdown is a graceful drain: ``stop()`` flips /healthz to draining,
+stops admissions, lets the batcher finish every accepted request, then
+closes the listener.
+
+Env knobs: MXTPU_SERVING_HOST / MXTPU_SERVING_PORT,
+MXTPU_SERVING_MAX_BATCH, MXTPU_SERVING_MAX_DELAY_MS,
+MXTPU_SERVING_QUEUE_LIMIT, MXTPU_SERVING_TIMEOUT_MS (see
+docs/serving.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import profiler as _prof
+from .batcher import DynamicBatcher
+from .errors import InvalidInputError, ServingError
+from .frozen import FrozenModel
+
+__all__ = ["ModelServer"]
+
+
+def _env_float(name, default):
+    return float(os.environ.get(name, default))
+
+
+class ModelServer:
+    """Serve a FrozenModel (or freeze a HybridBlock in place) over HTTP.
+
+    ``ModelServer(net, input_shape=(1, 28, 28)).start()`` returns
+    ``(host, port)``; port 0 (default) binds a free one.
+    """
+
+    def __init__(self, model, input_shape=None, host=None, port=None,
+                 max_batch=None, max_delay_ms=None, queue_limit=None,
+                 default_timeout_ms=None, **freeze_kwargs):
+        if not isinstance(model, FrozenModel):
+            if input_shape is None:
+                raise ValueError("input_shape is required when passing an "
+                                 "unfrozen block")
+            model = FrozenModel(model, input_shape, **freeze_kwargs)
+        self.model = model
+        self.host = host or os.environ.get("MXTPU_SERVING_HOST",
+                                           "127.0.0.1")
+        self.port = int(port if port is not None
+                        else os.environ.get("MXTPU_SERVING_PORT", "0"))
+        self.batcher = DynamicBatcher(
+            model,
+            max_batch=max_batch or
+            int(os.environ.get("MXTPU_SERVING_MAX_BATCH", "0")) or None,
+            max_delay_ms=max_delay_ms if max_delay_ms is not None
+            else _env_float("MXTPU_SERVING_MAX_DELAY_MS", 5.0),
+            queue_limit=queue_limit or
+            int(os.environ.get("MXTPU_SERVING_QUEUE_LIMIT", "256")),
+            default_timeout_ms=default_timeout_ms if default_timeout_ms
+            is not None else _env_float("MXTPU_SERVING_TIMEOUT_MS", 1000.0))
+        self._httpd = None
+        self._started_at = None
+        self._draining = False
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/healthz"):
+                        draining = server._draining
+                        self._reply(503 if draining else 200, {
+                            "status": "draining" if draining else "ok",
+                            "model": repr(server.model),
+                            "buckets": list(server.model.buckets)})
+                    elif self.path.startswith("/stats"):
+                        self._reply(200, server.stats())
+                    else:
+                        self._reply(404, {"error": "NotFound",
+                                          "message": self.path})
+                except Exception as e:  # noqa: BLE001
+                    self._safe_500(e)
+
+            def do_POST(self):
+                try:
+                    if not self.path.startswith("/predict"):
+                        self._reply(404, {"error": "NotFound",
+                                          "message": self.path})
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        doc = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(doc, dict) or "data" not in doc:
+                            raise ValueError("body must be a JSON object "
+                                             "with a 'data' key")
+                        x = np.asarray(doc["data"],
+                                       dtype=server.model.dtype)
+                    except (ValueError, TypeError) as e:
+                        raise InvalidInputError(str(e)) from e
+                    t0 = time.perf_counter()
+                    req = server.batcher.submit(
+                        x, timeout_ms=doc.get("timeout_ms"))
+                    outs = req.wait(
+                        (doc.get("timeout_ms")
+                         or server.batcher.default_timeout_ms) / 1e3 + 30.0)
+                    out = outs[0] if len(outs) == 1 else outs
+                    self._reply(200, {
+                        "output": (out.tolist() if isinstance(out, np.ndarray)
+                                   else [o.tolist() for o in out]),
+                        "batch_size": req.batch_size,
+                        "batch_id": req.batch_id,
+                        "batch_index": req.batch_index,
+                        "latency_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3)})
+                except ServingError as e:
+                    self._reply(e.code, e.to_json())
+                except Exception as e:  # noqa: BLE001
+                    self._safe_500(e)
+
+            def _safe_500(self, e):
+                try:
+                    self._reply(500, {"error": type(e).__name__,
+                                      "message": str(e)[:500]})
+                except Exception:
+                    pass
+
+            def log_message(self, *a):   # stay quiet on stderr
+                pass
+
+        self.batcher.start()
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="mxtpu-serving-http", daemon=True)
+        t.start()
+        self._started_at = time.time()
+        self._draining = False
+        _prof.set_gauge("serving.up", 1, "serving")
+        return self.host, self.port
+
+    def stop(self, drain: bool = True):
+        """Graceful shutdown: mark draining (healthz 503), stop
+        admissions, finish accepted requests, then close the listener."""
+        self._draining = True
+        self.batcher.stop(drain=drain)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        _prof.set_gauge("serving.up", 0, "serving")
+
+    @property
+    def address(self):
+        return f"http://{self.host}:{self.port}"
+
+    # -- stats ------------------------------------------------------------
+    def stats(self) -> dict:
+        s = self.batcher.stats()
+        uptime = (time.time() - self._started_at) if self._started_at \
+            else 0.0
+        s["uptime_s"] = round(uptime, 3)
+        responses = s.get("serving.responses", 0)
+        s["qps"] = round(responses / uptime, 3) if uptime > 0 else 0.0
+        s["draining"] = self._draining
+        s["buckets"] = list(self.model.buckets)
+        s["max_batch"] = self.batcher.max_batch
+        s["max_delay_ms"] = self.batcher.max_delay_s * 1e3
+        s["queue_limit"] = self.batcher.queue_limit
+        return s

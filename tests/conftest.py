@@ -41,6 +41,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavyweight test, skipped unless --run-slow "
                    "or RUN_SLOW=1")
+    config.addinivalue_line(
+        "markers", "serial: must not run concurrently with other tests "
+                   "(multi-process rendezvous on a reserved port); tier-1 "
+                   "runs with xdist disabled, and any parallel runner "
+                   "must isolate these")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -21,18 +21,49 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _WORKER = os.path.join(_HERE, "mh_worker.py")
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_port(_next=[0]):
+    """Reserve a coordination-service port OUTSIDE the kernel's ephemeral
+    range (Linux default 32768+). The old bind-port-0 probe was racy
+    under full-suite load: between closing the probe socket and the
+    worker's coordinator binding it, any other test's OUTGOING connection
+    (HTTP smoke servers, async-PS transports) could be assigned the same
+    ephemeral port, and the rendezvous then failed with address-in-use.
+    A dedicated low range nothing else allocates from (plus a per-pid
+    stagger and a rotating cursor so back-to-back tests in one session
+    never reuse a port still in TIME_WAIT) isolates the coordinator."""
+    base = 21000 + (os.getpid() * 131) % 1000
+    for off in range(2000):
+        port = 21000 + (base - 21000 + _next[0] + off) % 2000
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", port))
+        except OSError:
+            continue
+        finally:
+            s.close()
+        _next[0] = (port - 21000 + 1) % 2000
+        return port
+    raise RuntimeError("no free coordination port in 21000-22999")
 
 
-def _run_cluster(nproc, steps, timeout=240):
-    port = str(_free_port())
+# Startup deadline for worker rendezvous: under full-suite load the two
+# workers' heavy imports start staggered by tens of seconds, so both the
+# in-worker jax rendezvous (MXTPU_INIT_TIMEOUT -> initialization_timeout)
+# and the parent's communicate() wait get explicit, generous budgets.
+_INIT_TIMEOUT_S = int(os.environ.get("MXTPU_TEST_INIT_TIMEOUT", "180"))
+_WORKER_TIMEOUT_S = int(os.environ.get("MXTPU_TEST_WORKER_TIMEOUT", "420"))
+
+
+def _cluster_env():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker pins its own 2-device count
+    env["MXTPU_INIT_TIMEOUT"] = str(_INIT_TIMEOUT_S)
+    return env
+
+
+def _run_cluster(nproc, steps, timeout=_WORKER_TIMEOUT_S):
+    port = str(_free_port())
+    env = _cluster_env()
     procs = [subprocess.Popen(
         [sys.executable, _WORKER, str(pid), str(nproc), port, str(steps)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
@@ -97,8 +128,7 @@ def test_two_process_dist_async_push_crosses_process_boundary():
     steps = 60
     worker = os.path.join(_HERE, "mh_async_worker.py")
     port = str(_free_port())
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
+    env = _cluster_env()
     procs = [subprocess.Popen(
         [sys.executable, worker, str(pid), "2", port, str(steps)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
@@ -106,7 +136,7 @@ def test_two_process_dist_async_push_crosses_process_boundary():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=_WORKER_TIMEOUT_S)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -134,16 +164,21 @@ def test_two_process_dist_async_push_crosses_process_boundary():
     assert all(_parse([o], "SHUTDOWN_OK") for o in outs)
 
 
+@pytest.mark.serial
 def test_two_process_overlap_trainer_matches_single_process():
     """REAL cross-process overlapped gradient communication: buckets
     issue mid-backward on both ranks in deterministic order and aggregate
     through the actual process_allgather collective; finals must be
-    rank-identical AND equal single-process full-batch training."""
+    rank-identical AND equal single-process full-batch training.
+
+    Marked `serial` (and given an isolated coordination port + widened
+    startup deadline): it passes alone in ~18 s but used to flake under
+    full-suite load when its rendezvous port was re-assigned or its
+    workers started staggered past the old 240 s budget."""
     steps = 10
     worker = os.path.join(_HERE, "mh_overlap_worker.py")
     port = str(_free_port())
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
+    env = _cluster_env()
     procs = [subprocess.Popen(
         [sys.executable, worker, str(pid), "2", port, str(steps)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
@@ -151,7 +186,7 @@ def test_two_process_overlap_trainer_matches_single_process():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=_WORKER_TIMEOUT_S)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -169,14 +204,19 @@ def test_two_process_overlap_trainer_matches_single_process():
     for k in params[0]:
         np.testing.assert_allclose(params[0][k], params[1][k], rtol=1e-6)
 
-    # single-process ground truth: same net, full batch, plain Trainer
+    # single-process ground truth: same net, full batch, plain Trainer.
+    # Explicit prefixes: the suite parent's global auto-name counter has
+    # drifted (dense_349...) while fresh workers start at dense_0, so a
+    # by-generated-name lookup only worked when this test ran alone —
+    # the actual cause of the "fails under full-suite load" flake.
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import autograd, gluon, nd
     mx.random.seed(7)
     np.random.seed(7)
     net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Dense(8, in_units=6, activation="relu"),
-            gluon.nn.Dense(3, in_units=8))
+    net.add(gluon.nn.Dense(8, in_units=6, activation="relu",
+                           prefix="ref0_"),
+            gluon.nn.Dense(3, in_units=8, prefix="ref1_"))
     net.initialize(init=mx.init.Xavier())
     tr = gluon.Trainer(net.collect_params(), "sgd",
                        {"learning_rate": 0.1}, kvstore=None)
@@ -189,10 +229,15 @@ def test_two_process_overlap_trainer_matches_single_process():
             loss = L(net(X), Y).sum()
         loss.backward()
         tr.step(X.shape[0])
-    for name, p in sorted(net.collect_params().items()):
-        np.testing.assert_allclose(params[0][name],
-                                   p.data().asnumpy().ravel(),
-                                   rtol=1e-4, atol=1e-6)
+    # positional alignment: both sides sorted — workers are fresh
+    # processes (dense_0*/dense_1*), reference uses fixed prefixes
+    ref = sorted(net.collect_params().items())
+    got = sorted(params[0].items())
+    assert len(ref) == len(got)
+    for (_, p), (wname, wvals) in zip(ref, got):
+        np.testing.assert_allclose(wvals, p.data().asnumpy().ravel(),
+                                    rtol=1e-4, atol=1e-6,
+                                    err_msg=wname)
 
 
 @pytest.mark.slow

@@ -143,3 +143,46 @@ def test_overlap_requires_kvstore():
     with pytest.raises(ValueError, match="kvstore"):
         gluon.Trainer(net.collect_params(), "sgd", {}, kvstore=None,
                       overlap_comm=True)
+
+
+def test_update_without_allreduce_resets_scheduler(monkeypatch):
+    """ADVICE r5: update() without allreduce_grads() used to strand the
+    scheduler's _ready/_issued sets, so the NEXT backward's first grad
+    hook raised the misleading 'second backward pass' error. update()
+    now resets the per-pass state (without issuing anything new)."""
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="dist_sync", overlap_comm=True)
+    _force_two_workers(monkeypatch, tr)
+    _backward(net)
+    assert tr._sched._issued            # buckets issued mid-backward
+    tr.update(2)                        # user skipped allreduce_grads()
+    assert not tr._sched._ready and not tr._sched._issued
+    _backward(net)                      # must NOT raise
+    tr.step(2)                          # and the normal path still works
+    assert not tr._sched._ready and not tr._sched._issued
+
+
+def test_allreduce_then_update_does_not_double_aggregate(monkeypatch):
+    """The documented two-call sequence must stay numerically identical
+    to step(): update()'s defensive reset must not re-issue (and so
+    re-aggregate) buckets that allreduce_grads() already flushed."""
+    net_a, net_b = _mlp(seed=5), _mlp(seed=5)
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1}, kvstore="dist_sync")
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1}, kvstore="dist_sync",
+                         overlap_comm=True)
+    _force_two_workers(monkeypatch, tr_a)
+    _force_two_workers(monkeypatch, tr_b)
+    for step in range(2):
+        _backward(net_a, seed=step)
+        _backward(net_b, seed=step)
+        tr_a.step(2)
+        tr_b._optimizer.rescale_grad = 1.0 / 2
+        tr_b.allreduce_grads()
+        tr_b.update(2)
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        np.testing.assert_array_equal(pa.data().asnumpy(),
+                                      pb.data().asnumpy())

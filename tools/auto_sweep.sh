@@ -17,6 +17,13 @@ if timeout 900 bash tools/diag_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) diag smoke FAILED (continuing; sweep telemetry suspect)" >> "$LOG"
 fi
+# serving-path smoke (CPU-only): the inference stack must validate
+# before the sweep burns tunnel time
+if timeout 900 bash tools/serve_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) serve smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) serve smoke FAILED (continuing; serving path suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

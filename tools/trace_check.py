@@ -12,6 +12,12 @@
   per-line schema, non-decreasing sample timestamps, and MONOTONIC
   counters: any metric declared `kind == "counter"` must never decrease
   across samples (a decrease means a broken registry or a torn read).
+  Histogram-kind metrics are validated structurally (cumulative buckets,
+  `+Inf` == count) and their observation count must be monotonic;
+* **bench result JSON** (`BENCH_*.json`) — when the result carries an
+  `extra.serving` section (the serving benchmark), its latency
+  histograms, percentiles, and fill-ratio/error accounting are
+  structurally validated.
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -29,7 +35,8 @@ import re
 import sys
 
 __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
-           "check_metrics_jsonl", "check_file"]
+           "check_metrics_jsonl", "check_histogram_snapshot",
+           "check_bench_json", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 
@@ -147,11 +154,61 @@ def check_flight(path: str) -> list:
     kinds = doc.get("counter_kinds")
     if isinstance(kinds, dict):
         bad = [k for k, v in kinds.items()
-               if v not in ("counter", "gauge")]
+               if v not in ("counter", "gauge", "histogram")]
         if bad:
-            errors.append(f"counter_kinds values must be counter|gauge: "
-                          f"{bad[:3]}")
+            errors.append(f"counter_kinds values must be "
+                          f"counter|gauge|histogram: {bad[:3]}")
+        counters = doc.get("counters")
+        if isinstance(counters, dict):
+            for k, kind in kinds.items():
+                if kind == "histogram" and k in counters:
+                    errors += [f"counters[{k!r}]: {e}" for e in
+                               check_histogram_snapshot(counters[k])]
     return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# histogram snapshots (profiler.counters.Histogram.value)
+# ---------------------------------------------------------------------------
+
+def check_histogram_snapshot(h) -> list:
+    """Structural validation of one histogram snapshot dict: numeric
+    count/sum, cumulative non-decreasing buckets ending in `+Inf` ==
+    count, and ordered percentile estimates."""
+    if not isinstance(h, dict):
+        return [f"histogram snapshot must be an object, "
+                f"got {type(h).__name__}"]
+    errors = []
+    for key in ("count", "sum"):
+        if not _is_num(h.get(key)):
+            errors.append(f"needs numeric {key!r}, got {h.get(key)!r}")
+    buckets = h.get("buckets")
+    if not isinstance(buckets, dict) or not buckets:
+        errors.append("needs non-empty 'buckets'")
+    else:
+        prev = None
+        for le, c in buckets.items():
+            if not _is_num(c) or c < 0:
+                errors.append(f"bucket le={le!r}: bad count {c!r}")
+                continue
+            if prev is not None and c < prev:
+                errors.append(f"bucket le={le!r}: cumulative count "
+                              f"decreased ({c} < {prev})")
+            prev = c
+        if "+Inf" not in buckets:
+            errors.append("buckets must end with '+Inf'")
+        elif _is_num(h.get("count")) and buckets["+Inf"] != h["count"]:
+            errors.append(f"buckets['+Inf']={buckets['+Inf']} != "
+                          f"count={h['count']}")
+    pcts = [h.get(k) for k in ("p50", "p95", "p99")]
+    if h.get("count"):
+        if not all(_is_num(p) for p in pcts):
+            errors.append(f"non-empty histogram needs numeric "
+                          f"p50/p95/p99, got {pcts!r}")
+        elif not (pcts[0] <= pcts[1] <= pcts[2]):
+            errors.append(f"percentiles must be ordered "
+                          f"p50<=p95<=p99, got {pcts!r}")
+    return errors
 
 
 # ---------------------------------------------------------------------------
@@ -207,9 +264,20 @@ def check_prom(path: str) -> list:
             float(m.group(3).replace("Inf", "inf"))
         except ValueError:
             errors.append(f"line {i}: unparseable value {m.group(3)!r}")
-        if m.group(1) not in typed:
-            errors.append(f"line {i}: sample {m.group(1)!r} has no "
-                          f"preceding # TYPE declaration")
+        name = m.group(1)
+        if name not in typed:
+            # histogram/summary families declare the base name; their
+            # samples carry the _bucket/_sum/_count suffixes
+            base = None
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and \
+                        typed.get(name[:-len(suffix)]) in ("histogram",
+                                                           "summary"):
+                    base = name[:-len(suffix)]
+                    break
+            if base is None:
+                errors.append(f"line {i}: sample {name!r} has no "
+                              f"preceding # TYPE declaration")
     if n_samples == 0:
         errors.append("no metric samples present")
     return [f"{path}: {e}" for e in errors]
@@ -250,7 +318,15 @@ def check_metrics_jsonl(path: str) -> list:
         last_ts = s["ts"]
         kinds = s.get("kinds") or {}
         for name, v in s["counters"].items():
-            if kinds.get(name) != "counter" or not _is_num(v):
+            kind = kinds.get(name)
+            if kind == "histogram":
+                errors += [f"line {i}: histogram {name!r}: {e}"
+                           for e in check_histogram_snapshot(v)]
+                n = v.get("count") if isinstance(v, dict) else None
+                if not _is_num(n):
+                    continue
+                v = n              # observation count is the monotone series
+            elif kind != "counter" or not _is_num(v):
                 continue
             prev = last_counter_vals.get(name)
             if prev is not None and v < prev:
@@ -261,13 +337,70 @@ def check_metrics_jsonl(path: str) -> list:
 
 
 # ---------------------------------------------------------------------------
+# bench result JSON (BENCH_*.json with serving stats)
+# ---------------------------------------------------------------------------
+
+def check_bench_json(path: str) -> list:
+    """Validate a bench.py result line/file. Core keys always; when the
+    run was the serving benchmark, its `extra.serving` section must carry
+    well-formed latency histograms and request accounting."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/invalid JSON: {e}"]
+    errors = []
+    if not isinstance(doc, dict):
+        return [f"{path}: bench result must be a JSON object"]
+    if not isinstance(doc.get("metric"), str) or not doc["metric"]:
+        errors.append("missing/empty 'metric'")
+    if not _is_num(doc.get("value")):
+        errors.append(f"needs numeric 'value', got {doc.get('value')!r}")
+    serving = (doc.get("extra") or {}).get("serving")
+    if serving is not None:
+        if not isinstance(serving, dict):
+            return [f"{path}: extra.serving must be an object"]
+        for key in ("requests", "responses", "batches", "batch_fill",
+                    "p50_ms", "p95_ms", "p99_ms", "qps"):
+            if not _is_num(serving.get(key)):
+                errors.append(f"extra.serving needs numeric {key!r}, "
+                              f"got {serving.get(key)!r}")
+        for key in ("rejected_queue_full", "rejected_deadline",
+                    "rejected_invalid"):
+            if key in serving and not _is_num(serving[key]):
+                errors.append(f"extra.serving[{key!r}] must be numeric")
+        hist = serving.get("latency_ms")
+        if hist is None:
+            errors.append("extra.serving needs a 'latency_ms' histogram")
+        else:
+            errors += [f"extra.serving.latency_ms: {e}"
+                       for e in check_histogram_snapshot(hist)]
+            if isinstance(hist, dict) and _is_num(serving.get("responses")) \
+                    and _is_num(hist.get("count")) \
+                    and hist["count"] < serving["responses"]:
+                errors.append(
+                    f"latency_ms.count={hist['count']} < "
+                    f"responses={serving['responses']} (lost observations)")
+        if _is_num(serving.get("batch_fill")) and serving["batch_fill"] < 1.0:
+            errors.append(f"batch_fill={serving['batch_fill']} < 1.0 "
+                          f"(more batches than requests?)")
+        ordered = [serving.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+        if all(_is_num(p) for p in ordered) and \
+                not (ordered[0] <= ordered[1] <= ordered[2]):
+            errors.append(f"serving percentiles must be ordered, "
+                          f"got {ordered!r}")
+    return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
 
 def check_file(path: str) -> list:
     """Validate one file, auto-detecting its kind: `.prom`/`.txt` →
     Prometheus, `.jsonl` → metrics time series, JSON object with a
-    flight `schema` → flight dump, anything else → Chrome trace."""
+    flight `schema` → flight dump, a bench result (has `metric` +
+    `value`) → bench JSON, anything else → Chrome trace."""
     low = path.lower()
     if low.endswith((".prom", ".txt")):
         return check_prom(path)
@@ -280,6 +413,16 @@ def check_file(path: str) -> list:
         return [f"{path}: unreadable: {e}"]
     if f'"{FLIGHT_SCHEMA_PREFIX}' in head:
         return check_flight(path)
+    if '"metric"' in head and '"value"' in head:
+        # bench result detection must parse the WHOLE document — a
+        # serving/diag bench json easily exceeds the 4 KB sniff window
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if isinstance(doc, dict) and "metric" in doc and "value" in doc:
+            return check_bench_json(path)
     return check_trace(path)
 
 
