@@ -137,6 +137,32 @@ def _bench_diag_start():
     return diag_dir
 
 
+def _bench_healthmon_start():
+    """BENCH_HEALTHMON=1: arm the cross-rank health layer for the bench
+    run — the structured event log + watchdogs (stall deadline widened to
+    cover the compile phase, BENCH_HEALTHMON_STALL_S). The bench loop
+    feeds it one mark per step, so the emitted BENCH json carries the
+    healthmon counters and the events file — and the run doubles as the
+    measured-overhead harness tools/health_smoke.sh compares against a
+    healthmon-off run."""
+    if os.environ.get("BENCH_HEALTHMON", "0") != "1":
+        return None
+    from incubator_mxnet_tpu import healthmon as hm
+    diag_dir = os.environ.get("MXTPU_DIAG_DIR", "/tmp/mxtpu_bench_diag")
+    os.makedirs(diag_dir, exist_ok=True)
+    return hm.enable(
+        hm_dir=diag_dir,
+        stall_timeout_s=float(os.environ.get("BENCH_HEALTHMON_STALL_S",
+                                             "1200")))
+
+
+def _healthmon_mark_step():
+    """One completed bench step (no-op when healthmon is off)."""
+    from incubator_mxnet_tpu import healthmon as hm
+    if hm._HM is not None:
+        hm._HM.step_end()
+
+
 def _profiled_compile_warmup(run_compile, run_warmup):
     """Shared compile+warmup phase instrumentation for both bench paths:
     arms the profiler, runs the compile under a bench.compile scope and
@@ -217,6 +243,18 @@ def _finish_profile(result, trace_path, **phase_s):
             if os.path.exists(p):
                 errors += checker(p)
                 result["extra"]["diag_" + name.split(".")[1]] = p
+    from incubator_mxnet_tpu import healthmon as hm
+    if hm.enabled():
+        mon = hm.current()
+        events_path = mon.events.path
+        result["extra"]["healthmon"] = {
+            "events_file": events_path,
+            "steps": mon.step,
+            "counters": {k: v for k, v in prof.counters().items()
+                         if k.startswith("healthmon/")},
+        }
+        hm.disable()               # closes the event log before validation
+        errors += tc.check_events_jsonl(events_path)
     if errors:
         raise RuntimeError("bench telemetry failed schema check: "
                            + "; ".join(errors[:5]))
@@ -771,6 +809,8 @@ def main():
     diag_dir = _bench_diag_start()
     if diag_dir:
         _log(f"diagnostics armed (sampler + flight recorder) -> {diag_dir}")
+    if _bench_healthmon_start() is not None:
+        _log("healthmon armed (watchdogs + structured event log)")
     np.random.seed(0)
     mx.random.seed(0)
 
@@ -839,6 +879,7 @@ def main():
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(chunks):
                 losses = step.run_k(xs, ys)
+                _healthmon_mark_step()     # one mark per dispatched chunk
             loss_val = float(losses[k - 1])         # host fetch = barrier
         dt = time.time() - t0
         steps = chunks * k
@@ -848,8 +889,13 @@ def main():
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(steps):
                 loss = step(x, y)
+                _healthmon_mark_step()
             loss_val = float(loss)
         dt = time.time() - t0
+    from incubator_mxnet_tpu import healthmon as _hm_mod
+    if _hm_mod._HM is not None:
+        # final-loss NaN sentinel: the one host value the bench fetched
+        _hm_mod.observe_loss(loss_val)
 
     img_s = batch * steps / dt
     peak = 197e12 if dtype == "bfloat16" else 99e12  # v5e chip

@@ -53,6 +53,7 @@ from . import amp
 from . import runtime
 from . import engine
 from . import diagnostics
+from . import healthmon
 from . import serving
 from . import test_utils
 from . import utils
@@ -71,3 +72,6 @@ _sys.modules.setdefault("mxtpu", _sys.modules[__name__])
 # MXTPU_DIAG=1: arm the always-on observability layer (memory ledger,
 # flight recorder, optional sampler — see docs/diagnostics.md) at import.
 diagnostics.enable_from_env()
+# MXTPU_HEALTHMON=1: arm cross-rank training health (watchdogs, skew
+# timeline, structured event log — see docs/observability.md) at import.
+healthmon.enable_from_env()

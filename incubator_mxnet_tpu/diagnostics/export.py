@@ -24,6 +24,7 @@ mx.profiler counters; the pull/push split follows Prometheus practice.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
@@ -195,6 +196,24 @@ def stop_sampler():
 
 def sampler_running() -> bool:
     return _SAMPLER is not None and _SAMPLER.is_alive()
+
+
+def _atexit_stop_sampler():
+    """Join the sampler before interpreter teardown. Without this, a
+    still-running tick can race module teardown (open() on a half-torn
+    interpreter → noisy ignored exceptions on exit). No final tick: at
+    atexit the priority is a clean join, not one more sample."""
+    global _SAMPLER
+    s = _SAMPLER
+    if s is not None:
+        try:
+            s.stop(final_tick=False)
+        except Exception:
+            pass
+        _SAMPLER = None
+
+
+atexit.register(_atexit_stop_sampler)
 
 
 # ---------------------------------------------------------------------------

@@ -80,10 +80,21 @@ def _env_snapshot() -> dict:
             if k.startswith(("MXTPU_", "BENCH_", "JAX_", "XLA_"))}
     snap = {"argv": list(sys.argv), "pid": os.getpid(),
             "python": sys.version.split()[0], "env": keep}
+    # rank tag: lets tools/mxdiag.py merge interleave several ranks'
+    # dumps into one cluster timeline without filename conventions. The
+    # launcher env wins: when the recorder is armed at import (MXTPU_DIAG
+    # =1), the cluster is not formed yet and jax.process_index() would
+    # report 0 on EVERY rank — mis-tagging all dumps as rank 0.
+    try:
+        snap["rank"] = int(os.environ["MXTPU_PROCESS_ID"])
+    except (KeyError, ValueError):
+        pass
     try:
         import jax
         snap["jax_backend"] = jax.default_backend()
         snap["jax_device_count"] = jax.device_count()
+        snap.setdefault("rank", jax.process_index())
+        snap["num_ranks"] = jax.process_count()
     except Exception:
         pass
     try:

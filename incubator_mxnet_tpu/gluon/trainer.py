@@ -13,6 +13,7 @@ import pickle
 import jax
 import numpy as np
 
+from .. import healthmon as _hm
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
 from .. import profiler as _prof
@@ -299,6 +300,9 @@ class Trainer:
             self._kvstore.pushpull(keys, grads, out=grads)
 
     def step(self, batch_size, ignore_stale_grad=False):
+        hm = _hm._HM
+        if hm is not None:
+            hm.step_begin()
         if _flight._REC is not None:
             _flight.record("trainer", "trainer.step",
                            {"batch_size": int(batch_size)})
@@ -310,7 +314,19 @@ class Trainer:
                     self._kvstore_step()
             else:
                 self._kvstore_step()
+            if hm is not None:
+                # grad-norm sentinel BEFORE step_end: the kvstore step
+                # left this worker's grads untouched, and step_end's
+                # periodic exchange should see the freshest NaN verdict
+                hm.maybe_check_grad_norm(self._params)
+                hm.step_end(kv=self._kvstore, batch_size=batch_size)
             return
+        phases = None
+        if hm is not None:
+            # healthmon step phases (cheap wall timing, on whether or not
+            # a trace session is running — the event log is the consumer)
+            import time as _time
+            t0 = _time.perf_counter()
         if _prof._ACTIVE:
             # step phases as separate trace buckets: grad aggregation
             # (incl. overlap-comm stragglers) vs the optimizer update
@@ -318,12 +334,25 @@ class Trainer:
             with _prof.Scope("trainer.allreduce_grads", "trainer",
                              sync=False):
                 self.allreduce_grads()
+            if hm is not None:
+                t1 = _time.perf_counter()
             with _prof.Scope("trainer.optimizer_update", "trainer",
                              sync=False):
                 self._update()
-            return
-        self.allreduce_grads()
-        self._update()
+        else:
+            self.allreduce_grads()
+            if hm is not None:
+                t1 = _time.perf_counter()
+            self._update()
+        if hm is not None:
+            t2 = _time.perf_counter()
+            phases = {"allreduce_ms": (t1 - t0) * 1e3,
+                      "update_ms": (t2 - t1) * 1e3}
+            # grads survive _update (it only reads them), so the opt-in
+            # global-norm sentinel runs on exactly what was applied
+            hm.maybe_check_grad_norm(self._params)
+            hm.step_end(kv=self._kvstore, batch_size=batch_size,
+                        phases=phases)
 
     def _kvstore_step(self):
         """Server-side update round: push grads, pull updated weights

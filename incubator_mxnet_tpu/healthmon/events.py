@@ -1,0 +1,129 @@
+"""Structured event log — the ``mxtpu.events/1`` JSONL stream.
+
+Flight dumps answer "what just happened in THIS process"; the event log
+is the cross-rank correlation surface: every record carries the same
+three correlation ids — ``run_id`` (shared by every rank of one training
+run), ``rank``, and ``step`` — so per-rank files merge into one ordered
+cluster timeline (``tools/mxdiag.py merge``). The pattern is Dapper's
+trace/span ids collapsed to the three that matter for SPMD training,
+where "one request" is "one step on every rank".
+
+Records are newline-JSON, one self-describing object per line::
+
+    {"schema": "mxtpu.events/1", "ts": <epoch s>, "run_id": "...",
+     "rank": 0, "step": 12, "kind": "trainer", "name": "step",
+     "args": {...}}
+
+``kind`` groups the emitting subsystem (``trainer``, ``collective``,
+``serving``, ``alert``, ``healthmon``, ``lifecycle``); ``step`` is null
+for records outside the training loop (serving batches, watchdog fires
+before the first step). Timestamps are monotone WITHIN a file (enforced
+under the writer lock) so `tools/trace_check.py` can validate ordering,
+and the merge tool's sort is stable across ranks.
+
+Hot-path discipline mirrors diagnostics.flight: one module global
+(``_LOG``) is THE fast-path predicate — subsystems guard with
+``if events._LOG is not None:`` and pay nothing when the log is off.
+Writes are line-buffered and flushed per record: an alert that never
+reached disk is an alert that never happened, which is exactly the
+failure mode a post-mortem log exists to avoid.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["SCHEMA", "EventLog", "open_log", "close_log", "emit",
+           "log_enabled", "current_log"]
+
+SCHEMA = "mxtpu.events/1"
+
+# module global: None = log off (THE fast-path predicate)
+_LOG = None
+
+
+class EventLog:
+    """One rank's append-only event stream."""
+
+    def __init__(self, path: str, run_id: str, rank: int):
+        self.path = path
+        self.run_id = str(run_id)
+        self.rank = int(rank)
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # fresh series per open (the sampler's truncate rationale): an
+        # appended prior run would break the file's monotonic-ts
+        # contract (each process clamps only against its OWN last ts)
+        # and make validators re-judge dead runs forever. Line-buffered:
+        # each record is durable at the following newline.
+        self._f = open(path, "w", buffering=1)
+        self._last_ts = 0.0
+        self.n_emitted = 0
+        self.emit("lifecycle", "events.open",
+                  args={"pid": os.getpid()})
+
+    def emit(self, kind: str, name: str, step=None, args=None):
+        """Append one record. Timestamps are clamped monotone within the
+        file (concurrent writers serialize on the lock; the clock is read
+        inside it so ordering and timestamps agree)."""
+        with self._lock:
+            if self._f.closed:
+                return
+            ts = time.time()
+            if ts < self._last_ts:
+                ts = self._last_ts
+            self._last_ts = ts
+            rec = {"schema": SCHEMA, "ts": ts, "run_id": self.run_id,
+                   "rank": self.rank,
+                   "step": (int(step) if step is not None else None),
+                   "kind": kind, "name": name}
+            if args:
+                rec["args"] = args
+            self._f.write(json.dumps(rec) + "\n")
+            self.n_emitted += 1
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# module surface
+# ---------------------------------------------------------------------------
+
+def open_log(path: str, run_id: str, rank: int) -> EventLog:
+    """Open (or replace) the module-level event log."""
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = EventLog(path, run_id, rank)
+    return _LOG
+
+
+def close_log():
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+        _LOG = None
+
+
+def log_enabled() -> bool:
+    return _LOG is not None
+
+
+def current_log():
+    return _LOG
+
+
+def emit(kind: str, name: str, step=None, args=None):
+    """Append one record if the log is on (cheap no-op otherwise).
+    Subsystems on hot paths should guard with
+    ``if events._LOG is not None:`` to skip even this call."""
+    log = _LOG
+    if log is not None:
+        log.emit(kind, name, step=step, args=args)

@@ -1,0 +1,158 @@
+"""Cross-rank collective timeline: straggler detection from step timing.
+
+The MegaScale observation this module rebuilds: in synchronous SPMD
+training every collective is a barrier, so a single slow rank makes the
+WHOLE job slow while looking idle itself — per-rank metrics show every
+rank "busy" (the fast ranks busy waiting inside the collective) and the
+aggregate just reads "training got slower". The signal that actually
+attributes blame is the *decomposition* of each rank's step time:
+
+    compute_ms = step_ms - collective_ms
+
+The straggler is the rank with the LARGEST compute time (it arrives at
+the collective last, so it waits least — its sleep/GC/contention shows
+up as compute); the fast ranks absorb the difference as collective wait.
+``collective_skew_ms`` is max(compute) - min(compute) across ranks: the
+time the collective barrier absorbs every step, i.e. the per-step cost
+of the straggler.
+
+Each rank keeps EWMAs of its own step/collective times (fed by the
+Trainer/kvstore hooks) and periodically exchanges a compact fixed-width
+record with every other rank:
+
+* **sync clusters** (`dist_sync*`, lockstep steps) — one
+  ``process_allgather`` of a 6-float vector, itself a collective, so it
+  is only issued from the step hook where every rank reaches the same
+  step count;
+* **dist_async clusters** (no lockstep) — the rank-0 TCP server from
+  kvstore/async_ps gains a ``health`` op: workers post their record and
+  receive the server's merged table (best-effort, possibly stale —
+  matching the async contract).
+
+The merged table feeds the shared counters registry
+(``healthmon.collective_skew_ms``, ``healthmon.slowest_rank``,
+``healthmon.straggler_flags``) so Prometheus/JSON/flight export the
+verdict with zero new wiring, and is kept as ``last_table`` for the
+stall watchdog's "per-rank last-known state" crash dump.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..profiler.counters import counter as _counter, set_gauge as _set_gauge
+
+__all__ = ["CollectiveTimeline", "RECORD_FIELDS"]
+
+# fixed-width exchange record (float64): stable wire format for both the
+# allgather and the async TCP paths
+RECORD_FIELDS = ("rank", "step", "step_ewma_ms", "coll_ewma_ms",
+                 "compute_ewma_ms", "nan_alerts")
+
+
+def _gauge(name, value):
+    _set_gauge("healthmon." + name, value, "healthmon")
+
+
+class CollectiveTimeline:
+    """Per-rank EWMA bookkeeping + the cross-rank exchange/verdict."""
+
+    def __init__(self, rank: int = 0, alpha: float = 0.3,
+                 straggler_factor: float = 2.0, min_skew_ms: float = 1.0):
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.straggler_factor = float(straggler_factor)
+        self.min_skew_ms = float(min_skew_ms)
+        self.step_ewma = None        # full step interval, ms
+        self.coll_ewma = None        # collective time inside the step, ms
+        self.last_step = 0
+        self.last_table = None       # {rank: {field: value}} from exchange
+        self.last_summary = None
+
+    # -- local recording --------------------------------------------------
+    def _fold(self, prev, x):
+        return x if prev is None else \
+            self.alpha * x + (1.0 - self.alpha) * prev
+
+    def record_step(self, step: int, step_ms: float, coll_ms: float):
+        """Fold one completed step's timing into the EWMAs and publish
+        the local gauges."""
+        self.last_step = int(step)
+        self.step_ewma = self._fold(self.step_ewma, float(step_ms))
+        self.coll_ewma = self._fold(self.coll_ewma, float(coll_ms))
+        _gauge("step_ms_ewma", round(self.step_ewma, 3))
+
+    @property
+    def compute_ewma(self):
+        if self.step_ewma is None:
+            return None
+        return max(0.0, self.step_ewma - (self.coll_ewma or 0.0))
+
+    def local_record(self, step: int, nan_alerts: int = 0) -> np.ndarray:
+        return np.array([self.rank, int(step), self.step_ewma or 0.0,
+                         self.coll_ewma or 0.0, self.compute_ewma or 0.0,
+                         int(nan_alerts)], dtype=np.float64)
+
+    # -- cross-rank verdict ----------------------------------------------
+    def ingest_table(self, table) -> dict:
+        """Compute the skew verdict from a (n_ranks, 6) record table (any
+        transport). Publishes gauges/counters and returns the summary
+        dict the event log records."""
+        table = np.asarray(table, dtype=np.float64).reshape(-1,
+                                                           len(RECORD_FIELDS))
+        ranks = table[:, 0].astype(int)
+        compute = table[:, 4]
+        skew = float(compute.max() - compute.min()) if len(table) else 0.0
+        slowest = int(ranks[int(np.argmax(compute))]) if len(table) else -1
+        _gauge("collective_skew_ms", round(skew, 3))
+        _gauge("slowest_rank", slowest)
+        flagged = []
+        if len(table) > 1 and skew >= self.min_skew_ms:
+            # EWMA slow-rank flagging: a rank whose compute EWMA exceeds
+            # straggler_factor x the cross-rank median is flagged (the
+            # median, not the min, so one fast rank can't indict the rest)
+            median = float(np.median(compute))
+            floor = max(median, 1e-6) * self.straggler_factor
+            flagged = [int(r) for r, c in zip(ranks, compute) if c > floor]
+            if flagged:
+                _counter("healthmon.straggler_flags",
+                                  "healthmon").increment(len(flagged))
+        self.last_table = {
+            int(row[0]): {f: (int(row[i]) if f in ("rank", "step",
+                                                   "nan_alerts")
+                              else round(float(row[i]), 3))
+                          for i, f in enumerate(RECORD_FIELDS)}
+            for row in table}
+        self.last_summary = {
+            "skew_ms": round(skew, 3), "slowest_rank": slowest,
+            "flagged_ranks": flagged, "n_ranks": len(table),
+            "compute_ms": {int(r): round(float(c), 3)
+                           for r, c in zip(ranks, compute)}}
+        return self.last_summary
+
+    def exchange(self, step: int, kv=None, nan_alerts: int = 0):
+        """Share this rank's record with the cluster and ingest the merged
+        table. Transport is chosen per the module docstring; single
+        process degenerates to a local-only table (skew 0).
+
+        SYNC-CLUSTER CONTRACT: on `dist_sync*` clusters this issues a
+        collective — call it only from points every rank reaches at the
+        same step count (the Trainer step hook does)."""
+        rec = self.local_record(step, nan_alerts)
+        table = None
+        ps = None
+        if kv is not None and getattr(kv, "_is_async", False):
+            ps = kv._ps()           # None when single-process
+        if ps is not None:
+            merged = ps.health_exchange(rec.tolist())
+            table = np.array([merged[r] for r in sorted(merged)],
+                             dtype=np.float64)
+        else:
+            import jax
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                table = np.asarray(
+                    multihost_utils.process_allgather(rec))
+            else:
+                table = rec[None]
+        _counter("healthmon.exchanges", "healthmon").increment()
+        return self.ingest_table(table)

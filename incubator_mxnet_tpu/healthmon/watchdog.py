@@ -1,0 +1,134 @@
+"""Training watchdogs: NaN/Inf sentinel, step-time regression, stall/hang.
+
+Three failure modes that per-step metrics alone don't surface until the
+job is already lost:
+
+* **silent NaN divergence** — the loss (or gradient norm) goes NaN and
+  training keeps "running", burning the rest of the reservation on
+  garbage. :class:`NaNSentinel` checks host-side values (loss every time
+  the loop hands one over; gradient global-norm opt-in every N steps
+  since computing it forces a device sync) and fires an alert — counter
+  + flight-ring breadcrumb + structured event — within the same step.
+* **step-time regression** — a slow ramp (fragmentation, thermal
+  throttle, a sick NIC) that no single threshold catches.
+  :class:`StepTimeRegression` keeps an EWMA of step time and flags any
+  step slower than ``factor`` x the running estimate, after a short
+  warmup so compile/first-touch steps don't trip it.
+* **stall/hang** — a deadlocked collective or a wedged input pipeline
+  looks exactly like "training is just slow" from outside.
+  :class:`StallWatchdog` is a daemon thread fed a heartbeat per
+  completed step; when no step lands within the deadline it invokes the
+  monitor's stall handler, which records the alert and triggers a
+  flight-recorder dump carrying the per-rank last-known state (the skew
+  timeline's most recent exchanged table). One fire per stall: the
+  watchdog re-arms only after progress resumes, so a long hang produces
+  one dump, not a dump per poll interval.
+
+Alert plumbing is deliberately dumb: callers pass an ``alert`` callback
+(the HealthMonitor's) that owns counters/events/flight, so these classes
+stay testable with no global state.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+__all__ = ["NaNSentinel", "StepTimeRegression", "StallWatchdog"]
+
+
+class NaNSentinel:
+    """NaN/Inf detector over host-side scalars (loss, grad norm)."""
+
+    def __init__(self, alert, on_nan: str = "alert"):
+        if on_nan not in ("alert", "raise"):
+            raise ValueError(f"on_nan must be 'alert' or 'raise', "
+                            f"got {on_nan!r}")
+        self._alert = alert
+        self.on_nan = on_nan
+        self.alerts = 0
+
+    def check(self, value, step=None, source: str = "loss") -> bool:
+        """Returns True (after alerting) when `value` is NaN/Inf.
+        `value` must already be a host scalar — callers own the decision
+        of when to pay the device sync."""
+        v = float(value)
+        if math.isfinite(v):
+            return False
+        self.alerts += 1
+        self._alert("nan_" + source,
+                    {"value": repr(v), "source": source}, step=step)
+        if self.on_nan == "raise":
+            raise FloatingPointError(
+                f"healthmon: non-finite {source} ({v!r}) at step {step}")
+        return True
+
+
+class StepTimeRegression:
+    """EWMA + threshold detector over per-step wall times."""
+
+    def __init__(self, alert, factor: float = 2.0, alpha: float = 0.3,
+                 warmup: int = 5):
+        self._alert = alert
+        self.factor = float(factor)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.ewma = None
+        self.n = 0
+        self.regressions = 0
+
+    def observe(self, dur_ms: float, step=None) -> bool:
+        """Fold one step time in; True when it regressed past
+        factor x EWMA (checked against the PRE-update estimate so the
+        spike can't mask itself)."""
+        dur_ms = float(dur_ms)
+        regressed = False
+        if self.n >= self.warmup and self.ewma is not None \
+                and dur_ms > self.factor * self.ewma:
+            self.regressions += 1
+            regressed = True
+            self._alert("step_time_regression",
+                        {"step_ms": round(dur_ms, 3),
+                         "ewma_ms": round(self.ewma, 3),
+                         "factor": self.factor}, step=step)
+        self.ewma = dur_ms if self.ewma is None else \
+            self.alpha * dur_ms + (1.0 - self.alpha) * self.ewma
+        self.n += 1
+        return regressed
+
+
+class StallWatchdog(threading.Thread):
+    """Daemon heartbeat monitor: fires `on_stall(age_s)` when no
+    heartbeat lands within `deadline_s`. Re-arms on the next beat."""
+
+    def __init__(self, deadline_s: float, on_stall,
+                 check_interval_s: float | None = None):
+        super().__init__(name="mxtpu-healthmon-watchdog", daemon=True)
+        self.deadline_s = float(deadline_s)
+        self._on_stall = on_stall
+        self._interval = (check_interval_s if check_interval_s is not None
+                          else max(0.05, min(5.0, self.deadline_s / 4.0)))
+        self._last = time.monotonic()   # enable time counts: a job that
+        self._fired = False             # hangs before step 1 still alerts
+        self._stop_ev = threading.Event()
+        self.stalls = 0
+
+    def beat(self):
+        self._last = time.monotonic()
+        self._fired = False
+
+    def run(self):
+        while not self._stop_ev.wait(self._interval):
+            age = time.monotonic() - self._last
+            if not self._fired and age > self.deadline_s:
+                self._fired = True
+                self.stalls += 1
+                try:
+                    self._on_stall(age)
+                except Exception:
+                    pass   # the watchdog must never kill the host run
+
+    def stop(self, timeout: float = 5.0):
+        self._stop_ev.set()
+        if self.is_alive():
+            self.join(timeout)

@@ -35,6 +35,7 @@ half precision for the wire. Unsupported types raise (no silent no-ops).
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
+from .. import healthmon as _hm
 from .. import optimizer as _opt
 from .. import profiler as _prof
 from ..diagnostics import flight as _flight
@@ -70,6 +72,22 @@ def _account(op: str, value) -> None:
     _prof.counter("kvstore.%s_bytes" % op).increment(nb)
     if _flight._REC is not None:
         _flight.record("collective", "kvstore.%s" % op, {"bytes": nb})
+
+
+def _timed(op: str, fn):
+    """Run one collective-surface call, feeding its entry-to-exit wall
+    time to the healthmon skew timeline (docs/observability.md). The
+    duration includes the cross-rank wait inside blocking collectives —
+    exactly the quantity straggler attribution decomposes — and the hook
+    costs one predicate check when healthmon is off."""
+    hm = _hm._HM
+    if hm is None:
+        return fn()
+    t0 = time.perf_counter()
+    try:
+        return fn()
+    finally:
+        hm.record_collective(op, (time.perf_counter() - t0) * 1e3)
 
 __all__ = ["KVStore", "create"]
 
@@ -370,8 +388,9 @@ class KVStore:
         _account("push", value)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.push", "kvstore", sync=False):
-                return self._push_impl(key, value, priority)
-        return self._push_impl(key, value, priority)
+                return _timed("push",
+                              lambda: self._push_impl(key, value, priority))
+        return _timed("push", lambda: self._push_impl(key, value, priority))
 
     def _push_impl(self, key, value, priority=0):
         if self._is_async:
@@ -440,8 +459,10 @@ class KVStore:
         _account("pull", out)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.pull", "kvstore", sync=False):
-                return self._pull_impl(key, out, priority, ignore_sparse)
-        return self._pull_impl(key, out, priority, ignore_sparse)
+                return _timed("pull", lambda: self._pull_impl(
+                    key, out, priority, ignore_sparse))
+        return _timed("pull", lambda: self._pull_impl(key, out, priority,
+                                                      ignore_sparse))
 
     def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -471,8 +492,10 @@ class KVStore:
         _account("pushpull", value)
         if _prof._ACTIVE:
             with _prof.Scope("kvstore.pushpull", "kvstore", sync=False):
-                return self._pushpull_impl(key, value, out, priority)
-        return self._pushpull_impl(key, value, out, priority)
+                return _timed("pushpull", lambda: self._pushpull_impl(
+                    key, value, out, priority))
+        return _timed("pushpull", lambda: self._pushpull_impl(
+            key, value, out, priority))
 
     def _pushpull_impl(self, key, value, out=None, priority=0):
         if self._is_async and self._optimizer is not None:

@@ -108,6 +108,7 @@ class AsyncPSTransport:
         self._stop = threading.Event()
         self._applied = {}            # server: worker rank -> applied count
         self._last_seq = {}           # server: rank -> newest applied seq
+        self._health = {}             # server: rank -> latest health record
         self._lock = threading.Lock()
         self._apply_lock = threading.Lock()  # serializes optimizer applies
         self._thread = None
@@ -221,6 +222,20 @@ class AsyncPSTransport:
             f"rank {self.rank}: {self._pushed} pushed but server applied "
             f"only {applied} after {timeout}s")
 
+    def health_exchange(self, record):
+        """healthmon skew-timeline transport for dist_async (workers are
+        NOT in lockstep, so the sync path's allgather would deadlock):
+        post this worker's fixed-width timing record to the rank-0
+        server, get back the merged {rank: record} table — best-effort
+        and possibly stale for other ranks, the async contract."""
+        record = [float(v) for v in record]
+        if self.rank == 0:
+            with self._lock:
+                self._health[0] = record
+                return {int(r): list(v) for r, v in self._health.items()}
+        merged = self._rpc("health", self.rank, record)
+        return {int(r): list(v) for r, v in merged.items()}
+
     def applied_counts(self):
         """Per-worker applied-update counts from the server."""
         if self.rank == 0:
@@ -284,6 +299,12 @@ class AsyncPSTransport:
                     elif op == "counts":
                         with self._lock:
                             reply = ("ok", dict(self._applied))
+                    elif op == "health":
+                        rank, rec = args
+                        with self._lock:
+                            self._health[int(rank)] = [float(v)
+                                                       for v in rec]
+                            reply = ("ok", dict(self._health))
                     elif op == "flush":
                         self._kv._async_queue.flush()
                         reply = ("ok", True)

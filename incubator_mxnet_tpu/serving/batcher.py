@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import profiler as _prof
 from ..diagnostics import flight as _flight
+from ..healthmon import events as _events
 from .errors import (DeadlineExceededError, QueueFullError,
                      ServerClosedError)
 
@@ -109,6 +110,10 @@ class DynamicBatcher:
         self._stopped = False          # dispatcher must exit (after drain)
         self._thread = None
         self._dispatch_seq = 0         # only the dispatcher increments
+        # liveness breadcrumbs for the deep /healthz: when did a predict
+        # last succeed, and when did the dispatcher last attempt a batch
+        self.last_response_ts = None   # wall time of last fulfilled batch
+        self.last_batch_ts = None      # wall time of last dispatch attempt
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -144,6 +149,10 @@ class DynamicBatcher:
     @property
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._q)        # len(deque) is GIL-atomic; no lock
 
     # -- admission --------------------------------------------------------
     def submit(self, x, timeout_ms=None) -> Request:
@@ -229,6 +238,7 @@ class DynamicBatcher:
                 live.append(req)
         if not live:
             return
+        self.last_batch_ts = time.time()
         try:
             x = np.stack([r.x for r in live])
             t0 = time.perf_counter()
@@ -249,6 +259,12 @@ class DynamicBatcher:
             _flight.record("serving", "serving.batch",
                            {"n": n, "bucket": self.model.bucket_for(n),
                             "exec_ms": round(exec_ms, 3)})
+        if _events._LOG is not None:
+            _events.emit("serving", "serving.batch",
+                         args={"n": n,
+                               "bucket": self.model.bucket_for(n),
+                               "exec_ms": round(exec_ms, 3)})
+        self.last_response_ts = time.time()
         done = time.perf_counter()
         bid = self._dispatch_seq
         self._dispatch_seq = bid + 1

@@ -10,9 +10,15 @@ frontend, collapsed to its essentials:
   200 with ``{"output": ..., "batch_size": n, "latency_ms": t}``, or the
   admission error's HTTP code (400 invalid, 429 queue full, 504
   deadline, 503 draining) with ``{"error": ..., "message": ...}``;
-* ``GET /healthz`` — ``{"status": "ok"|"draining", ...}`` (200 while
-  serving, 503 once draining: load balancers stop routing before the
-  listener goes away);
+* ``GET /healthz`` — a DEEP health check, not an unconditional 200:
+  ``{"status": "ok"|"degraded"|"draining", "checks": {...}}`` reporting
+  batcher liveness, queue saturation, the age of the last successful
+  predict, and the healthmon watchdog status. 200 only while genuinely
+  able to serve; 503 when draining, when the dispatcher thread is dead,
+  when the queue is saturated, or when requests are queued but no
+  predict has completed within ``MXTPU_SERVING_STALL_S`` (default 30) —
+  so load balancers stop routing to a wedged replica, not just a
+  closing one;
 * ``GET /stats`` — serving counters, batch-fill ratio, latency
   percentiles, queue depth, uptime and QPS.
 
@@ -34,6 +40,7 @@ import time
 
 import numpy as np
 
+from .. import healthmon as _healthmon
 from .. import profiler as _prof
 from .batcher import DynamicBatcher
 from .errors import InvalidInputError, ServingError
@@ -100,11 +107,8 @@ class ModelServer:
             def do_GET(self):
                 try:
                     if self.path.startswith("/healthz"):
-                        draining = server._draining
-                        self._reply(503 if draining else 200, {
-                            "status": "draining" if draining else "ok",
-                            "model": repr(server.model),
-                            "buckets": list(server.model.buckets)})
+                        code, doc = server.health()
+                        self._reply(code, doc)
                     elif self.path.startswith("/stats"):
                         self._reply(200, server.stats())
                     else:
@@ -184,6 +188,73 @@ class ModelServer:
     @property
     def address(self):
         return f"http://{self.host}:{self.port}"
+
+    # -- deep health ------------------------------------------------------
+    def health(self):
+        """(http_code, body) for /healthz — the deep check. Policy:
+
+        * draining → 503 "draining" (the graceful-shutdown signal);
+        * dispatcher thread dead → 503 (accepted requests can never
+          complete);
+        * queue saturated (depth >= limit) → 503 (every new predict
+          would be rejected 429 anyway — stop routing here);
+        * requests queued but nothing served for MXTPU_SERVING_STALL_S
+          → 503 (a wedged executable looks exactly like "slow");
+        * otherwise 200, with the same observations reported so
+          dashboards see saturation BEFORE it trips the threshold.
+
+        The healthmon watchdog status rides along as a report-only
+        section: a training-side stall in a co-hosted process is context
+        for the operator, not a reason for the LB to drop this replica.
+        """
+        now = time.time()
+        b = self.batcher
+        depth = b.queue_depth
+        saturation = depth / b.queue_limit if b.queue_limit else 0.0
+        last_ts = b.last_response_ts
+        age = (now - last_ts) if last_ts is not None else None
+        stall_s = _env_float("MXTPU_SERVING_STALL_S", 30.0)
+        checks = {
+            "batcher_alive": b.running,
+            "queue_depth": depth,
+            "queue_limit": b.queue_limit,
+            "queue_saturation": round(saturation, 3),
+            "last_predict_age_s": (round(age, 3) if age is not None
+                                   else None),
+        }
+        snap = _prof.counters()
+        checks["healthmon"] = {
+            "enabled": _healthmon.enabled(),
+            "stall_alerts": snap.get(
+                "healthmon/healthmon.stall_alerts", 0),
+            "nan_alerts": snap.get("healthmon/healthmon.nan_alerts", 0),
+        }
+        problems = []
+        if not b.running:
+            problems.append("batcher_dead")
+        if depth >= b.queue_limit:
+            problems.append("queue_saturated")
+        # stalled = work is waiting and nothing has completed recently;
+        # the reference point falls back to server start so a server
+        # whose FIRST batch wedges is caught too
+        progress_ref = max(x for x in (last_ts, b.last_batch_ts,
+                                       self._started_at, 0.0)
+                           if x is not None)
+        if depth > 0 and (now - progress_ref) > stall_s:
+            problems.append("predict_stalled")
+        if self._draining:
+            status = "draining"
+        elif problems:
+            status = "degraded"
+        else:
+            status = "ok"
+        doc = {"status": status,
+               "model": repr(self.model),
+               "buckets": list(self.model.buckets),
+               "checks": checks}
+        if problems:
+            doc["problems"] = problems
+        return (200 if status == "ok" else 503), doc
 
     # -- stats ------------------------------------------------------------
     def stats(self) -> dict:

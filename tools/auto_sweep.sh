@@ -24,6 +24,13 @@ if timeout 900 bash tools/serve_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) serve smoke FAILED (continuing; serving path suspect)" >> "$LOG"
 fi
+# healthmon smoke (CPU-only 2-proc cluster + overhead budget): the
+# cross-rank health layer must validate before any distributed sweep
+if timeout 1200 bash tools/health_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) health smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) health smoke FAILED (continuing; healthmon suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -126,6 +126,14 @@ def main(argv=None):
     else:
         coordinator = f"127.0.0.1:{_free_port()}"
 
+    # one correlation id for the whole run: every rank's healthmon event
+    # log / flight dump carries it, so `mxdiag merge` can interleave them
+    # (the launcher is the natural place to mint it — same role as the
+    # reference tracker's job id)
+    import time as _time
+    run_id = os.environ.get(
+        "MXTPU_RUN_ID", f"launch-{int(_time.time())}-{os.getpid():x}")
+
     procs = []
     threads = []
     for rank in range(n):
@@ -133,7 +141,8 @@ def main(argv=None):
         env.update(extra)
         env.update({"MXTPU_COORDINATOR": coordinator,
                     "MXTPU_NUM_PROCESSES": str(n),
-                    "MXTPU_PROCESS_ID": str(rank)})
+                    "MXTPU_PROCESS_ID": str(rank),
+                    "MXTPU_RUN_ID": run_id})
         if hosts:
             # reference-style ssh fanout: env rides the remote command line
             envs = " ".join(f"{k}={shlex.quote(v)}"

@@ -9,9 +9,18 @@ timestamps — the "what happened in the seconds before the crash" view.
 Sampler time series (`metrics.jsonl`): first/last sample, counter deltas
 and rates over the covered window.
 
+`merge`: interleave SEVERAL ranks' flight dumps and/or structured event
+logs (`mxtpu.events/1` JSONL) into one time-ordered cross-rank timeline,
+each line tagged with its rank — the post-mortem view for distributed
+failures ("rank 1 went quiet 40 s before rank 0's collective timed
+out"). `-o merged.jsonl` additionally writes the merged timeline as
+`mxtpu.events/1` records (validated by tools/trace_check.py).
+
 Usage:
     python tools/mxdiag.py DUMP.json [--events N]
     python tools/mxdiag.py metrics.jsonl
+    python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
+        mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
 from __future__ import annotations
 
@@ -124,7 +133,138 @@ def print_metrics(path: str) -> None:
               f"live {mem.get('live_arrays')}")
 
 
+# ---------------------------------------------------------------------------
+# merge: cross-rank timeline from per-rank flight dumps / event logs
+# ---------------------------------------------------------------------------
+
+def _load_timeline(path: str, fallback_rank: int):
+    """Normalize one artifact into (rank, run_id, [records]); records are
+    {ts, rank, step, kind, name, args?, src}. Event logs carry their own
+    rank/run_id per record; flight dumps are tagged from their env
+    snapshot (rank recorded at enable time) or, failing that, the
+    file's position on the command line."""
+    records = []
+    if path.endswith(".jsonl"):
+        rank, run_id = fallback_rank, None
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rec = json.loads(ln)
+                if not str(rec.get("schema", "")).startswith(
+                        "mxtpu.events/"):
+                    raise ValueError(
+                        f"{path}: not an mxtpu.events/ log (merge takes "
+                        f"event logs and flight dumps, not metrics "
+                        f"series)")
+                rank = rec.get("rank", fallback_rank)
+                run_id = rec.get("run_id", run_id)
+                records.append({
+                    "ts": rec["ts"], "rank": rank,
+                    "run_id": rec.get("run_id"),
+                    "step": rec.get("step"), "kind": rec.get("kind", "?"),
+                    "name": rec.get("name", "?"),
+                    "args": rec.get("args"), "src": path})
+        return rank, run_id, records
+    with open(path) as f:
+        doc = json.load(f)
+    if not (isinstance(doc, dict) and str(doc.get("schema", "")).startswith(
+            "mxtpu.flight/")):
+        raise ValueError(f"{path}: neither an event log nor a flight dump")
+    env = doc.get("env") or {}
+    rank = env.get("rank", fallback_rank)
+    for ev in doc.get("events") or []:
+        records.append({"ts": ev.get("ts", 0), "rank": rank, "step": None,
+                        "kind": ev.get("kind", "?"),
+                        "name": ev.get("name", "?"),
+                        "args": ev.get("args"), "src": path})
+    return rank, None, records
+
+
+def merge_timelines(paths, out_path=None):
+    """Merge-sort the artifacts by timestamp; returns the merged record
+    list (and optionally writes it as mxtpu.events/1 JSONL)."""
+    merged = []
+    run_ids = set()
+    for i, p in enumerate(paths):
+        _, rid, recs = _load_timeline(p, fallback_rank=i)
+        if rid:
+            run_ids.add(rid)
+        merged.extend(recs)
+    merged.sort(key=lambda r: r["ts"])
+    if len(run_ids) > 1:
+        print(f"merge: WARNING: inputs span {len(run_ids)} run_ids "
+              f"({sorted(run_ids)[:3]}...) — these are different runs",
+              file=sys.stderr)
+    # run_id for records that carry none (flight dumps): the inputs'
+    # consensus when they agree, else an explicit unknown — NEVER a
+    # run_id borrowed from an unrelated file (the correlation id must
+    # stay honest in the validated merged output)
+    fallback_rid = next(iter(run_ids)) if len(run_ids) == 1 else "unknown"
+    if out_path:
+        with open(out_path, "w") as f:
+            last_ts = 0.0
+            for r in merged:
+                ts = max(float(r["ts"]), last_ts)   # keep the schema's
+                last_ts = ts                        # monotonic-ts contract
+                rec = {"schema": "mxtpu.events/1", "ts": ts,
+                       "run_id": r.get("run_id") or fallback_rid,
+                       "rank": int(r["rank"]), "step": r["step"],
+                       "kind": r["kind"], "name": r["name"]}
+                if r.get("args"):
+                    rec["args"] = r["args"]
+                f.write(json.dumps(rec) + "\n")
+    return merged
+
+
+def print_merged(merged, tail=0) -> None:
+    ranks = sorted({r["rank"] for r in merged})
+    if not merged:
+        print("merge: no records")
+        return
+    t0, t_end = merged[0]["ts"], merged[-1]["ts"]
+    print(f"merged timeline: {len(merged)} records from "
+          f"{len(ranks)} rank(s) {ranks} over {t_end - t0:.3f}s "
+          f"({_fmt_ts(t0)} .. {_fmt_ts(t_end)})")
+    show = merged[-tail:] if tail else merged
+    if tail and len(merged) > tail:
+        print(f"  ... {len(merged) - tail} earlier records elided ...")
+    for r in show:
+        step = f" step={r['step']}" if r.get("step") is not None else ""
+        args = f"  {json.dumps(r['args'])}" if r.get("args") else ""
+        print(f"  {r['ts'] - t0:>9.3f}s  [rank {r['rank']}] "
+              f"{r['kind']:<10} {r['name']}{step}{args}")
+
+
+def _merge_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py merge",
+        description="interleave per-rank flight dumps / event logs into "
+                    "one cross-rank timeline")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl and/or flight-dump .json files")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the merged timeline as "
+                         "mxtpu.events/1 JSONL")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="print only the last N merged records")
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_timelines(args.paths, out_path=args.out)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"merge: {e}", file=sys.stderr)
+        return 1
+    print_merged(merged, tail=args.tail)
+    if args.out:
+        print(f"merged timeline written: {args.out}")
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "merge":
+        return _merge_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

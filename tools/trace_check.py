@@ -17,7 +17,14 @@
 * **bench result JSON** (`BENCH_*.json`) — when the result carries an
   `extra.serving` section (the serving benchmark), its latency
   histograms, percentiles, and fill-ratio/error accounting are
-  structurally validated.
+  structurally validated;
+* **structured event logs** (`healthmon.events` / ``mxtpu.events/1``
+  JSONL, including `mxdiag merge` output) — per-record schema with the
+  run_id/rank/step correlation ids, non-decreasing timestamps;
+* **healthmon counter families** — any `healthmon/*` metric appearing
+  in a flight dump or metrics series must belong to the known family
+  table with the declared kind (an unknown or re-kinded healthmon
+  metric means a producer drifted from the documented schema).
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -36,9 +43,29 @@ import sys
 
 __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_metrics_jsonl", "check_histogram_snapshot",
-           "check_bench_json", "check_file"]
+           "check_bench_json", "check_events_jsonl",
+           "check_healthmon_kinds", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
+EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
+
+# The healthmon metric families (docs/observability.md). Exporters and
+# dashboards key on these names; a producer inventing a new healthmon/*
+# metric (or flipping a kind) must update this table — that is the
+# schema-stability contract this validator enforces.
+HEALTHMON_FAMILIES = {
+    "healthmon/healthmon.steps": "counter",
+    "healthmon/healthmon.exchanges": "counter",
+    "healthmon/healthmon.nan_alerts": "counter",
+    "healthmon/healthmon.stall_alerts": "counter",
+    "healthmon/healthmon.step_time_regressions": "counter",
+    "healthmon/healthmon.straggler_flags": "counter",
+    "healthmon/healthmon.exchange_errors": "counter",
+    "healthmon/healthmon.collective_skew_ms": "gauge",
+    "healthmon/healthmon.slowest_rank": "gauge",
+    "healthmon/healthmon.step_ms_ewma": "gauge",
+    "healthmon/healthmon.grad_global_norm": "gauge",
+}
 
 
 def _is_num(x) -> bool:
@@ -164,6 +191,88 @@ def check_flight(path: str) -> list:
                 if kind == "histogram" and k in counters:
                     errors += [f"counters[{k!r}]: {e}" for e in
                                check_histogram_snapshot(counters[k])]
+        errors += check_healthmon_kinds(kinds)
+    return [f"{path}: {e}" for e in errors]
+
+
+# ---------------------------------------------------------------------------
+# healthmon counter families
+# ---------------------------------------------------------------------------
+
+def check_healthmon_kinds(kinds: dict) -> list:
+    """Every healthmon/* metric must belong to HEALTHMON_FAMILIES with
+    the declared kind."""
+    errors = []
+    for k, kind in sorted(kinds.items()):
+        if not k.startswith("healthmon/"):
+            continue
+        want = HEALTHMON_FAMILIES.get(k)
+        if want is None:
+            errors.append(f"unknown healthmon counter family {k!r} "
+                          f"(update HEALTHMON_FAMILIES if intentional)")
+        elif kind != want:
+            errors.append(f"healthmon counter {k!r} has kind {kind!r}, "
+                          f"schema says {want!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# structured event logs (mxtpu.events/1 JSONL)
+# ---------------------------------------------------------------------------
+
+def check_events_jsonl(path: str) -> list:
+    """Validate a healthmon structured event log (or a `mxdiag merge`
+    output): every record a JSON object with the versioned schema tag,
+    the run_id/rank/step correlation ids, non-empty kind/name, and
+    non-decreasing timestamps."""
+    try:
+        with open(path) as f:
+            raw_lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    if not raw_lines:
+        return [f"{path}: empty event log"]
+    errors = []
+    last_ts = None
+    for i, ln in enumerate(raw_lines, 1):
+        try:
+            rec = json.loads(ln)
+        except ValueError as e:
+            errors.append(f"line {i}: invalid JSON: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"line {i}: record must be an object")
+            continue
+        schema = rec.get("schema")
+        if not isinstance(schema, str) or \
+                not schema.startswith(EVENTS_SCHEMA_PREFIX):
+            errors.append(f"line {i}: schema must start with "
+                          f"{EVENTS_SCHEMA_PREFIX!r}, got {schema!r}")
+        if not _is_num(rec.get("ts")):
+            errors.append(f"line {i}: needs numeric 'ts', "
+                          f"got {rec.get('ts')!r}")
+        else:
+            if last_ts is not None and rec["ts"] < last_ts:
+                errors.append(f"line {i}: ts went backwards "
+                              f"({rec['ts']} < {last_ts})")
+            last_ts = rec["ts"]
+        if not isinstance(rec.get("run_id"), str) or not rec["run_id"]:
+            errors.append(f"line {i}: missing/empty 'run_id'")
+        rank = rec.get("rank")
+        if not isinstance(rank, int) or isinstance(rank, bool) or rank < 0:
+            errors.append(f"line {i}: 'rank' must be int >= 0, "
+                          f"got {rank!r}")
+        step = rec.get("step")
+        if step is not None and (not isinstance(step, int)
+                                 or isinstance(step, bool)):
+            errors.append(f"line {i}: 'step' must be int or null, "
+                          f"got {step!r}")
+        for key in ("kind", "name"):
+            if not isinstance(rec.get(key), str) or not rec[key]:
+                errors.append(f"line {i}: missing/empty {key!r}")
+        if "args" in rec and not isinstance(rec["args"], dict):
+            errors.append(f"line {i}: 'args' must be an object, "
+                          f"got {type(rec['args']).__name__}")
     return [f"{path}: {e}" for e in errors]
 
 
@@ -301,6 +410,7 @@ def check_metrics_jsonl(path: str) -> list:
         return [f"{path}: empty metrics file"]
     last_ts = None
     last_counter_vals = {}
+    seen_kinds = {}
     for i, ln in enumerate(raw_lines, 1):
         try:
             s = json.loads(ln)
@@ -317,6 +427,7 @@ def check_metrics_jsonl(path: str) -> list:
                           f"({s['ts']} < {last_ts})")
         last_ts = s["ts"]
         kinds = s.get("kinds") or {}
+        seen_kinds.update(kinds)
         for name, v in s["counters"].items():
             kind = kinds.get(name)
             if kind == "histogram":
@@ -333,6 +444,7 @@ def check_metrics_jsonl(path: str) -> list:
                 errors.append(f"line {i}: counter {name!r} decreased "
                               f"({prev} -> {v})")
             last_counter_vals[name] = v
+    errors += check_healthmon_kinds(seen_kinds)
     return [f"{path}: {e}" for e in errors]
 
 
@@ -405,6 +517,15 @@ def check_file(path: str) -> list:
     if low.endswith((".prom", ".txt")):
         return check_prom(path)
     if low.endswith(".jsonl"):
+        # events vs metrics series: event records are self-describing
+        # (every line carries the schema tag), so sniff the first line
+        try:
+            with open(path) as f:
+                first = f.readline()
+        except OSError as e:
+            return [f"{path}: unreadable: {e}"]
+        if f'"{EVENTS_SCHEMA_PREFIX}' in first:
+            return check_events_jsonl(path)
         return check_metrics_jsonl(path)
     try:
         with open(path) as f:
