@@ -36,6 +36,7 @@ from incubator_mxnet_tpu.models import get_model  # noqa: E402
 from incubator_mxnet_tpu.parallel import FusedTrainStep  # noqa: E402
 
 V100_BASELINE_IMG_S = 390.0  # MXNet ResNet-50 fp32, single V100 (published)
+RESNET50_FLOPS_PER_SAMPLE = 3 * 4.09e9   # fwd+bwd, 224x224 (both benches)
 
 # updated once the model is resolved; all error paths report through this
 _CURRENT_METRIC = "resnet50_imagenet_images_per_sec_per_chip"
@@ -310,7 +311,7 @@ def _build_resnet(batch, dtype):
         x = x.astype("bfloat16")
     y = nd.array(np.random.randint(0, 1000, batch))
     L = gluon.loss.SoftmaxCrossEntropyLoss()
-    flops_per_sample = 3 * 4.09e9                   # fwd+bwd, 224x224
+    flops_per_sample = RESNET50_FLOPS_PER_SAMPLE
     return net, L, x, y, flops_per_sample, "resnet50_imagenet"
 
 
@@ -428,6 +429,14 @@ def _build_transformer_lm(batch, dtype):
 _BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
                  "lenet": _build_lenet, "ssd": _build_ssd,
                  "transformer_lm": _build_transformer_lm}
+
+
+def _mfu(samples_per_s, flops_per_sample, dtype):
+    """Model FLOPs utilization: achieved model FLOP/s over the chip's
+    peak (v5e: 197 Tf bf16 / 99 Tf f32) — ROADMAP item 1's regression
+    metric, emitted into every training BENCH json."""
+    peak = 197e12 if dtype == "bfloat16" else 99e12
+    return samples_per_s * flops_per_sample / peak
 
 # per-sample input shapes for the serving bench (BENCH_MODEL=serving)
 _SERVING_SHAPES = {"lenet": (1, 28, 28), "resnet50_v1": (224, 224, 3)}
@@ -743,6 +752,8 @@ def _record_data_bench(mode, batch, steps, dtype):
         "vs_baseline": round(e2e / V100_BASELINE_IMG_S, 3),
         "extra": {"model": f"resnet50_{mode}", "batch": batch,
                   "dtype": dtype, "steps": steps,
+                  "mfu": round(_mfu(e2e, RESNET50_FLOPS_PER_SAMPLE,
+                                    dtype), 6),
                   "data_path_img_s": round(data_rate, 2),
                   "bottleneck": bottleneck,
                   "decode_threads": threads,
@@ -789,6 +800,11 @@ def main():
     acquire_backend(attempts=_init_attempts,
                     per_attempt_timeout=_init_per)
     init_watchdog.cancel()
+    # persistent-cache integrity canary (runtime/cache_guard): validate
+    # the cache READ path now — before the big compile — so a corrupt
+    # cache recompiles fresh instead of training on garbage executables
+    from incubator_mxnet_tpu.runtime import cache_guard as _cg
+    _log(f"compile-cache canary ok={_cg.check()}")
     # Front-load the one-time pallas on-device self-test (tiny compiles)
     # under its own deadline, so a Mosaic failure surfaces HERE as a logged
     # fallback to the XLA path — not mid-way through the big model compile.
@@ -844,17 +860,43 @@ def main():
                                                                    dtype)
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
                               multi_precision=(dtype == "bfloat16"))
-    step = FusedTrainStep(net, L, opt,
-                          remat=os.environ.get("BENCH_REMAT") == "1")
+    # BENCH_LOOP_CHUNK / MXTPU_LOOP_CHUNK > 1: steady phase runs through
+    # the whole-loop executor (mxtpu.trainloop) — N micro-steps per
+    # dispatch, device-side double-buffered prefetch, per-micro-step lr;
+    # the io.* / trainloop.* counter families land in extra.counters.
+    loop_k = int(os.environ.get("BENCH_LOOP_CHUNK",
+                                os.environ.get("MXTPU_LOOP_CHUNK", "0"))
+                 or "0")
+    loop = None
+    if loop_k > 1:
+        from incubator_mxnet_tpu.trainloop import TrainLoop
+        loop = TrainLoop(net, L, opt, chunk=loop_k,
+                         remat=os.environ.get("BENCH_REMAT") == "1")
+        step = loop.step
+    else:
+        step = FusedTrainStep(net, L, opt,
+                              remat=os.environ.get("BENCH_REMAT") == "1")
 
     # compile + warmup. NOTE: through the axon relay block_until_ready() does
     # not synchronize; a host value fetch is the only true barrier. Steps
     # chain through updated params, so fetching the final loss times them all.
-    _log("compiling fused train step (first call)")
+    # In loop mode the CHUNK program is the only one the steady phase runs,
+    # so it is the one compiled/warmed (the single-step program is never
+    # built — jax.jit is lazy).
     from incubator_mxnet_tpu import profiler as prof
-    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
-        lambda: float(step(x, y)),
-        lambda: float(step(x, y)))
+    if loop is not None:
+        import jax.numpy as jnp
+        loop_xs = jnp.broadcast_to(x._data, (loop_k,) + x._data.shape)
+        loop_ys = jnp.broadcast_to(y._data, (loop_k,) + y._data.shape)
+        _log(f"compiling whole-loop chunk (k={loop_k})")
+        trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+            lambda: float(loop.run_chunk(loop_xs, loop_ys)[loop_k - 1]),
+            lambda: float(loop.run_chunk(loop_xs, loop_ys)[loop_k - 1]))
+    else:
+        _log("compiling fused train step (first call)")
+        trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+            lambda: float(step(x, y)),
+            lambda: float(step(x, y)))
 
     # BENCH_K > 1: dispatch k micro-steps as ONE XLA program (lax.scan in
     # FusedTrainStep.run_k) — amortizes per-step relay/host dispatch
@@ -863,7 +905,28 @@ def main():
     # same config (PERF.md) — the 62 ms step is device-bound, not
     # dispatch-bound, so the scan only adds compile surface.
     k = int(os.environ.get("BENCH_K", "1"))
-    if k > 1:
+    if loop is not None:
+        chunks = max(1, steps // loop_k)
+        _log(f"timing {chunks} chunks x {loop_k} micro-steps through the "
+             f"whole-loop executor @ batch {batch} {dtype} "
+             f"(in_program_lr={loop.in_program_lr})")
+
+        def batches():
+            while True:
+                yield x, y
+
+        with loop._prefetcher(batches(), cycle=False) as pf:
+            t0 = time.time()
+            with prof.record_function("bench.steady", "bench", sync=False):
+                for _ in range(chunks):
+                    xb, yb = next(pf)
+                    losses = loop.run_chunk(xb, yb)
+                    _healthmon_mark_step()   # one mark per dispatched chunk
+                loss_val = float(losses[loop_k - 1])    # host fetch = barrier
+            dt = time.time() - t0
+        steps = chunks * loop_k
+        k = loop_k
+    elif k > 1:
         import jax.numpy as jnp
         xs = jnp.broadcast_to(x._data, (k,) + x._data.shape)
         ys = jnp.broadcast_to(y._data, (k,) + y._data.shape)
@@ -898,8 +961,7 @@ def main():
         _hm_mod.observe_loss(loss_val)
 
     img_s = batch * steps / dt
-    peak = 197e12 if dtype == "bfloat16" else 99e12  # v5e chip
-    mfu = img_s * flops_per_sample / peak
+    mfu = _mfu(img_s, flops_per_sample, dtype)
 
     watchdog.cancel()
     # keep the headline metric name stable across rounds for the driver
@@ -916,7 +978,10 @@ def main():
                         if model == "resnet50" else None),
         "extra": {"model": tag, "batch": batch, "dtype": dtype,
                   "steps": steps, "k_per_dispatch": k,
-                  "mfu": round(mfu, 4),
+                  "mfu": round(mfu, 6),
+                  "loop_chunk": loop_k if loop is not None else None,
+                  "in_program_lr": (loop.in_program_lr
+                                    if loop is not None else None),
                   "k1_control_img_s": None,
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
@@ -931,7 +996,10 @@ def main():
     # thread watchdog that emits the MAIN result and exits cleanly —
     # SIGALRM can't interrupt a C-level relay hang, and the control must
     # never destroy an already-measured number. BENCH_K1_CONTROL=0 skips.
-    if k > 1 and os.environ.get("BENCH_K1_CONTROL", "1") == "1":
+    # (loop mode skips the control: its single-step program was never
+    # compiled, so the control would time a fresh compile, not dispatch)
+    if k > 1 and loop is None \
+            and os.environ.get("BENCH_K1_CONTROL", "1") == "1":
         import threading
 
         # single-emit: Timer.cancel() can't stop an in-flight callback, so

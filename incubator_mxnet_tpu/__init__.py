@@ -55,6 +55,8 @@ from . import engine
 from . import diagnostics
 from . import healthmon
 from . import serving
+from . import trainloop
+from .trainloop import TrainLoop
 from . import test_utils
 from . import utils
 

@@ -367,6 +367,7 @@ class HybridBlock(Block):
         return _unflatten_out(entry.out_treedef, list(real))
 
     def _build_cache(self, params, args, training):
+        from ..ops import select as _sel
         sub_ids = [id(p) for p in params]
         n_p = len(params)
         out_info = {}
@@ -387,10 +388,18 @@ class HybridBlock(Block):
 
         jitted = jax.jit(raw_fn)
         # Abstract trace once to learn output structure (no device work).
+        # The kernel-selection layer (ops/select) logs which pallas
+        # kernels this signature's trace picked; the decisions go to the
+        # flight recorder so "which kernels did my model get" is
+        # answerable from a crash dump or a bench artifact.
         p_raws = [p.data()._data for p in params]
         dummy_key = jax.random.PRNGKey(0)
-        shapes = jax.eval_shape(raw_fn, dummy_key, *p_raws,
-                                *[a._data for a in args])
+        with _sel.capture() as kernel_log:
+            shapes = jax.eval_shape(raw_fn, dummy_key, *p_raws,
+                                    *[a._data for a in args])
+        if kernel_log and _flight._REC is not None:
+            _flight.record("compile", "pallas.selection:" + self.name,
+                           {"decisions": kernel_log[:32]})
         n_aux = len(out_info["aux_params"])
         n_real = len(shapes) - n_aux
         return _CacheEntry(raw_fn, jitted, n_real, n_aux,

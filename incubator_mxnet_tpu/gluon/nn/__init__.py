@@ -274,16 +274,20 @@ class BatchNorm(HybridBlock):
             p.shape = (c,)
 
     def forward(self, x):
+        return self._forward_impl(x, act=None)
+
+    def _forward_impl(self, x, act=None):
         from ..symbolize import is_symbol
         if is_symbol(x):  # symbol trace (gluon/symbolize.py)
             from ..symbolize import sym_call
-            return sym_call(
+            out = sym_call(
                 "BatchNorm", out_index=0, data=x, gamma=self.gamma.data(),
                 beta=self.beta.data(), moving_mean=self.running_mean.data(),
                 moving_var=self.running_var.data(), axis=self._axis,
                 eps=self._eps, momentum=self._momentum,
                 fix_gamma=not self._scale,
                 use_global_stats=self._use_global_stats)
+            return out.relu() if act == "relu" else out
         training = autograd.is_training() and not self._use_global_stats
         axis, eps, mom = self._axis, self._eps, self._momentum
         fix_gamma = not self._scale
@@ -292,11 +296,12 @@ class BatchNorm(HybridBlock):
             return _raw.batch_norm(xr, gr, br, mmr, mvr, axis=axis, eps=eps,
                                    momentum=mom, training=training,
                                    use_global_stats=self._use_global_stats,
-                                   fix_gamma=fix_gamma)
+                                   fix_gamma=fix_gamma, act=act)
 
         y, nm, nv = _apply(f, [x, self.gamma.data(), self.beta.data(),
                                self.running_mean.data(), self.running_var.data()],
-                           n_out=3, name="BatchNorm")
+                           n_out=3, name="BatchNorm" if act is None
+                           else "BatchNorm" + act.upper())
         if training:
             self.running_mean.update_aux(nm._data)
             self.running_var.update_aux(nv._data)
@@ -306,12 +311,14 @@ class BatchNorm(HybridBlock):
 class BatchNormReLU(BatchNorm):
     """BatchNorm with a fused trailing ReLU (parity:
     gluon.nn.BatchNormReLU / the reference's fused CUDNN_BATCHNORM_OPS
-    path). On TPU the fusion is XLA's job — the relu rides in the same
-    compiled computation as the normalization — so this class is pure
-    API parity with identical numerics."""
+    path). The normalize+affine+relu tail routes through the kernel-
+    selection layer (ops/select.py): on qualifying channels-last shapes
+    it runs as ONE pallas HBM pass (scale_shift_act — the stats
+    reduction stays XLA in training mode); elsewhere XLA fuses the relu
+    into the normalization chain, numerics unchanged."""
 
     def forward(self, x):
-        return super().forward(x).relu()
+        return self._forward_impl(x, act="relu")
 
 
 class LayerNorm(HybridBlock):

@@ -194,7 +194,8 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
                  overlap_comm=False, comm_bucket_bytes=0,
-                 comm_credit_bytes=4 << 20, fused_update=None):
+                 comm_credit_bytes=4 << 20, fused_update=None,
+                 loop_chunk=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -239,6 +240,17 @@ class Trainer:
         if fused_update is None:
             fused_update = os.environ.get("MXTPU_FUSED_UPDATE", "1") != "0"
         self._fused_update = bool(fused_update)
+        # loop_chunk=N marks this trainer for WHOLE-LOOP execution: the
+        # trainloop executor (mxtpu.trainloop.TrainLoop) compiles N
+        # micro-steps (fwd+bwd+collective+update+lr schedule) into one
+        # donated XLA program and reads this chunk size when constructed
+        # from the Trainer. Env default: MXTPU_LOOP_CHUNK=<n>. The eager
+        # step()/update() path ignores it (that path is per-step by
+        # construction).
+        if loop_chunk is None:
+            env = os.environ.get("MXTPU_LOOP_CHUNK", "").strip()
+            loop_chunk = int(env) if env else None
+        self.loop_chunk = int(loop_chunk) if loop_chunk else None
         self._kv_params_init = False
         self._sched = None
         if overlap_comm:

@@ -16,7 +16,9 @@ from ..ndarray import NDArray
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "BucketSentenceIter", "LibSVMIter",
            "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter",
-           "ImageDetRecordIter"]
+           "ImageDetRecordIter", "DevicePrefetcher"]
+
+from .prefetch import DevicePrefetcher  # noqa: E402  (device-side buffering)
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
 DataDesc.__new__.__defaults__ = (np.float32, "NCHW")

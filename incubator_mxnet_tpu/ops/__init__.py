@@ -20,6 +20,7 @@ from .box import (box_iou, box_nms, MultiBoxPrior, MultiBoxTarget,
                   MultiBoxDetection)
 
 __all__ = ["FullyConnected", "Convolution", "Deconvolution", "Pooling",
+           "ConvBNReLU",
            "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Activation",
            "Dropout", "L2Normalization", "softmax_cross_entropy", "smooth_l1",
            "UpSampling", "multihead_attention", "box_iou", "box_nms",
@@ -102,6 +103,28 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, pad=None,
                       [data, weight], name="Deconvolution")
     return _apply(lambda x, w, b: _raw.conv_transpose(x, w, b, **kw),
                   [data, weight, bias], name="Deconvolution")
+
+
+def ConvBNReLU(data, weight, gamma, beta, moving_mean, moving_var, *,
+               eps=1e-5, stride=None, pad=None, dilate=None, num_group=1,
+               layout="NHWC", act_type="relu"):
+    """Fused conv + BatchNorm + activation — the inference/serving hot
+    path (reference analogue: cuDNN's fused ConvBiasActivation). In
+    predict mode, qualifying shapes (ops/select.py) run the pallas fused
+    kernel (1x1 convs as one matmul+epilogue program); otherwise the op
+    is the exact conv→BN→act chain. Moving stats are read, never
+    written — training graphs should keep separate Conv/BatchNorm blocks
+    so the stats update (this op discards batch-stat updates)."""
+    training = autograd.is_training()
+
+    def f(x, w, g, b, mm, mv):
+        return _raw.conv_bn_relu(x, w, g, b, mm, mv, eps=eps, stride=stride,
+                                 pad=pad, dilate=dilate,
+                                 num_group=num_group, layout=layout,
+                                 act=act_type, training=training)
+
+    return _apply(f, [data, weight, gamma, beta, moving_mean, moving_var],
+                  name="ConvBNReLU")
 
 
 def Pooling(data, pool_type="max", kernel=(2, 2), stride=None, pad=None,

@@ -400,9 +400,15 @@ def pooling(x, pool_type="max", kernel=(2, 2), stride=None, pad=None,
 
 def batch_norm(x, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
                momentum=0.9, training=True, use_global_stats=False,
-               fix_gamma=False):
+               fix_gamma=False, act=None):
     """BatchNorm (reference src/operator/nn/batch_norm.cc). Returns
-    (y, new_moving_mean, new_moving_var); caller threads state."""
+    (y, new_moving_mean, new_moving_var); caller threads state.
+
+    ``act`` fuses a trailing activation (BatchNormReLU): on qualifying
+    channels-last shapes the normalize+affine+act tail runs as ONE pallas
+    HBM pass (ops/pallas/conv_bn_relu.scale_shift_act) — the stats
+    reduction (training mode) stays XLA; otherwise the activation rides
+    the XLA chain."""
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     red = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
@@ -416,24 +422,58 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, *, axis=1, eps=1e-5,
     else:
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
+    from . import select as _sel
+    if act is not None and _sel.scale_shift_act(x, axis, act=act):
+        from . import pallas as _pallas
+        scale, shift = _pallas.fold_bn(gamma, beta, mean, var, eps)
+        return (_pallas.scale_shift_act(x, scale, shift, act=act),
+                new_mm, new_mv)
     inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
     y = (x - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape)
     y = y * gamma.reshape(bshape).astype(x.dtype) + beta.reshape(bshape).astype(x.dtype)
+    if act is not None:
+        y = activation(y, act)
     return y, new_mm, new_mv
+
+
+def conv_bn_relu(x, weight, gamma, beta, moving_mean, moving_var, *,
+                 eps=1e-5, stride=None, pad=None, dilate=None, num_group=1,
+                 layout="NHWC", act="relu", training=False):
+    """Fused conv+BN+activation (inference hot path). Qualifying calls
+    (ops/select.py: inference BN, NHWC, ungrouped) run the pallas fused
+    kernel — 1x1 convs as one matmul+epilogue program, other geometries
+    as XLA conv + fused epilogue; everything else falls back to the
+    unfused conv → batch_norm(act=...) chain with identical semantics.
+    Returns y only (moving stats are unchanged by inference BN; training
+    callers get the updated stats from the fallback chain via
+    batch_norm)."""
+    nsp = x.ndim - 2
+    stride = tuple(stride or (1,) * nsp)
+    pad = tuple(pad or (0,) * nsp)
+    from . import select as _sel
+    if (not training and nsp == 2
+            and _sel.conv_bn_relu(x, weight, stride, pad, dilate, num_group,
+                                  layout, training, act=act)):
+        from . import pallas as _pallas
+        return _pallas.conv_bn_relu(x, weight, gamma, beta, moving_mean,
+                                    moving_var, eps=eps, stride=stride,
+                                    pad=pad, act=act)
+    y = conv(x, weight, None, stride=stride, pad=pad, dilate=dilate,
+             num_group=num_group, layout=layout)
+    caxis = -1 if layout.endswith("C") and layout[1] != "C" else 1
+    y, _, _ = batch_norm(y, gamma, beta, moving_mean, moving_var,
+                         axis=caxis, eps=eps, training=training, act=act)
+    return y
 
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     """LayerNorm (reference src/operator/nn/layer_norm.cc). Stats in f32 for
-    bf16 stability, one fused XLA chain. Last-axis case dispatches to the
-    fused pallas kernel on TPU (ops/pallas/layer_norm.py)."""
-    if axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+    bf16 stability, one fused XLA chain. Qualifying shapes dispatch to the
+    fused pallas kernel through the selection layer (ops/select.py)."""
+    from . import select as _sel
+    if _sel.layer_norm(x, gamma, axis):
         from . import pallas as _pallas
-        # on a real TPU (canonical or plugin platform) only 128-lane-
-        # aligned widths go to the Mosaic kernel; off-TPU interpret mode
-        # takes any shape
-        if _pallas.enabled() and (not _pallas.is_tpu()
-                                  or x.shape[-1] % 128 == 0):
-            return _pallas.layer_norm(x, gamma, beta, eps)
+        return _pallas.layer_norm(x, gamma, beta, eps)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axis, keepdims=True)
     var = jnp.var(xf, axis=axis, keepdims=True)
@@ -559,10 +599,10 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
     scaled-dot-product, merges heads. Reference: src/operator/contrib/
     transformer.cc (interleaved_matmul_*).
 
-    Fast path: when no custom mask/dropout is active, dispatches to the
-    pallas flash-attention kernel (ops/pallas/) — O(L) memory, scores stay
-    in VMEM."""
-    from . import pallas as _pallas
+    Fast path: qualifying calls (no custom mask/dropout — see
+    ops/select.py) dispatch to the pallas flash-attention kernel
+    (ops/pallas/) — O(L) memory, scores stay in VMEM."""
+    from . import select as _sel
 
     b, lq, d = q.shape
     lk = k.shape[1]
@@ -572,8 +612,8 @@ def multihead_attention(q, k, v, num_heads, mask=None, dropout_rate=0.0,
     def split(x, l):
         return x.reshape(b, l, num_heads, hd).transpose(0, 2, 1, 3)
 
-    if (mask is None and not (dropout_rate > 0.0 and training)
-            and _pallas.enabled()):
+    if _sel.flash_attention(mask, dropout_rate > 0.0 and training):
+        from . import pallas as _pallas
         out = _pallas.flash_attention(split(q, lq), split(k, lk), split(v, lk),
                                       causal=causal, scale=scale)
         return out.transpose(0, 2, 1, 3).reshape(b, lq, d)

@@ -13,6 +13,7 @@ tiling bug take down a long training/bench run mid-compile.
 """
 from .flash_attention import flash_attention
 from .layer_norm import layer_norm
+from .conv_bn_relu import conv_bn_relu, scale_shift_act, fold_bn
 
 import os
 import sys
@@ -21,7 +22,8 @@ import warnings
 
 import jax
 
-__all__ = ["flash_attention", "layer_norm", "enabled", "kernels_ok",
+__all__ = ["flash_attention", "layer_norm", "conv_bn_relu",
+           "scale_shift_act", "fold_bn", "enabled", "kernels_ok",
            "is_tpu"]
 
 # tri-state: None = not yet tested, True/False = verdict for this process
@@ -45,13 +47,25 @@ def _truthy(name):
 
 def enabled() -> bool:
     """Use pallas kernels for framework ops? On by default on TPU (gated by
-    the one-time on-device self-test); set MXTPU_FORCE_PALLAS=1 to exercise
-    interpret-mode kernels off-TPU, or MXTPU_NO_PALLAS=1 to force the plain
-    XLA path everywhere."""
+    the one-time on-device self-test). MXTPU_PALLAS is the master switch:
+    ``0`` forces the plain XLA path everywhere (the escape hatch);
+    ``1`` is explicit-on (TPU keeps the self-test gate, off-TPU runs
+    interpret-mode kernels); ``force`` selects kernels everywhere with
+    no self-test gate (what the CPU parity tests use). MXTPU_NO_PALLAS=1
+    / MXTPU_FORCE_PALLAS=1 are the legacy spellings and keep working.
+    Per-call-site qualification
+    (shape/dtype/layout) lives in ops/select.py on top of this switch."""
+    master = os.environ.get("MXTPU_PALLAS", "").strip().lower()
+    if master in ("0", "false", "off"):
+        return False
     if _truthy("MXTPU_NO_PALLAS"):
         return False
-    if _truthy("MXTPU_FORCE_PALLAS"):
+    if master == "force" or _truthy("MXTPU_FORCE_PALLAS"):
         return True
+    if master in ("1", "true", "on"):
+        # explicit on: TPU keeps the self-test gate; off-TPU this means
+        # interpret-mode kernels (the MXTPU_*=1 spelling must not no-op)
+        return kernels_ok() if is_tpu() else True
     return is_tpu() and kernels_ok()
 
 

@@ -1,0 +1,178 @@
+"""Kernel-selection layer: ONE place that decides, per call site, whether a
+hand-written Pallas kernel (ops/pallas/) replaces the plain XLA
+formulation of a hot op.
+
+Every framework path that can hit a Pallas kernel — eager ops, the
+`hybridize()` CachedOp trace, and the FusedTrainStep/TrainLoop whole-loop
+trace — routes its decision through these predicates, so the
+qualification rules (platform, dtype, shape alignment) live in one table
+instead of being re-derived inline at each call site, and every decision
+is observable:
+
+* counters ``pallas.selected.<kernel>`` / ``pallas.rejected.<kernel>``
+  (domain ``ops``) count decisions — once per TRACE (the CachedOp build
+  traces a signature twice: the eval_shape structure probe, then the
+  first jit dispatch), in eager mode once per call — directional
+  indicators, not exact compile counts;
+* :class:`capture` collects the decisions made while tracing a
+  hybridized block, and `HybridBlock._build_cache` attaches them to the
+  compile's flight-recorder record, so "which kernels did my model
+  actually get" is answerable from a flight dump.
+
+Escape hatches (checked by ``pallas.enabled()``):
+
+* ``MXTPU_PALLAS=0``  — master off switch: plain XLA everywhere;
+* ``MXTPU_PALLAS=force`` / ``MXTPU_FORCE_PALLAS=1`` — select kernels
+  off-TPU too (interpret mode; what the CPU parity tests use);
+* ``MXTPU_NO_PALLAS=1`` — legacy spelling of the off switch.
+
+Selection table (docs/trainloop.md renders this):
+
+===============  =========================================================
+kernel           qualifies when
+===============  =========================================================
+flash_attention  pallas enabled; no additive mask; no attention-weight
+                 dropout in training mode (the kernel keeps scores in
+                 VMEM and applies no dropout)
+layer_norm       pallas enabled; normalized axis is the LAST axis;
+                 1-D gamma; on real TPU the width is 128-lane aligned
+scale_shift_act  pallas enabled; channels-last input (the BatchNorm+ReLU
+                 epilogue: one HBM pass for normalize+affine+act); on
+                 real TPU channel count 128-lane aligned
+conv_bn_relu     pallas enabled; inference-style BN (moving stats);
+                 NHWC; 1x1/stride-1/no-pad conv runs as one fused
+                 matmul+epilogue kernel, any other geometry keeps the
+                 XLA conv and fuses only the epilogue
+===============  =========================================================
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import profiler as _prof
+
+__all__ = ["flash_attention", "layer_norm", "scale_shift_act",
+           "conv_bn_relu", "capture", "selection_table"]
+
+_tls = threading.local()
+
+
+class capture:
+    """Collect the selection decisions made on this thread inside the
+    scope (used by HybridBlock._build_cache to attach the traced block's
+    kernel choices to its compile record). Nestable; each scope sees only
+    its own decisions."""
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "log", None)
+        _tls.log = []
+        return _tls.log
+
+    def __exit__(self, *exc):
+        _tls.log = self._prev
+        return False
+
+
+def _decide(kernel: str, ok: bool, reason: str) -> bool:
+    _prof.counter(
+        ("pallas.selected." if ok else "pallas.rejected.") + kernel,
+        "ops").increment()
+    log = getattr(_tls, "log", None)
+    if log is not None:
+        log.append({"kernel": kernel, "selected": bool(ok),
+                    "reason": reason})
+    return ok
+
+
+def _enabled():
+    from . import pallas as _pallas
+    return _pallas.enabled()
+
+
+def _on_tpu():
+    from . import pallas as _pallas
+    return _pallas.is_tpu()
+
+
+def flash_attention(mask, dropout_active: bool) -> bool:
+    """Qualify the pallas flash-attention kernel for a multihead-attention
+    call (O(L) memory, scores stay in VMEM)."""
+    if not _enabled():
+        return False
+    if mask is not None:
+        return _decide("flash_attention", False, "explicit mask")
+    if dropout_active:
+        return _decide("flash_attention", False, "attention dropout")
+    return _decide("flash_attention", True, "ok")
+
+
+def layer_norm(x, gamma, axis) -> bool:
+    """Qualify the fused pallas layernorm (one HBM pass, f32 stats)."""
+    if not _enabled():
+        return False
+    if axis not in (-1, x.ndim - 1) or gamma.ndim != 1:
+        return _decide("layer_norm", False, "non-last-axis")
+    if _on_tpu() and x.shape[-1] % 128:
+        return _decide("layer_norm", False,
+                       f"width {x.shape[-1]} not 128-lane aligned")
+    return _decide("layer_norm", True, "ok")
+
+
+# activations the fused epilogue kernel implements; anything else keeps
+# the XLA chain (which supports the full _ACTIVATIONS table)
+_EPILOGUE_ACTS = (None, "relu", "relu6")
+
+
+def scale_shift_act(x, channel_axis, act=None) -> bool:
+    """Qualify the fused scale+shift+activation epilogue (the
+    BatchNorm[+ReLU] tail as one HBM pass) — channels-last layouts only;
+    the per-channel scale/shift broadcast along the last axis maps onto
+    lanes."""
+    if not _enabled():
+        return False
+    if act not in _EPILOGUE_ACTS:
+        return _decide("scale_shift_act", False, f"act {act!r}")
+    if channel_axis % x.ndim != x.ndim - 1:
+        return _decide("scale_shift_act", False, "channels not last")
+    if _on_tpu() and x.shape[-1] % 128:
+        return _decide("scale_shift_act", False,
+                       f"channels {x.shape[-1]} not 128-lane aligned")
+    return _decide("scale_shift_act", True, "ok")
+
+
+def conv_bn_relu(x, weight, stride, pad, dilate, num_group,
+                 layout, training: bool, act="relu") -> bool:
+    """Qualify the fused conv+BN+relu path (inference hot path: the conv
+    epilogue applies the folded BN scale/shift + relu in one pass; 1x1
+    convs run entirely as a fused pallas matmul)."""
+    if not _enabled():
+        return False
+    if act not in _EPILOGUE_ACTS:
+        return _decide("conv_bn_relu", False, f"act {act!r}")
+    if training:
+        # training-mode BN normalizes with CURRENT batch stats of the conv
+        # output — a second pass by construction; the scale_shift_act
+        # epilogue covers that case separately
+        return _decide("conv_bn_relu", False, "training-mode batch stats")
+    if layout != "NHWC":
+        return _decide("conv_bn_relu", False, f"layout {layout}")
+    if num_group != 1:
+        return _decide("conv_bn_relu", False, "grouped conv")
+    if dilate is not None and any(d != 1 for d in dilate):
+        return _decide("conv_bn_relu", False, "dilated conv")
+    if _on_tpu() and (x.shape[-1] % 128 or weight.shape[-1] % 128):
+        return _decide("conv_bn_relu", False,
+                       "channels not 128-lane aligned")
+    return _decide("conv_bn_relu", True, "ok")
+
+
+def selection_table():
+    """The qualification rules as data (docs/tests): kernel -> rule."""
+    return {
+        "flash_attention": "no mask, no attention-weight dropout",
+        "layer_norm": "last-axis, 1-D gamma; TPU: width % 128 == 0",
+        "scale_shift_act": "channels-last; TPU: channels % 128 == 0",
+        "conv_bn_relu": ("inference BN, NHWC, ungrouped/undilated; "
+                         "TPU: in/out channels % 128 == 0; 1x1/s1 fully "
+                         "fused, other geometries fuse the epilogue"),
+    }

@@ -1,4 +1,16 @@
-"""LR schedulers (parity: python/mxnet/lr_scheduler.py)."""
+"""LR schedulers (parity: python/mxnet/lr_scheduler.py).
+
+Every stock scheduler additionally exposes :meth:`LRScheduler.as_jax` — a
+PURE jax-traceable closed form of the schedule, ``fn(t) -> lr`` over a
+traced ``num_update``. The whole-loop executor (mxtpu.trainloop) compiles
+it INSIDE the train program so each micro-step of a k-step chunk sees its
+own exact lr without a host round trip; custom subclasses that don't
+override ``as_jax`` fall back to a host-computed per-micro-step lr table
+(still step-exact, just not host-free). The closed form is evaluated
+against the scheduler's CURRENT state, so stateful schedulers
+(Factor/MultiFactor) hand off mid-run correctly as long as ``t`` keeps
+moving forward — the same contract the stateful host path has.
+"""
 from __future__ import annotations
 
 import math
@@ -26,6 +38,27 @@ class LRScheduler:
             return self.warmup_begin_lr
         raise ValueError(self.warmup_mode)
 
+    def _jax_warmup(self, t, main_lr):
+        """Wrap a traced main-schedule lr with the warmup ramp (pure
+        analogue of get_warmup_lr; f32 math like the host path)."""
+        import jax.numpy as jnp
+        if not self.warmup_steps:
+            return main_lr
+        if self.warmup_mode == "linear":
+            w = (self.warmup_begin_lr
+                 + (self.warmup_final_lr - self.warmup_begin_lr)
+                 * t / self.warmup_steps)
+        else:                                  # constant
+            w = jnp.full_like(main_lr, self.warmup_begin_lr)
+        return jnp.where(t < self.warmup_steps, w, main_lr)
+
+    def as_jax(self):
+        """Pure traceable form ``fn(t) -> lr`` (t = traced num_update),
+        or None when this scheduler has no closed form (custom
+        subclasses): callers then fall back to host-side per-step
+        sampling."""
+        return None
+
     def __call__(self, num_update):
         raise NotImplementedError
 
@@ -47,6 +80,22 @@ class FactorScheduler(LRScheduler):
             self.base_lr = max(self.base_lr * self.factor, self.stop_factor_lr)
         return self.base_lr
 
+    def as_jax(self):
+        import jax.numpy as jnp
+        # closed form relative to the CURRENT state: the host loop drops
+        # once per crossed `step` boundary, i.e. floor((u-1)/step) total
+        # drops, of which count/step already happened
+        base, factor = float(self.base_lr), float(self.factor)
+        stop, step = float(self.stop_factor_lr), int(self.step)
+        done = self.count // step
+
+        def fn(t):
+            t = jnp.asarray(t, jnp.float32)
+            drops = jnp.maximum(jnp.floor((t - 1.0) / step) - done, 0.0)
+            lr = jnp.maximum(base * factor ** drops, stop)
+            return self._jax_warmup(t, lr.astype(jnp.float32))
+        return fn
+
 
 class MultiFactorScheduler(LRScheduler):
     def __init__(self, step, factor=1.0, base_lr=0.01, **kwargs):
@@ -65,6 +114,20 @@ class MultiFactorScheduler(LRScheduler):
             self.cur_step_ind += 1
         return self.base_lr
 
+    def as_jax(self):
+        import jax.numpy as jnp
+        base, factor = float(self.base_lr), float(self.factor)
+        remaining = jnp.asarray(self.step[self.cur_step_ind:],
+                                jnp.float32)
+
+        def fn(t):
+            t = jnp.asarray(t, jnp.float32)
+            drops = (jnp.sum(t > remaining) if remaining.size
+                     else jnp.float32(0.0))
+            lr = base * factor ** drops.astype(jnp.float32)
+            return self._jax_warmup(t, lr.astype(jnp.float32))
+        return fn
+
 
 class PolyScheduler(LRScheduler):
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0.0, **kwargs):
@@ -82,6 +145,20 @@ class PolyScheduler(LRScheduler):
         frac = (num_update - self.warmup_steps) / self.max_steps
         return self.final_lr + (self.base_lr - self.final_lr) * (1 - frac) ** self.power
 
+    def as_jax(self):
+        import jax.numpy as jnp
+        base, final = float(self.base_lr), float(self.final_lr)
+        power, w = float(self.power), int(self.warmup_steps)
+        max_update, max_steps = int(self.max_update), int(self.max_steps)
+
+        def fn(t):
+            t = jnp.asarray(t, jnp.float32)
+            frac = (t - w) / max_steps
+            lr = final + (base - final) * jnp.maximum(1.0 - frac, 0.0) ** power
+            lr = jnp.where(t >= max_update, final, lr)
+            return self._jax_warmup(t, lr.astype(jnp.float32))
+        return fn
+
 
 class CosineScheduler(LRScheduler):
     def __init__(self, max_update, base_lr=0.01, final_lr=0.0, **kwargs):
@@ -98,6 +175,20 @@ class CosineScheduler(LRScheduler):
         frac = (num_update - self.warmup_steps) / self.max_steps
         return (self.final_lr + (self.base_lr - self.final_lr) *
                 (1 + math.cos(math.pi * frac)) / 2)
+
+    def as_jax(self):
+        import jax.numpy as jnp
+        base, final = float(self.base_lr), float(self.final_lr)
+        w, max_update = int(self.warmup_steps), int(self.max_update)
+        max_steps = int(self.max_steps)
+
+        def fn(t):
+            t = jnp.asarray(t, jnp.float32)
+            frac = (t - w) / max_steps
+            lr = final + (base - final) * (1.0 + jnp.cos(math.pi * frac)) / 2.0
+            lr = jnp.where(t >= max_update, final, lr)
+            return self._jax_warmup(t, lr.astype(jnp.float32))
+        return fn
 
 
 class LinearScheduler(PolyScheduler):

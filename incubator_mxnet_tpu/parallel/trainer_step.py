@@ -34,13 +34,19 @@ class FusedTrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh: Mesh | None = None,
                  data_axis: str = "dp", donate: bool = True,
-                 remat: bool = False, shard_optimizer_states: bool = False):
+                 remat: bool = False, remat_policy: str | None = None,
+                 shard_optimizer_states: bool = False,
+                 schedule_in_program: bool = False):
         """remat=True rematerializes the forward during backward
         (jax.checkpoint with the dots-saveable policy) — the TPU-native
         form of the reference's memonger/mirror_stage memory trade:
         activations are recomputed instead of stored, buying batch size /
         sequence length for ~1/3 extra FLOPs, with matmul outputs still
-        saved so the MXU work is not repeated.
+        saved so the MXU work is not repeated. remat_policy picks the
+        checkpoint policy: "dots" (default — matmul outputs saved),
+        "nothing" (recompute everything: max memory savings, max extra
+        FLOPs), "everything" (save all: remat becomes a no-op knob for
+        A/B sweeps).
 
         shard_optimizer_states=True shards each optimizer-state tensor's
         leading axis over the data-parallel mesh axis (ZeRO-1: momentum/
@@ -48,7 +54,15 @@ class FusedTrainStep:
         cutting optimizer memory by the dp degree). Pure layout change —
         GSPMD inserts the collectives; the math is bit-identical. Needs a
         mesh; states whose leading dim doesn't divide the axis stay
-        replicated."""
+        replicated.
+
+        schedule_in_program=True compiles the optimizer's lr schedule
+        INTO the k-step program (lr_scheduler.as_jax closed form) so each
+        micro-step computes its own lr from the on-device step counter —
+        the host never touches the scheduler inside a chunk. Falls back
+        to the host-sampled per-micro-step lr table when the scheduler
+        has no closed form; either way run_k matches a sequential loop
+        step-for-step (the k-granularity coarsening is gone)."""
         self.net = net
         self.loss_fn = loss_fn
         if isinstance(optimizer, Trainer):
@@ -61,9 +75,15 @@ class FusedTrainStep:
         self.data_axis = data_axis
         self.donate = donate
         self.remat = remat
+        self.remat_policy = remat_policy
+        self.schedule_in_program = schedule_in_program
         self.shard_optimizer_states = shard_optimizer_states and mesh is not None
         self._jitted = None
         self._jitted_k = None
+        self._stacked_sharding = None   # set by _build_k under a mesh
+        self._lr_program = None   # traceable fn(t)->lr, set in _build_k
+        self._lr_dummy = {}       # k -> cached zeros(k) placeholder table
+        self._lr_const = {}       # k -> (lr, cached constant (k,) table)
         self._num_update = 0
         self.params = None      # resolved at first call (after deferred init)
         self._states = None
@@ -84,6 +104,13 @@ class FusedTrainStep:
 
     # -- setup ------------------------------------------------------------
     def _resolve(self, x, y):
+        # persistent-compile-cache integrity canary (runtime/cache_guard):
+        # this jaxlib has mis-deserialized donated fused-step executables
+        # written by a previous process (PR 4); the canary validates the
+        # cache READ path once per process and disables the cache on
+        # corruption instead of letting the step train on garbage
+        from ..runtime import cache_guard as _cg
+        _cg.check()
         # one eager pass completes deferred shapes
         try:
             all_params = list(self.net.collect_params().values())
@@ -127,9 +154,22 @@ class FusedTrainStep:
                 return loss_raw, aux_new
 
             if self.remat:
-                loss_of = jax.checkpoint(
-                    loss_of,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+                policies = {
+                    None: jax.checkpoint_policies
+                              .dots_with_no_batch_dims_saveable,
+                    "dots": jax.checkpoint_policies
+                               .dots_with_no_batch_dims_saveable,
+                    "nothing": None,       # recompute everything
+                    "everything": jax.checkpoint_policies.everything_saveable,
+                }
+                try:
+                    policy = policies[self.remat_policy]
+                except KeyError:
+                    raise ValueError(
+                        f"unknown remat_policy {self.remat_policy!r}; "
+                        f"expected one of {sorted(k for k in policies if k)}"
+                    ) from None
+                loss_of = jax.checkpoint(loss_of, policy=policy)
             (loss, aux_new), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_raws)
             new_train, new_states = [], []
@@ -204,20 +244,34 @@ class FusedTrainStep:
         axis: k fwd+bwd+collective+update iterations inside ONE XLA
         program. Through a remote dispatch relay (or any host-limited
         launch path) this amortizes per-step latency by k — the chip runs
-        micro-steps back-to-back instead of idling between dispatches."""
-        step_fn = self._step_fn
+        micro-steps back-to-back instead of idling between dispatches.
 
-        def scan_fn(train_raws, aux_raws, states, key, lr, wd, t0, rescale,
+        lr is PER MICRO-STEP: either computed in-program from the step
+        counter t (schedule_in_program + a closed-form scheduler) or
+        scanned from a host-sampled (k,) table — both match a sequential
+        loop step-for-step; the old chunk-granularity lr is gone."""
+        step_fn = self._step_fn
+        self._lr_program = None
+        if self.schedule_in_program:
+            sched = getattr(self.optimizer, "lr_scheduler", None)
+            if sched is not None:
+                self._lr_program = sched.as_jax()
+        lr_program = self._lr_program
+
+        def scan_fn(train_raws, aux_raws, states, key, lrs, wd, t0, rescale,
                     xs, ys):
             def one(carry, xy):
                 tr, ax, st, k, t = carry
                 k, sub = jax.random.split(k)
+                xb, yb, lr_t = xy
+                if lr_program is not None:
+                    lr_t = lr_program(t)        # in-program schedule
                 loss, ntr, nax, nst = step_fn(
-                    tr, ax, st, sub, lr, wd, t, rescale, xy[0], xy[1])
+                    tr, ax, st, sub, lr_t, wd, t, rescale, xb, yb)
                 return (ntr, nax, nst, k, t + 1), loss
 
             (tr, ax, st, _, _), losses = jax.lax.scan(
-                one, (train_raws, aux_raws, states, key, t0), (xs, ys))
+                one, (train_raws, aux_raws, states, key, t0), (xs, ys, lrs))
             return losses, tr, ax, st
 
         kwargs = {}
@@ -233,6 +287,35 @@ class FusedTrainStep:
         if self.donate:
             kwargs["donate_argnums"] = (0, 1, 2)
         self._jitted_k = jax.jit(scan_fn, **kwargs)
+
+    def _chunk_lrs(self, k):
+        """The (k,) per-micro-step lr values for the NEXT k updates.
+
+        Host-table mode samples the scheduler at each t exactly as a
+        sequential loop would (stateful schedulers advance identically —
+        t is monotone). In-program mode returns a cached zero placeholder
+        (threaded through the scan signature, dead-code-eliminated by
+        XLA) and leaves the scheduler object untouched."""
+        if self._lr_program is not None:
+            tab = self._lr_dummy.get(k)
+            if tab is None:
+                tab = jnp.zeros((k,), jnp.float32)
+                self._lr_dummy[k] = tab
+            return tab
+        if getattr(self.optimizer, "lr_scheduler", None) is None:
+            # constant lr: one cached device table per (k, lr) — no
+            # per-chunk host upload (the _f32 scalar-cache discipline)
+            lr = float(self.optimizer.learning_rate)
+            hit = self._lr_const.get(k)
+            if hit is None or hit[0] != lr:
+                hit = (lr, jnp.full((k,), lr, jnp.float32))
+                self._lr_const[k] = hit
+            return hit[1]
+        vals = np.empty((k,), np.float32)
+        for i in range(k):
+            self.optimizer.num_update = self._num_update + 1 + i
+            vals[i] = self.optimizer.learning_rate
+        return jnp.asarray(vals)
 
     # -- execution --------------------------------------------------------
     def __call__(self, x, y):
@@ -273,9 +356,10 @@ class FusedTrainStep:
         lax.scan over the leading axis) — k× fewer host dispatches, so a
         slow launch path (e.g. a remote device relay) no longer bounds
         step time. xs/ys: stacked (k, batch, ...) arrays, or lists of k
-        per-step batches. lr/wd are sampled once for the whole chunk, so
-        schedulers advance in k-step granularity. Returns the k per-step
-        losses as an NDArray of shape (k,).
+        per-step batches. lr is per micro-step (host-sampled table, or
+        computed in-program under schedule_in_program), so schedulers
+        advance step-for-step exactly like a sequential loop. Returns the
+        k per-step losses as an NDArray of shape (k,).
 
         Reference contrast: the reference's engine pipelines k steps by
         async dependency tracking; here the compiler gets all k steps in
@@ -294,11 +378,7 @@ class FusedTrainStep:
             self._resolve(NDArray(xs[0]), NDArray(ys[0]))
         if self._jitted_k is None:
             self._build_k()
-        # lr/wd sampled ONCE at the start-of-chunk step count (matches the
-        # first step a sequential loop would take; schedulers advance in
-        # k-step granularity)
-        self.optimizer.num_update = self._num_update + 1
-        lr = self._f32("lr", self.optimizer.learning_rate)
+        lrs = self._chunk_lrs(k)
         wd = self._f32("wd", self.optimizer.wd)
         t0 = jnp.int32(self._num_update + 1)
         key = ndrandom._key()
@@ -309,7 +389,7 @@ class FusedTrainStep:
         aux_raws = [self.params[i].data()._data for i in self.aux_idx]
         rescale = self._f32("rescale", self.optimizer.rescale_grad)
         losses, new_train, new_aux, new_states = self._jitted_k(
-            train_raws, aux_raws, self._states, key, lr, wd, t0, rescale,
+            train_raws, aux_raws, self._states, key, lrs, wd, t0, rescale,
             xs, ys)
         self._num_update += k
         self.optimizer.num_update = self._num_update

@@ -1,0 +1,222 @@
+"""mxtpu.trainloop — the whole-loop train executor.
+
+The reference's hot loop is host-driven: Python sits between every step
+(CachedOp fwd/bwd → kvstore → per-weight optimizer kernels). PR 2–5
+fused the *step*; this module fuses the *loop*: N micro-steps — forward,
+backward, gradient collective, optimizer update, AND the lr schedule —
+compile into ONE donated, remat-policy-tuned XLA program, losses
+accumulate on device, and a double-buffered prefetcher
+(io.DevicePrefetcher) lands the next chunk's batches on the chip while
+the current chunk runs. The host's only per-chunk work is a queue pop
+and one dispatch; between chunk boundaries it never touches the device.
+
+What this fixes over the bench-only ``FusedTrainStep.run_k`` knob:
+
+* **scheduler granularity** — lr is per MICRO-STEP, not per chunk:
+  closed-form schedulers (optimizer/lr_scheduler.as_jax) compute lr
+  IN-PROGRAM from the on-device step counter ``t``; custom schedulers
+  fall back to a host-sampled (k,) lr table. Either way a k-chunked run
+  matches a sequential loop step-for-step. (wd has no scheduler in this
+  framework — it is sampled once at chunk start, like every other
+  constant hyperparameter.)
+* **input starvation is visible** — the prefetcher exports ``io.*``
+  counters (batches_prefetched / wait_ms / put_ms / depth / buffer_fill)
+  through the shared registry, so "TPU starved by input" shows up in
+  /metrics, flight dumps and BENCH json next to step times.
+* **first-class selection** — ``Trainer(..., loop_chunk=N)`` or
+  ``MXTPU_LOOP_CHUNK=N`` marks a training setup for whole-loop
+  execution; ``TrainLoop(net, loss, trainer)`` picks the chunk size up.
+* **Pallas hot paths** — the traced step routes through the kernel-
+  selection layer (ops/select.py), so flash-attention / fused layernorm
+  / fused BN+relu kernels land inside the loop program when shapes
+  qualify.
+
+Telemetry (domain ``trainloop``): ``trainloop.chunks`` /
+``trainloop.steps`` counters, ``trainloop.k`` / ``trainloop.chunk_ms`` /
+``trainloop.in_program_lr`` gauges — plus the existing
+``trainer.dispatches_per_step`` gauge, which reads 1/k under the
+executor (the smoke test asserts < 1).
+
+See docs/trainloop.md for lifecycle, remat-policy knobs, prefetch-depth
+tuning and the Pallas selection table.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from . import profiler as _prof
+from .io.prefetch import DevicePrefetcher
+from .parallel.trainer_step import FusedTrainStep
+
+__all__ = ["TrainLoop", "resolve_chunk"]
+
+
+def resolve_chunk(explicit=None, optimizer=None, default=4):
+    """Chunk-size resolution: explicit argument > Trainer.loop_chunk >
+    MXTPU_LOOP_CHUNK env > default."""
+    if explicit:
+        return int(explicit)
+    lc = getattr(optimizer, "loop_chunk", None)
+    if lc:
+        return int(lc)
+    env = os.environ.get("MXTPU_LOOP_CHUNK", "").strip()
+    if env:
+        return int(env)
+    return int(default)
+
+
+class TrainLoop:
+    """Whole-loop executor: ``run_chunk`` dispatches k train steps as one
+    XLA program; ``fit`` drives a data source through the device
+    prefetcher for a whole run.
+
+        loop = TrainLoop(net, loss_fn, trainer)          # or optimizer
+        losses = loop.fit(train_iter, steps=500)         # np (500,)
+
+        # or hand-fed chunks:
+        losses = loop.run_chunk(xs, ys)                  # (k,) NDArray
+
+    Parameters mirror FusedTrainStep (mesh/data_axis/donate/remat/
+    remat_policy); ``chunk`` defaults through
+    Trainer.loop_chunk → MXTPU_LOOP_CHUNK → 4, ``prefetch_depth`` sizes
+    the device-side input buffer (2 = double buffering).
+
+    Donation safety: every chunk donates the parameter/optimizer-state
+    buffers into the program and rebinds the live Parameters to the
+    outputs — reading ``net.collect_params()`` between chunks is always
+    valid; stale references to raw pre-chunk ``jax.Array``s are not (the
+    same contract as FusedTrainStep)."""
+
+    def __init__(self, net, loss_fn, optimizer, chunk=None, mesh=None,
+                 data_axis="dp", donate=True, remat=False, remat_policy=None,
+                 prefetch_depth=2, schedule_in_program=True):
+        self.chunk = resolve_chunk(explicit=chunk, optimizer=optimizer)
+        if self.chunk < 1:
+            raise ValueError(f"loop chunk must be >= 1, got {self.chunk}")
+        self.prefetch_depth = int(prefetch_depth)
+        self.step = FusedTrainStep(
+            net, loss_fn, optimizer, mesh=mesh, data_axis=data_axis,
+            donate=donate, remat=remat, remat_policy=remat_policy,
+            schedule_in_program=schedule_in_program)
+        self._c_chunks = _prof.counter("trainloop.chunks", "trainloop")
+        self._c_steps = _prof.counter("trainloop.steps", "trainloop")
+        _prof.set_gauge("trainloop.k", self.chunk, "trainloop")
+
+    # -- properties -------------------------------------------------------
+    @property
+    def net(self):
+        return self.step.net
+
+    @property
+    def optimizer(self):
+        return self.step.optimizer
+
+    @property
+    def num_update(self):
+        return self.step._num_update
+
+    @property
+    def in_program_lr(self) -> bool:
+        """True once the compiled loop computes lr on device from the
+        step counter (closed-form scheduler); False = host lr table."""
+        return self.step._lr_program is not None
+
+    # -- execution --------------------------------------------------------
+    def run_chunk(self, xs, ys):
+        """Run one chunk: xs/ys stacked (k, batch, ...) arrays (or lists
+        of k batches). Returns the k per-step losses as an NDArray —
+        still on device; fetch at run end, not per chunk."""
+        t0 = time.perf_counter()
+        losses = self.step.run_k(xs, ys)
+        k = int(losses.shape[0])
+        self._c_chunks.increment()
+        self._c_steps.increment(k)
+        # dispatch wall time: through an async dispatch path this is the
+        # HOST cost per chunk (the device runs behind), which is exactly
+        # the quantity the executor exists to shrink
+        _prof.set_gauge("trainloop.chunk_ms",
+                        round((time.perf_counter() - t0) * 1e3, 3),
+                        "trainloop")
+        _prof.set_gauge("trainloop.in_program_lr",
+                        int(self.in_program_lr), "trainloop")
+        return losses
+
+    def fit(self, data, steps=None, epochs=None, cycle=None):
+        """Drive the executor from a data source.
+
+        data   : DataIter / iterable of DataBatch or (x, y) pairs.
+        steps  : total optimizer steps to run (rounded DOWN to whole
+                 chunks). With ``steps``, DataIter sources are cycled
+                 (reset + refeed) across epoch ends.
+        epochs : alternatively, full passes over the source (chunk
+                 remainders at each epoch tail are dropped — static
+                 shapes can't take short chunks).
+
+        Returns the per-step losses as a numpy array — fetched ONCE at
+        the end; the loop itself never blocks on device values."""
+        if (steps is None) == (epochs is None):
+            raise ValueError("pass exactly one of steps= or epochs=")
+        histories = []
+        if steps is not None:
+            n_chunks = steps // self.chunk
+            if n_chunks < 1:
+                raise ValueError(
+                    f"steps={steps} is less than one chunk of "
+                    f"{self.chunk}; lower loop_chunk or raise steps")
+            cycle = True if cycle is None else cycle
+            with self._prefetcher(data, cycle=cycle) as pf:
+                for i in range(n_chunks):
+                    try:
+                        xs, ys = next(pf)
+                    except StopIteration:
+                        # never let a bare StopIteration escape (it would
+                        # be swallowed by any enclosing iterator frame)
+                        raise ValueError(
+                            f"data source exhausted after "
+                            f"{i * self.chunk} of {steps} steps and "
+                            f"cannot be rewound (pass a DataIter or a "
+                            f"re-iterable, or lower steps=)") from None
+                    self._check_labeled(ys)
+                    histories.append(self.run_chunk(xs, ys))
+        else:
+            for e in range(epochs):
+                # MXNet epoch convention: DataIter sources rewind at each
+                # epoch start (without this, epoch 2+ would iterate an
+                # exhausted iterator and silently contribute nothing)
+                if hasattr(data, "reset"):
+                    data.reset()
+                n_before = len(histories)
+                with self._prefetcher(data, cycle=False) as pf:
+                    for xs, ys in pf:
+                        self._check_labeled(ys)
+                        histories.append(self.run_chunk(xs, ys))
+                if len(histories) == n_before:
+                    # an empty epoch is always a caller bug (one-shot
+                    # iterator that can't rewind, or fewer batches than
+                    # one chunk) — never silently under-train
+                    raise ValueError(
+                        f"epoch {e + 1} produced no chunks: the source "
+                        f"is exhausted/non-rewindable or yields fewer "
+                        f"than chunk={self.chunk} batches (pass a "
+                        f"DataIter or a re-iterable)")
+        if not histories:
+            return np.zeros((0,), np.float32)
+        return np.concatenate([h.asnumpy() for h in histories])
+
+    @staticmethod
+    def _check_labeled(ys):
+        if ys is None:
+            raise ValueError(
+                "TrainLoop.fit needs labeled batches ((x, y) pairs or "
+                "DataBatch with labels); got a label-less batch — for "
+                "self-supervised inputs yield (x, x)")
+
+    def _prefetcher(self, data, cycle):
+        # the stacked-batch sharding only exists after the first build;
+        # hand the prefetcher a late-bound getter instead of a value
+        return DevicePrefetcher(
+            data, depth=self.prefetch_depth, chunk=self.chunk,
+            sharding=lambda: self.step._stacked_sharding, cycle=cycle)

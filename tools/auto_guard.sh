@@ -31,6 +31,14 @@ if timeout 1200 bash tools/health_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) health smoke FAILED (continuing; healthmon suspect)" >> "$LOG"
 fi
+# whole-loop executor smoke (CPU-only): 50 lenet steps through
+# mxtpu.trainloop — loss decreases, io.*/trainloop.* telemetry present,
+# dispatches_per_step < 1
+if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) trainloop smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

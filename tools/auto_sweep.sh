@@ -31,6 +31,13 @@ if timeout 1200 bash tools/health_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) health smoke FAILED (continuing; healthmon suspect)" >> "$LOG"
 fi
+# whole-loop executor smoke (CPU-only): the trainloop + prefetcher +
+# telemetry pipeline must hold before sweeping it on the tunnel
+if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) trainloop smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -224,6 +224,20 @@ def main():
                 aborted = True
                 break
 
+    # 2.5) whole-loop executor (mxtpu.trainloop, PR 6): k-chunked dispatch
+    #      + device-side prefetch + per-micro-step lr. Same scan program
+    #      family as BENCH_K (cache-friendly), plus the io.*/trainloop.*
+    #      telemetry lands in the BENCH json; pallas selection rides the
+    #      on-TPU defaults.
+    if not aborted:
+        for cfg in ([{"BENCH_LOOP_CHUNK": 8}] if quick else
+                    [{"BENCH_LOOP_CHUNK": 8},
+                     {"BENCH_LOOP_CHUNK": 8, "BENCH_S2D": 1}]):
+            if record({**base, **cfg}) is None:
+                log("aborting trainloop stage (unhealthy run)")
+                aborted = True
+                break
+
     # 3) model stage: BERT (BASELINE config 2; first-ever chip number —
     #    VERDICT r3 next-step #4) then transformer_lm (the causal-LM
     #    family's first chip number). Flash attention pays in both;

@@ -67,6 +67,23 @@ HEALTHMON_FAMILIES = {
     "healthmon/healthmon.grad_global_norm": "gauge",
 }
 
+# The io.* (device prefetcher) and trainloop.* (whole-loop executor)
+# metric families — same schema-stability contract as healthmon: a
+# producer inventing a new name or flipping a kind must update this
+# table (docs/trainloop.md documents each metric).
+IO_TRAINLOOP_FAMILIES = {
+    "io/io.batches_prefetched": "counter",
+    "io/io.wait_ms": "counter",
+    "io/io.put_ms": "counter",
+    "io/io.depth": "gauge",
+    "io/io.buffer_fill": "gauge",
+    "trainloop/trainloop.chunks": "counter",
+    "trainloop/trainloop.steps": "counter",
+    "trainloop/trainloop.k": "gauge",
+    "trainloop/trainloop.chunk_ms": "gauge",
+    "trainloop/trainloop.in_program_lr": "gauge",
+}
+
 
 def _is_num(x) -> bool:
     return isinstance(x, numbers.Real) and not isinstance(x, bool)
@@ -200,19 +217,25 @@ def check_flight(path: str) -> list:
 # ---------------------------------------------------------------------------
 
 def check_healthmon_kinds(kinds: dict) -> list:
-    """Every healthmon/* metric must belong to HEALTHMON_FAMILIES with
-    the declared kind."""
+    """Every healthmon/*, io/* and trainloop/* metric must belong to its
+    family table with the declared kind."""
     errors = []
+    tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
+              ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
+              ("trainloop/", IO_TRAINLOOP_FAMILIES,
+               "IO_TRAINLOOP_FAMILIES"))
     for k, kind in sorted(kinds.items()):
-        if not k.startswith("healthmon/"):
-            continue
-        want = HEALTHMON_FAMILIES.get(k)
-        if want is None:
-            errors.append(f"unknown healthmon counter family {k!r} "
-                          f"(update HEALTHMON_FAMILIES if intentional)")
-        elif kind != want:
-            errors.append(f"healthmon counter {k!r} has kind {kind!r}, "
-                          f"schema says {want!r}")
+        for prefix, table, tname in tables:
+            if not k.startswith(prefix):
+                continue
+            want = table.get(k)
+            if want is None:
+                errors.append(f"unknown {prefix.rstrip('/')} counter "
+                              f"family {k!r} (update {tname} if "
+                              f"intentional)")
+            elif kind != want:
+                errors.append(f"counter {k!r} has kind {kind!r}, "
+                              f"schema says {want!r}")
     return errors
 
 
@@ -468,6 +491,18 @@ def check_bench_json(path: str) -> list:
         errors.append("missing/empty 'metric'")
     if not _is_num(doc.get("value")):
         errors.append(f"needs numeric 'value', got {doc.get('value')!r}")
+    extra = doc.get("extra") or {}
+    # training benches must carry MFU (ROADMAP item 1: regressions visible
+    # per-PR). Serving benches and error results are exempt.
+    if (isinstance(extra, dict) and extra
+            and "serving" not in extra and "error" not in doc):
+        mfu = extra.get("mfu")
+        if not _is_num(mfu):
+            errors.append(f"training bench extra needs numeric 'mfu', "
+                          f"got {mfu!r}")
+        elif not (0.0 <= mfu <= 1.5):
+            errors.append(f"extra.mfu={mfu} outside [0, 1.5] — wrong "
+                          f"peak-FLOPs or flops-per-sample accounting")
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
