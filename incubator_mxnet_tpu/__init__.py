@@ -54,6 +54,7 @@ from . import runtime
 from . import engine
 from . import diagnostics
 from . import healthmon
+from . import perfscope
 from . import serving
 from . import trainloop
 from .trainloop import TrainLoop
@@ -77,3 +78,6 @@ diagnostics.enable_from_env()
 # MXTPU_HEALTHMON=1: arm cross-rank training health (watchdogs, skew
 # timeline, structured event log — see docs/observability.md) at import.
 healthmon.enable_from_env()
+# MXTPU_PERFSCOPE=1: arm roofline-aware cost capture at compile sites
+# (per-program FLOPs/bytes + verdicts — see docs/perfscope.md) at import.
+perfscope.enable_from_env()

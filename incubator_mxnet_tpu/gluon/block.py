@@ -20,6 +20,7 @@ from .. import autograd
 from .. import profiler as _prof
 from ..diagnostics import memory as _dmem
 from ..diagnostics import flight as _flight
+from .. import perfscope as _perfscope
 from ..base import NameManager, camel_to_snake
 from ..ndarray import NDArray, _apply
 from ..ndarray import random as ndrandom
@@ -400,6 +401,20 @@ class HybridBlock(Block):
         if kernel_log and _flight._REC is not None:
             _flight.record("compile", "pallas.selection:" + self.name,
                            {"decisions": kernel_log[:32]})
+        ps = _perfscope._PS
+        if ps is not None and ps.capture_jit_cache:
+            # roofline verdict for this signature's forward executable
+            # (host-side lowering only; one extra trace per compile —
+            # the reason jit-cache capture is gated on perfscope being
+            # armed rather than always-on)
+            shape0 = tuple(args[0].shape) if args else ()
+            _perfscope.analyze_jit(
+                jitted, (dummy_key, *p_raws, *[a._data for a in args]),
+                name=f"jit:{self.name}:{'x'.join(map(str, shape0))}",
+                dtype=(args[0]._data.dtype if args else "float32"),
+                kind="jit_cache",
+                extra={"training": training,
+                       "pallas_selections": len(kernel_log or ())})
         n_aux = len(out_info["aux_params"])
         n_real = len(shapes) - n_aux
         return _CacheEntry(raw_fn, jitted, n_real, n_aux,

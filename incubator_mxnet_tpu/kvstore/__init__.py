@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
 from .. import healthmon as _hm
+from .. import perfscope as _ps
 from .. import optimizer as _opt
 from .. import profiler as _prof
 from ..diagnostics import flight as _flight
@@ -76,18 +77,23 @@ def _account(op: str, value) -> None:
 
 def _timed(op: str, fn):
     """Run one collective-surface call, feeding its entry-to-exit wall
-    time to the healthmon skew timeline (docs/observability.md). The
-    duration includes the cross-rank wait inside blocking collectives —
-    exactly the quantity straggler attribution decomposes — and the hook
-    costs one predicate check when healthmon is off."""
+    time to the healthmon skew timeline (docs/observability.md) and the
+    cumulative ``kvstore.collective_ms`` counter perfscope's step-time
+    decomposition reads. The duration includes the cross-rank wait
+    inside blocking collectives — exactly the quantity straggler
+    attribution and the step budget decompose — and the hook costs two
+    predicate checks when both layers are off."""
     hm = _hm._HM
-    if hm is None:
+    if hm is None and _ps._PS is None:
         return fn()
     t0 = time.perf_counter()
     try:
         return fn()
     finally:
-        hm.record_collective(op, (time.perf_counter() - t0) * 1e3)
+        ms = (time.perf_counter() - t0) * 1e3
+        if hm is not None:
+            hm.record_collective(op, ms)
+        _prof.counter("kvstore.collective_ms").increment(ms)
 
 __all__ = ["KVStore", "create"]
 

@@ -52,7 +52,7 @@ import threading
 from .. import profiler as _prof
 
 __all__ = ["flash_attention", "layer_norm", "scale_shift_act",
-           "conv_bn_relu", "capture", "selection_table"]
+           "conv_bn_relu", "capture", "quiet", "selection_table"]
 
 _tls = threading.local()
 
@@ -73,10 +73,27 @@ class capture:
         return False
 
 
+class quiet:
+    """Suppress the selection counters on this thread inside the scope.
+    perfscope's cost capture re-lowers an already-traced program purely
+    to read XLA's cost analysis; without this, every analyzed compile
+    would double-count pallas.selected.*/rejected.*."""
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "quiet", False)
+        _tls.quiet = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.quiet = self._prev
+        return False
+
+
 def _decide(kernel: str, ok: bool, reason: str) -> bool:
-    _prof.counter(
-        ("pallas.selected." if ok else "pallas.rejected.") + kernel,
-        "ops").increment()
+    if not getattr(_tls, "quiet", False):
+        _prof.counter(
+            ("pallas.selected." if ok else "pallas.rejected.") + kernel,
+            "ops").increment()
     log = getattr(_tls, "log", None)
     if log is not None:
         log.append({"kernel": kernel, "selected": bool(ok),

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from .. import autograd
+from .. import perfscope as _ps
 from .. import profiler as _prof
 from ..diagnostics import flight as _flight
 from ..gluon.block import HybridBlock, _flatten_out, _unflatten_out
@@ -170,6 +171,13 @@ class FrozenModel:
             self._exec[b] = lowered.compile()
         if self._out_tree is None:
             self._out_tree = self._raw_info["tree"]
+        if _ps._PS is not None:
+            # the bucket is already lowered — the roofline verdict is a
+            # free host-side read here (no extra trace)
+            _ps.analyze_lowered(
+                lowered, name=f"serving:{self._block.name}:b{b}",
+                dtype=self._dtype, kind="serving_bucket",
+                extra={"bucket": b})
         _prof.counter("serving.compiles", "serving").increment()
         if warmup:
             x0 = np.zeros(shape, self._dtype)

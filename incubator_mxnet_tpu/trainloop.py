@@ -103,6 +103,11 @@ class TrainLoop:
             schedule_in_program=schedule_in_program)
         self._c_chunks = _prof.counter("trainloop.chunks", "trainloop")
         self._c_steps = _prof.counter("trainloop.steps", "trainloop")
+        # cumulative host wall spent INSIDE run_chunk dispatches — the
+        # whole-loop host_gap signal perfscope's step-time decomposition
+        # reads (per-step share = dispatch_ms delta / steps)
+        self._c_dispatch = _prof.counter("trainloop.dispatch_ms",
+                                         "trainloop")
         _prof.set_gauge("trainloop.k", self.chunk, "trainloop")
 
     # -- properties -------------------------------------------------------
@@ -137,8 +142,9 @@ class TrainLoop:
         # dispatch wall time: through an async dispatch path this is the
         # HOST cost per chunk (the device runs behind), which is exactly
         # the quantity the executor exists to shrink
-        _prof.set_gauge("trainloop.chunk_ms",
-                        round((time.perf_counter() - t0) * 1e3, 3),
+        chunk_ms = (time.perf_counter() - t0) * 1e3
+        self._c_dispatch.increment(chunk_ms)
+        _prof.set_gauge("trainloop.chunk_ms", round(chunk_ms, 3),
                         "trainloop")
         _prof.set_gauge("trainloop.in_program_lr",
                         int(self.in_program_lr), "trainloop")

@@ -39,6 +39,14 @@ if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
 fi
+# perfscope smoke (CPU-only): step-time decomposition sums, roofline
+# verdicts present, and the perf_regress gate passes self-vs-self /
+# fails on an injected regression / skips env_failure artifacts
+if timeout 900 bash tools/perfscope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) perfscope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) perfscope smoke FAILED (continuing; perf attribution suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "
@@ -50,8 +58,16 @@ print(float((x @ x).sum()))
   echo "$ts guard probe rc=$rc" >> "$LOG"
   if [ "$rc" = "0" ]; then
     echo "$ts TUNNEL HEALED -> one cached driver-default bench, then quiet" >> "$LOG"
-    timeout 1800 python bench.py >> "$LOG" 2>&1
-    echo "$(date -u +%F' '%T) guard bench rc=$?; auto_guard exiting (tunnel left alone)" >> "$LOG"
+    timeout 1800 python bench.py > /tmp/mxtpu_guard_bench.json 2>> "$LOG"
+    brc=$?
+    cat /tmp/mxtpu_guard_bench.json >> "$LOG"
+    echo "$(date -u +%F' '%T) guard bench rc=$brc" >> "$LOG"
+    # regression gate: the fresh number vs the repo's BENCH trajectory
+    # (env_failure artifacts — the r02-r05 hangs — are skipped, so an
+    # empty baseline pool just reports OK)
+    timeout 120 python tools/perf_regress.py --dir . \
+      --candidate /tmp/mxtpu_guard_bench.json >> "$LOG" 2>&1
+    echo "$(date -u +%F' '%T) perf_regress rc=$?; auto_guard exiting (tunnel left alone)" >> "$LOG"
     exit 0
   fi
   sleep 600

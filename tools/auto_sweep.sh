@@ -38,6 +38,13 @@ if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
 fi
+# perfscope smoke (CPU-only): decomposition + roofline verdicts + the
+# perf_regress gate must validate before any on-chip number is trusted
+if timeout 900 bash tools/perfscope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) perfscope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) perfscope smoke FAILED (continuing; perf attribution suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "
@@ -50,7 +57,11 @@ print(float((x @ x).sum()))
   if [ "$rc" = "0" ]; then
     echo "$ts TUNNEL HEALTHY -> perf_sweep" >> "$LOG"
     timeout 21600 python tools/perf_sweep.py >> "$LOG" 2>&1
-    echo "$(date -u +%F' '%T) perf_sweep rc=$?; auto_sweep exiting" >> "$LOG"
+    echo "$(date -u +%F' '%T) perf_sweep rc=$?" >> "$LOG"
+    # regression gate over the repo's BENCH trajectory: every sweep run
+    # ends with a machine verdict (env_failure artifacts skipped)
+    timeout 120 python tools/perf_regress.py --dir . >> "$LOG" 2>&1
+    echo "$(date -u +%F' '%T) perf_regress rc=$?; auto_sweep exiting" >> "$LOG"
     exit 0
   fi
   sleep 600

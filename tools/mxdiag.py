@@ -49,6 +49,38 @@ def _fmt_ts(epoch) -> str:
         return str(epoch)
 
 
+def _fmt_flops(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000 or unit == "P":
+            return f"{n:,.2f} {unit}FLOP"
+        n /= 1000.0
+
+
+def _fmt_cost_args(args: dict) -> str:
+    """Human rendering of a perfscope-enriched compile span's cost
+    fields (flops / bytes_accessed / roofline / ai)."""
+    parts = []
+    if args.get("flops") is not None:
+        parts.append(_fmt_flops(args["flops"]))
+    if args.get("bytes_accessed") is not None:
+        parts.append(_fmt_bytes(args["bytes_accessed"]))
+    if args.get("ai") is not None:
+        parts.append(f"AI {args['ai']:.2f}")
+    if args.get("roofline"):
+        parts.append(f"-> {args['roofline'].upper()}")
+    rest = {k: v for k, v in args.items()
+            if k not in ("flops", "bytes_accessed", "ai", "roofline",
+                         "est_compute_ms", "est_memory_ms")}
+    out = "  " + "  ".join(parts)
+    if rest:
+        out += "  " + json.dumps(rest)
+    return out
+
+
 def print_flight(doc: dict, n_events: int) -> None:
     print(f"flight dump  schema={doc.get('schema')}  "
           f"reason={doc.get('reason')!r}")
@@ -92,7 +124,11 @@ def print_flight(doc: dict, n_events: int) -> None:
     for ev in tail:
         dt = ev.get("ts", 0) - t_end
         args = ev.get("args")
-        extra = "  " + json.dumps(args) if args else ""
+        if args and ev.get("kind") == "compile" and \
+                ("flops" in args or "roofline" in args):
+            extra = _fmt_cost_args(args)   # perfscope-enriched span
+        else:
+            extra = "  " + json.dumps(args) if args else ""
         print(f"    {dt:>+9.3f}s  {ev.get('kind', '?'):<10} "
               f"{ev.get('name', '?')}{extra}")
 
@@ -131,6 +167,100 @@ def print_metrics(path: str) -> None:
         print(f"  memory: current {_fmt_bytes(mem.get('current_bytes'))}  "
               f"peak {_fmt_bytes(mem.get('peak_bytes'))}  "
               f"live {mem.get('live_arrays')}")
+
+
+# ---------------------------------------------------------------------------
+# perf: MFU-decomposition report from a BENCH json (extra.perfscope)
+# ---------------------------------------------------------------------------
+
+def _load_bench(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "parsed" in doc and "metric" not in doc:
+        doc = doc["parsed"] or {}
+    if not isinstance(doc, dict) or "metric" not in doc:
+        raise ValueError(f"{path}: not a bench result "
+                         f"(no 'metric'/'parsed' key)")
+    return doc
+
+
+def print_perf(doc: dict) -> int:
+    """The "why is my MFU low" report: step budget with per-component
+    shares, the counterfactual MFU table, and per-program roofline
+    verdicts."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')}, batch "
+          f"{extra.get('batch')}, {extra.get('dtype')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    ps = extra.get("perfscope")
+    if not isinstance(ps, dict):
+        print("  no extra.perfscope section (perfscope was off — "
+              "rerun without BENCH_PERFSCOPE=0)")
+        return 1
+    peaks = ps.get("peaks") or {}
+    print(f"  peaks: {peaks.get('device_kind')} "
+          f"(table row {peaks.get('table_row')})  "
+          f"bf16 {_fmt_flops(peaks.get('peak_flops_bf16'))}/s  "
+          f"f32 {_fmt_flops(peaks.get('peak_flops_f32'))}/s  "
+          f"HBM {_fmt_bytes(peaks.get('hbm_bytes_per_s'))}/s")
+    d = ps.get("decomposition")
+    if isinstance(d, dict) and d.get("step_ms"):
+        step = d["step_ms"]
+        print(f"\n  step budget ({d.get('steps')} steps, source="
+              f"{d.get('source')}):  step_ms = {step:.3f}")
+        for comp in ("device_compute", "collective", "input_wait",
+                     "host_gap", "other"):
+            ms = d.get(comp + "_ms")
+            if ms is None:
+                continue
+            share = ms / step if step else 0.0
+            bar = "#" * int(round(share * 40))
+            print(f"    {comp:<15} {ms:>10.3f} ms  {share:>6.1%}  {bar}")
+        print(f"    {'(coverage':<15} {d.get('coverage')})")
+        if d.get("mfu") is not None:
+            print(f"\n  MFU decomposition:  achieved {d['mfu']:.4f}")
+            if d.get("mfu_device_only") is not None:
+                print(f"    device-compute-bound ceiling  "
+                      f"{d['mfu_device_only']:.4f}")
+            for comp, v in (d.get("mfu_if_removed") or {}).items():
+                if v is not None and d["mfu"]:
+                    print(f"    if {comp + ' were free:':<22} {v:.4f}  "
+                          f"({v / d['mfu']:.2f}x)")
+    else:
+        print("  no step-time decomposition in this artifact")
+    progs = ps.get("programs") or []
+    if progs:
+        print(f"\n  compiled programs ({len(progs)}):")
+        width = max(len(p.get("name", "?")) for p in progs)
+        for p in progs:
+            f = _fmt_flops(p.get("flops")) if p.get("flops") is not None \
+                else "-"
+            b = _fmt_bytes(p.get("bytes_accessed")) \
+                if p.get("bytes_accessed") is not None else "-"
+            ai = f"AI {p['ai']:.2f}" if p.get("ai") is not None else ""
+            print(f"    {p.get('name', '?'):<{width}}  "
+                  f"{p.get('verdict', '?'):<14} {f:>14}  {b:>12}  {ai}")
+    return 0
+
+
+def _perf_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py perf",
+        description="MFU-decomposition report from a BENCH json "
+                    "(extra.perfscope)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"perf: {e}", file=sys.stderr)
+        return 1
+    return print_perf(doc)
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +395,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "merge":
         return _merge_main(argv[1:])
+    if argv and argv[0] == "perf":
+        return _perf_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""BENCH regression gate: compare bench artifacts with noise-aware
+thresholds and an environment-failure filter.
+
+Why this exists: the repo's perf history mixes real measurements
+(PERF.md, BENCH_r01) with artifacts of a wedged backend (BENCH_r02–r05
+record a hung axon tunnel, value 0.0). A naive comparator reads those as
+100% regressions and either cries wolf or — worse — adopts 0 img/s as a
+baseline every later run "beats". This tool:
+
+* **skips env-failure artifacts** — anything carrying
+  ``"status": "env_failure"`` (bench.py's preflight/watchdog artifacts),
+  an ``error`` field, a null ``parsed`` wrapper, or a non-positive
+  value. They describe the environment, not the code;
+* **compares the metrics that matter** — headline throughput
+  (``value``), ``extra.mfu`` (ROADMAP item 1's regression metric), and
+  serving ``p99_ms`` — relative, per metric, only when both sides carry
+  the number;
+* **is noise-aware** — in trajectory mode (``--dir``) the baseline is
+  the MEDIAN of all usable prior artifacts and the effective threshold
+  is ``max(--threshold, --noise-mult × observed relative spread)``, so
+  a comparison across a noisy history demands a drop larger than the
+  history's own scatter before it indicts a PR.
+
+Usage:
+    python tools/perf_regress.py BASELINE.json CANDIDATE.json
+    python tools/perf_regress.py --dir REPO_DIR [--candidate FILE]
+
+Accepted artifact shapes: direct bench.py output
+(``{"metric", "value", ...}``) and the driver wrapper
+(``{"n", "cmd", "rc", "parsed": {...}}``).
+
+Exit status: 0 = no regression (or nothing comparable — every baseline
+was an env failure), 1 = regression, 2 = usage/IO error.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = ["load_artifact", "compare", "trajectory", "main"]
+
+DEFAULT_THRESHOLD = 0.05       # 5% relative drop on value / MFU
+DEFAULT_P99_THRESHOLD = 0.25   # 25% relative increase on p99
+DEFAULT_NOISE_MULT = 2.0
+
+
+def load_artifact(path):
+    """Load one BENCH artifact → (record | None, skip_reason | None).
+
+    The record is {path, metric, value, unit, mfu, p99_ms}; None means
+    the artifact is unusable as a perf number (the reason says why —
+    env failure, error, unparseable)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable/invalid JSON ({e})"
+    if not isinstance(doc, dict):
+        return None, "not a JSON object"
+    if "parsed" in doc and "metric" not in doc:
+        # driver wrapper: the bench's own JSON line lives under `parsed`
+        doc = doc["parsed"]
+        if not isinstance(doc, dict):
+            return None, "driver wrapper with no parsed bench line " \
+                         "(the run produced no usable output)"
+    if doc.get("status") == "env_failure":
+        return None, f"env_failure: {str(doc.get('error', ''))[:80]}"
+    if doc.get("error"):
+        # pre-perfscope artifacts (BENCH_r02–r05) carry only `error`;
+        # value 0 + error is an environment/run failure either way
+        return None, f"errored run: {str(doc['error'])[:80]}"
+    value = doc.get("value")
+    if not isinstance(value, (int, float)) or isinstance(value, bool) \
+            or value <= 0:
+        return None, f"non-positive value {value!r}"
+    extra = doc.get("extra") or {}
+    serving = extra.get("serving") or {}
+    rec = {
+        "path": path,
+        "metric": doc.get("metric"),
+        "value": float(value),
+        "unit": doc.get("unit"),
+        "mfu": extra.get("mfu") if isinstance(extra.get("mfu"),
+                                              (int, float)) else None,
+        "p99_ms": serving.get("p99_ms") if isinstance(
+            serving.get("p99_ms"), (int, float)) else None,
+    }
+    return rec, None
+
+
+def _rel_spread(values):
+    """Max relative deviation from the median — the trajectory's own
+    noise band."""
+    if len(values) < 2:
+        return 0.0
+    med = sorted(values)[len(values) // 2]
+    if med <= 0:
+        return 0.0
+    return max(abs(v - med) / med for v in values)
+
+
+def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
+            p99_threshold=DEFAULT_P99_THRESHOLD, noise=0.0,
+            noise_mult=DEFAULT_NOISE_MULT):
+    """Compare two loaded records → (regressions, notes): lists of
+    human-readable strings. Lower-is-worse metrics (value, mfu) regress
+    on a relative DROP beyond the effective threshold; p99 regresses on
+    a relative INCREASE."""
+    regressions, notes = [], []
+    if baseline["metric"] != candidate["metric"]:
+        notes.append(f"metric mismatch ({baseline['metric']!r} vs "
+                     f"{candidate['metric']!r}) — nothing comparable")
+        return regressions, notes
+    eff = max(threshold, noise_mult * noise)
+    if noise:
+        notes.append(f"noise band {noise:.1%} -> effective threshold "
+                     f"{eff:.1%}")
+    for key, label in (("value", f"{candidate['unit'] or 'value'}"),
+                       ("mfu", "MFU")):
+        b, c = baseline.get(key), candidate.get(key)
+        if b is None or c is None or b <= 0:
+            continue
+        drop = (b - c) / b
+        line = (f"{label}: {b:.4g} -> {c:.4g} "
+                f"({-drop:+.2%} vs threshold -{eff:.1%})")
+        if drop > eff:
+            regressions.append("REGRESSION " + line)
+        else:
+            notes.append("ok " + line)
+    b99, c99 = baseline.get("p99_ms"), candidate.get("p99_ms")
+    if b99 and c99 and b99 > 0:
+        rise = (c99 - b99) / b99
+        eff99 = max(p99_threshold, noise_mult * noise)
+        line = (f"p99_ms: {b99:.4g} -> {c99:.4g} "
+                f"({rise:+.2%} vs threshold +{eff99:.1%})")
+        if rise > eff99:
+            regressions.append("REGRESSION " + line)
+        else:
+            notes.append("ok " + line)
+    return regressions, notes
+
+
+def _natural_key(path):
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", os.path.basename(path))]
+
+
+def trajectory(paths, threshold, p99_threshold, noise_mult,
+               candidate_path=None):
+    """Directory mode: newest usable artifact vs the median of all
+    earlier usable ones, thresholds widened by the observed spread.
+    Returns (exit_code, lines)."""
+    lines = []
+    loaded = []
+    for p in sorted(paths, key=_natural_key):
+        rec, why = load_artifact(p)
+        if rec is None:
+            lines.append(f"skip {p}: {why}")
+        else:
+            loaded.append(rec)
+    if candidate_path:
+        cand, why = load_artifact(candidate_path)
+        if cand is None:
+            lines.append(f"candidate {candidate_path} unusable ({why}) — "
+                         f"no perf verdict possible")
+            return 0, lines
+        base_pool = [r for r in loaded if r["path"] != candidate_path]
+    else:
+        if not loaded:
+            lines.append("no usable artifacts at all — nothing to gate")
+            return 0, lines
+        cand = loaded[-1]
+        base_pool = loaded[:-1]
+    base_pool = [r for r in base_pool if r["metric"] == cand["metric"]]
+    if not base_pool:
+        lines.append(f"no usable baseline for metric {cand['metric']!r} "
+                     f"(every prior artifact skipped) — nothing to gate")
+        return 0, lines
+    values = [r["value"] for r in base_pool]
+    values_sorted = sorted(values)
+    med_val = values_sorted[len(values_sorted) // 2]
+    base = dict(min(base_pool, key=lambda r: abs(r["value"] - med_val)))
+    base["path"] = f"median of {len(base_pool)} artifacts"
+    noise = _rel_spread(values)
+    lines.append(f"candidate: {cand['path']} ({cand['value']:.4g} "
+                 f"{cand['unit']})")
+    lines.append(f"baseline: {base['path']} "
+                 f"(median value {base['value']:.4g})")
+    regs, notes = compare(base, cand, threshold=threshold,
+                          p99_threshold=p99_threshold, noise=noise,
+                          noise_mult=noise_mult)
+    lines.extend(notes + regs)
+    return (1 if regs else 0), lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH regression gate (env-failure-aware, "
+                    "noise-aware)")
+    ap.add_argument("files", nargs="*",
+                    help="BASELINE.json CANDIDATE.json (pairwise mode)")
+    ap.add_argument("--dir", default=None,
+                    help="trajectory mode: gate the newest usable "
+                         "BENCH_*.json in DIR against the median of the "
+                         "earlier ones")
+    ap.add_argument("--candidate", default=None,
+                    help="with --dir: explicit candidate artifact")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative drop threshold for value/MFU "
+                         "(default 0.05)")
+    ap.add_argument("--p99-threshold", type=float,
+                    default=DEFAULT_P99_THRESHOLD,
+                    help="relative increase threshold for p99 "
+                         "(default 0.25)")
+    ap.add_argument("--noise-mult", type=float, default=DEFAULT_NOISE_MULT,
+                    help="noise-band multiplier in trajectory mode "
+                         "(default 2.0)")
+    args = ap.parse_args(argv)
+
+    if args.dir:
+        paths = glob.glob(os.path.join(args.dir, "BENCH_*.json"))
+        if not paths:
+            print(f"perf_regress: no BENCH_*.json under {args.dir}",
+                  file=sys.stderr)
+            return 2
+        rc, lines = trajectory(paths, args.threshold, args.p99_threshold,
+                               args.noise_mult,
+                               candidate_path=args.candidate)
+        for ln in lines:
+            print(ln)
+        print("perf_regress: " + ("REGRESSION" if rc else "OK"))
+        return rc
+
+    if len(args.files) != 2:
+        ap.print_usage(sys.stderr)
+        print("perf_regress: pairwise mode takes exactly BASELINE and "
+              "CANDIDATE", file=sys.stderr)
+        return 2
+    base, why_b = load_artifact(args.files[0])
+    cand, why_c = load_artifact(args.files[1])
+    if base is None:
+        print(f"skip baseline {args.files[0]}: {why_b} — nothing to gate")
+        return 0
+    if cand is None:
+        print(f"skip candidate {args.files[1]}: {why_c} — no perf verdict "
+              f"possible")
+        return 0
+    regs, notes = compare(base, cand, threshold=args.threshold,
+                          p99_threshold=args.p99_threshold)
+    for ln in notes + regs:
+        print(ln)
+    print("perf_regress: " + ("REGRESSION" if regs else "OK"))
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,7 +44,7 @@ import sys
 __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_metrics_jsonl", "check_histogram_snapshot",
            "check_bench_json", "check_events_jsonl",
-           "check_healthmon_kinds", "check_file"]
+           "check_healthmon_kinds", "check_perfscope_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -79,10 +79,43 @@ IO_TRAINLOOP_FAMILIES = {
     "io/io.buffer_fill": "gauge",
     "trainloop/trainloop.chunks": "counter",
     "trainloop/trainloop.steps": "counter",
+    "trainloop/trainloop.dispatch_ms": "counter",
     "trainloop/trainloop.k": "gauge",
     "trainloop/trainloop.chunk_ms": "gauge",
     "trainloop/trainloop.in_program_lr": "gauge",
 }
+
+# The perfscope.* (roofline attribution) metric families
+# (docs/perfscope.md): per-program verdict counters, the step-time
+# decomposition gauges, and the device-time probe histogram.
+PERFSCOPE_FAMILIES = {
+    "perfscope/perfscope.programs_analyzed": "counter",
+    "perfscope/perfscope.compute_bound": "counter",
+    "perfscope/perfscope.hbm_bound": "counter",
+    "perfscope/perfscope.trivial": "counter",
+    "perfscope/perfscope.unknown": "counter",
+    "perfscope/perfscope.step_ms": "gauge",
+    "perfscope/perfscope.device_compute_ms": "gauge",
+    "perfscope/perfscope.collective_ms": "gauge",
+    "perfscope/perfscope.input_wait_ms": "gauge",
+    "perfscope/perfscope.host_gap_ms": "gauge",
+    "perfscope/perfscope.other_ms": "gauge",
+    "perfscope/perfscope.mfu": "gauge",
+    "perfscope/perfscope.device_step_ms": "histogram",
+}
+
+ROOFLINE_VERDICTS = ("compute_bound", "hbm_bound", "trivial", "unknown")
+
+# decomposition components that must sum (with "other" absorbing the
+# residual) to the measured step time
+PERFSCOPE_COMPONENTS = ("device_compute_ms", "collective_ms",
+                        "input_wait_ms", "host_gap_ms", "other_ms")
+
+# structural tolerance on |sum - step_ms| / step_ms. The CPU smoke
+# enforces the acceptance bound of 15%; the validator allows a little
+# more slack so a noisy-box artifact is flagged by the smoke (a perf
+# verdict) rather than rejected as malformed telemetry.
+PERFSCOPE_SUM_TOLERANCE = 0.25
 
 
 def _is_num(x) -> bool:
@@ -217,13 +250,14 @@ def check_flight(path: str) -> list:
 # ---------------------------------------------------------------------------
 
 def check_healthmon_kinds(kinds: dict) -> list:
-    """Every healthmon/*, io/* and trainloop/* metric must belong to its
-    family table with the declared kind."""
+    """Every healthmon/*, io/*, trainloop/* and perfscope/* metric must
+    belong to its family table with the declared kind."""
     errors = []
     tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
               ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
               ("trainloop/", IO_TRAINLOOP_FAMILIES,
-               "IO_TRAINLOOP_FAMILIES"))
+               "IO_TRAINLOOP_FAMILIES"),
+              ("perfscope/", PERFSCOPE_FAMILIES, "PERFSCOPE_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
             if not k.startswith(prefix):
@@ -472,6 +506,81 @@ def check_metrics_jsonl(path: str) -> list:
 
 
 # ---------------------------------------------------------------------------
+# perfscope bench section (extra.perfscope)
+# ---------------------------------------------------------------------------
+
+def check_perfscope_extra(ps) -> list:
+    """Validate an `extra.perfscope` BENCH section: per-program roofline
+    records with verdicts from the known taxonomy, a peak table, and —
+    when the run carried a step budget — a decomposition whose
+    components sum to the measured step time within tolerance."""
+    if ps is None:
+        return []
+    if not isinstance(ps, dict):
+        return [f"must be an object, got {type(ps).__name__}"]
+    errors = []
+    peaks = ps.get("peaks")
+    if not isinstance(peaks, dict):
+        errors.append("needs a 'peaks' object")
+    else:
+        for key in ("peak_flops_f32", "peak_flops_bf16", "hbm_bytes_per_s"):
+            v = peaks.get(key)
+            if not _is_num(v) or v <= 0:
+                errors.append(f"peaks[{key!r}] must be positive, got {v!r}")
+    progs = ps.get("programs")
+    if not isinstance(progs, list):
+        errors.append("needs a 'programs' list")
+    else:
+        for i, p in enumerate(progs):
+            if not isinstance(p, dict):
+                errors.append(f"programs[{i}]: not an object")
+                continue
+            if not isinstance(p.get("name"), str) or not p["name"]:
+                errors.append(f"programs[{i}]: missing/empty 'name'")
+            if p.get("verdict") not in ROOFLINE_VERDICTS:
+                errors.append(f"programs[{i}] ({p.get('name')!r}): verdict "
+                              f"{p.get('verdict')!r} not in "
+                              f"{ROOFLINE_VERDICTS}")
+            for key in ("flops", "bytes_accessed", "ai"):
+                v = p.get(key)
+                if v is not None and not _is_num(v):
+                    errors.append(f"programs[{i}] ({p.get('name')!r}): "
+                                  f"{key!r} must be numeric or null, "
+                                  f"got {v!r}")
+    d = ps.get("decomposition")
+    if d is None:
+        return errors
+    if not isinstance(d, dict):
+        return errors + ["decomposition must be an object"]
+    step_ms = d.get("step_ms")
+    if not _is_num(step_ms) or step_ms <= 0:
+        errors.append(f"decomposition.step_ms must be positive, "
+                      f"got {step_ms!r}")
+        return errors
+    total = 0.0
+    comp_ok = True
+    for key in PERFSCOPE_COMPONENTS:
+        v = d.get(key)
+        if not _is_num(v) or v < 0:
+            errors.append(f"decomposition[{key!r}] must be numeric >= 0, "
+                          f"got {v!r}")
+            comp_ok = False
+        else:
+            total += v
+    if comp_ok:
+        off = abs(total - step_ms) / step_ms
+        if off > PERFSCOPE_SUM_TOLERANCE:
+            errors.append(
+                f"components sum to {total:.4g} ms but step_ms="
+                f"{step_ms:.4g} ({off:.1%} apart, tolerance "
+                f"{PERFSCOPE_SUM_TOLERANCE:.0%})")
+    mfu = d.get("mfu")
+    if mfu is not None and (not _is_num(mfu) or not 0.0 <= mfu <= 1.5):
+        errors.append(f"decomposition.mfu={mfu!r} outside [0, 1.5]")
+    return errors
+
+
+# ---------------------------------------------------------------------------
 # bench result JSON (BENCH_*.json with serving stats)
 # ---------------------------------------------------------------------------
 
@@ -503,6 +612,9 @@ def check_bench_json(path: str) -> list:
         elif not (0.0 <= mfu <= 1.5):
             errors.append(f"extra.mfu={mfu} outside [0, 1.5] — wrong "
                           f"peak-FLOPs or flops-per-sample accounting")
+    errors += [f"extra.perfscope: {e}"
+               for e in check_perfscope_extra(
+                   (doc.get("extra") or {}).get("perfscope"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
