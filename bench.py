@@ -234,6 +234,55 @@ def _bench_perfscope_start():
     return ps.enable()
 
 
+def _bench_mesh():
+    """BENCH_MESH=dp4|dp2mp2|fsdp4|…: register a process-global device
+    mesh (mxtpu.sharding) so the steady phase runs through the SHARDED
+    executor — one jit whose in/out shardings carry the resolved
+    per-param NamedShardings, XLA inserting the collectives. Token
+    grammar: concatenated <axis><size> pairs (`dp2mp2` = 2×2); the
+    `fsdp` pseudo-axis names the data axis AND selects zero-style
+    param/state sharding. A layout with an `mp` axis runs mode='auto'
+    (Dense kernels / Embedding tables onto mp via the default rule
+    table). Returns the sharding mode, or None when BENCH_MESH is
+    unset. On CPU pair with XLA_FLAGS=--xla_force_host_platform_
+    device_count=N (tools/shard_smoke.sh does)."""
+    spec = os.environ.get("BENCH_MESH", "").strip()
+    if not spec:
+        return None
+    import re as _re
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from incubator_mxnet_tpu.parallel import sharding as _shmod
+    toks = _re.findall(r"([a-z]+)(\d+)", spec)
+    if not toks or "".join(f"{n}{s}" for n, s in toks) != spec:
+        raise ValueError(f"BENCH_MESH={spec!r}: expected concatenated "
+                         f"axis-size tokens (dp4, dp2mp2, fsdp4)")
+    mode, axes = "dp", {}
+    for name, size in toks:
+        if name == "fsdp":
+            mode, name = "fsdp", "dp"
+        if name in axes:
+            # dp2dp2 / fsdp2dp2 would silently keep only the last size —
+            # half the requested devices idle with no error
+            raise ValueError(f"BENCH_MESH={spec!r}: axis {name!r} given "
+                             f"more than once")
+        axes[name] = int(size)
+    if any(a in axes for a in _shmod.MODEL_AXES):
+        if mode == "fsdp":
+            # fsdp leaves the bench net unannotated, so an mp axis would
+            # just compute redundantly on every mp rank — reject rather
+            # than silently waste half the requested devices
+            raise ValueError(
+                f"BENCH_MESH={spec!r}: fsdp with a model axis is not "
+                f"supported by the bench driver (the bench net carries "
+                f"no model-axis annotations); use dp2mp2-style layouts")
+        mode = "auto"
+    mesh = make_mesh(axes)
+    _shmod.set_mesh(mesh)
+    _log(f"sharding: mesh {dict(mesh.shape)} mode={mode} over "
+         f"{mesh.size} of {len(jax.devices())} devices")
+    return mode
+
+
 def _perfscope_budget(steps_per_dispatch=1):
     """A primed StepBudget when perfscope is armed, else None."""
     from incubator_mxnet_tpu import perfscope as ps
@@ -971,6 +1020,9 @@ def main():
         _log("healthmon armed (watchdogs + structured event log)")
     if _bench_perfscope_start() is not None:
         _log("perfscope armed (roofline cost capture + step decomposition)")
+    # BENCH_MESH: register the global mesh BEFORE model build so param
+    # init and the executor resolve against it
+    shard_mode = _bench_mesh()
     np.random.seed(0)
     mx.random.seed(0)
 
@@ -1015,11 +1067,22 @@ def main():
     if loop_k > 1:
         from incubator_mxnet_tpu.trainloop import TrainLoop
         loop = TrainLoop(net, L, opt, chunk=loop_k,
-                         remat=os.environ.get("BENCH_REMAT") == "1")
+                         remat=os.environ.get("BENCH_REMAT") == "1",
+                         sharding=shard_mode)
         step = loop.step
     else:
         step = FusedTrainStep(net, L, opt,
-                              remat=os.environ.get("BENCH_REMAT") == "1")
+                              remat=os.environ.get("BENCH_REMAT") == "1",
+                              sharding=shard_mode)
+    if shard_mode is not None:
+        from incubator_mxnet_tpu.parallel import sharding as _shmod
+        dp_ax = _shmod.data_axis(step.mesh) or "dp"
+        dp_n = int(step.mesh.shape.get(dp_ax, 1))
+        if batch % dp_n:
+            raise ValueError(
+                f"BENCH_BATCH={batch} does not divide the {dp_ax}={dp_n} "
+                f"mesh axis (BENCH_MESH={os.environ['BENCH_MESH']}); "
+                f"pick a divisible global batch")
 
     # compile + warmup. NOTE: through the axon relay block_until_ready() does
     # not synchronize; a host value fetch is the only true barrier. Steps
@@ -1145,6 +1208,11 @@ def main():
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
     }
+    if shard_mode is not None:
+        # the resolved layout the executor actually compiled: mesh shape,
+        # per-param spec counts, fsdp on/off, per-device bytes
+        from incubator_mxnet_tpu.parallel import sharding as _shmod
+        result["extra"]["sharding"] = _shmod.summary()
     _perfscope_settle(result, budget, steps, dt, probe_fn,
                       steps_per_call=k,
                       flops_per_step=flops_per_sample * batch, dtype=dtype)

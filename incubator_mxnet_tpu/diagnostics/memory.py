@@ -44,7 +44,8 @@ from ..profiler.counters import set_gauge as _set_gauge
 
 __all__ = ["enable_memory", "disable_memory", "memory_enabled",
            "reset_memory", "memory_summary", "format_memory_summary",
-           "push_block", "pop_block", "reconcile", "logical_nbytes"]
+           "push_block", "pop_block", "reconcile", "logical_nbytes",
+           "shard_bytes_by_device"]
 
 
 def logical_nbytes(raw) -> int:
@@ -55,6 +56,28 @@ def logical_nbytes(raw) -> int:
     for s in raw.shape:
         n *= int(s)
     return n
+
+
+def shard_bytes_by_device(arrays) -> dict:
+    """{device: bytes} each device PHYSICALLY holds for these arrays —
+    a replicated array costs its full size on every device, a dp/mp
+    shard only its slice. THE shard-walking formula for both the
+    reconcile census and the sharding.*_bytes_per_device gauges
+    (parallel/sharding.py), so the FSDP memory evidence can't diverge
+    between the two surfaces. Arrays without addressable shards (plain
+    host/numpy buffers) are accounted under the key None."""
+    out = {}
+    for a in arrays:
+        shards = getattr(a, "addressable_shards", None)
+        if shards is None:
+            out[None] = out.get(None, 0) + int(getattr(a, "nbytes", 0) or 0)
+            continue
+        try:
+            for s in shards:
+                out[s.device] = out.get(s.device, 0) + int(s.data.nbytes)
+        except Exception:
+            continue
+    return out
 
 # fast-path predicate: read by Block.__call__ on every forward
 _ACTIVE = False
@@ -260,6 +283,14 @@ def reconcile() -> dict:
             out["jax_live_arrays"] = len(live)
             out["jax_live_bytes"] = int(sum(
                 getattr(a, "nbytes", 0) or 0 for a in live))
+            # sharding-aware census: what each device PHYSICALLY holds.
+            # This is the ledger evidence that an FSDP layout actually
+            # reduced per-device bytes — `nbytes` above is logical/
+            # global and cannot show it.
+            out["per_device_live_bytes"] = {
+                str(d): v
+                for d, v in shard_bytes_by_device(live).items()
+                if d is not None}
         except Exception:
             pass
     except Exception:

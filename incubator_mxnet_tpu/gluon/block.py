@@ -126,6 +126,62 @@ class Block:
         fn(self)
         return self
 
+    # -- sharding annotations (mxtpu.sharding, docs/sharding.md) ----------
+    def shard(self, spec="__unset__", recursive=True, **by_name):
+        """Attach GSPMD sharding annotations to this block's parameters.
+
+        `spec` is a `jax.sharding.PartitionSpec` whose entries may be
+        mesh axis names (``'dp'``, ``'mp'``) or LOGICAL names
+        (``'model'``, ``'batch'``, …) resolved through the active
+        `sharding.axis_rules` at build time. It applies to every
+        parameter in the subtree whose rank matches ``len(spec)`` —
+        `net.shard(P('model', None))` puts all 2-D kernels on the model
+        axis and leaves 1-D biases/norms alone. Keyword form targets
+        parameters by registered attribute name on each block:
+        `dense.shard(weight=P('model', None), bias=P())`.
+        `block.shard(None)` CLEARS the subtree's annotations.
+
+        Annotations are layout hints consumed by the sharded executor
+        (Trainer/TrainLoop/FusedTrainStep with a mesh); a dim that does
+        not divide its mesh axis falls back to replicated. Returns
+        ``self`` for chaining."""
+        from jax.sharding import PartitionSpec
+
+        matched = set()
+
+        def visit(blk):
+            for name, p in blk._reg_params.items():
+                if name in by_name:
+                    matched.add(name)
+                    p._sharding = by_name[name]
+                elif spec is None:
+                    p._sharding = None
+                elif spec != "__unset__" and p._shape is not None \
+                        and len(p._shape) == len(tuple(spec)):
+                    p._sharding = spec
+            if recursive:
+                for child in blk._children.values():
+                    visit(child)
+
+        if spec != "__unset__" and spec is not None \
+                and not isinstance(spec, PartitionSpec):
+            raise TypeError(f"spec must be a PartitionSpec or None, "
+                            f"got {type(spec).__name__}")
+        for v in by_name.values():
+            if v is not None and not isinstance(v, PartitionSpec):
+                raise TypeError("by-name sharding values must be "
+                                "PartitionSpec or None")
+        visit(self)
+        unmatched = set(by_name) - matched
+        if unmatched:
+            # a typo'd keyword must not leave the model silently
+            # replicated while the user believes it is sharded
+            raise ValueError(
+                f"shard() keywords {sorted(unmatched)} match no "
+                f"registered parameter in this subtree (this block "
+                f"registers: {sorted(self._reg_params)})")
+        return self
+
     # -- persistence ------------------------------------------------------
     def _collect_params_with_prefix(self, prefix=""):
         """Structural names ('features.0.weight'), independent of the

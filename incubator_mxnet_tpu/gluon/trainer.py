@@ -195,7 +195,7 @@ class Trainer:
                  compression_params=None, update_on_kvstore=None,
                  overlap_comm=False, comm_bucket_bytes=0,
                  comm_credit_bytes=4 << 20, fused_update=None,
-                 loop_chunk=None):
+                 loop_chunk=None, sharding=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -251,6 +251,22 @@ class Trainer:
             env = os.environ.get("MXTPU_LOOP_CHUNK", "").strip()
             loop_chunk = int(env) if env else None
         self.loop_chunk = int(loop_chunk) if loop_chunk else None
+        # sharding='dp'|'fsdp'|'auto' marks this trainer for MESH-NATIVE
+        # execution (mxtpu.sharding, docs/sharding.md): TrainLoop /
+        # FusedTrainStep constructed from this Trainer lower fwd+bwd+
+        # optimizer into ONE jit whose in/out shardings carry the
+        # resolved per-param NamedShardings — XLA inserts the
+        # collectives, replacing kvstore pushpull on that path. The
+        # eager step()/update() path ignores it (kvstore aggregation
+        # stays). Env default: MXTPU_SHARDING. Needs a process-global
+        # mesh (sharding.set_mesh) or an explicit mesh= at the executor.
+        if sharding is None:
+            sharding = os.environ.get("MXTPU_SHARDING", "").strip() or None
+        from ..parallel import sharding as _sharding_mod
+        if sharding is not None and sharding not in _sharding_mod.MODES:
+            raise ValueError(f"unknown sharding mode {sharding!r}; "
+                             f"expected one of {_sharding_mod.MODES}")
+        self.sharding = sharding
         self._kv_params_init = False
         self._sched = None
         if overlap_comm:

@@ -147,11 +147,30 @@ class _BucketedAllReduce:
                 self._flatten_cache[key] = fn
         return fn
 
+    @staticmethod
+    def _collective_mesh(devs):
+        """The 1-axis mesh the fused all-reduce rides. When the process-
+        global sharding mesh (parallel.sharding.set_mesh) is itself a
+        single axis over exactly these devices, return THE SAME Mesh
+        object — kvstore collectives and the sharded executor share one
+        mesh identity (one ICI ring layout, one XLA mesh context)
+        instead of each path minting its own. Multi-axis registry meshes
+        can't be identity-shared (the reduce needs one flat axis), so
+        those fall through to a private mesh and are not counted."""
+        from ..parallel import sharding as _sharding
+        gm = _sharding.get_mesh()
+        if gm is not None and len(gm.axis_names) == 1:
+            gdevs = tuple(np.ravel(np.asarray(gm.devices, dtype=object)))
+            if gdevs == tuple(devs):
+                _prof.counter("kvstore.mesh_reuse").increment()
+                return gm
+        return Mesh(np.array(devs), ("kv",))
+
     def _reduce_fn(self, devs, shapes, dtype):
         key = (devs, shapes, dtype)
         hit = self._reduce_cache.get(key)
         if hit is None:
-            mesh = Mesh(np.array(devs), ("kv",))
+            mesh = self._collective_mesh(devs)
             sizes = [int(np.prod(s)) if s else 1 for s in shapes]
             offs = np.cumsum([0] + sizes)
 
@@ -201,7 +220,9 @@ class _BucketedAllReduce:
         total = bufs[0].shape[0]
         devs = tuple(dev_slots)
         fn, mesh = self._reduce_fn(devs, shapes, dtype)
-        sharding = NamedSharding(mesh, P("kv"))
+        # the mesh may be the reused registry mesh, whose one axis is
+        # named dp/ep/… rather than "kv" — shard over whatever it has
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         stacked = jax.make_array_from_single_device_arrays(
             (n_dev, total), sharding,
             [jax.device_put(b, d)[None] for b, d in zip(bufs, devs)])

@@ -8,13 +8,23 @@ tell XLA where tensors live; XLA inserts the collectives (all-reduce /
 all-gather / reduce-scatter) over ICI. Axes convention:
 
     dp  data parallel        (batch dim)
+    mp  model parallel       (the documented spelling; `tp` recognized)
     tp  tensor parallel      (hidden/heads dims, Megatron-style)
     pp  pipeline parallel    (layer stages, lax.scan + ppermute)
     sp  sequence parallel    (sequence dim: ring attention or
                              Ulysses all-to-all — both exact)
     ep  expert parallel      (MoE experts)
+
+The process-global mesh registry lives in `sharding`
+(`set_mesh(make_mesh({'dp': -1, 'mp': 2}))`); every component here —
+FusedTrainStep/TrainLoop, the seed helpers (tensor_parallel /
+ring_attention / moe), kvstore's bucketed all-reduce — resolves against
+it when no explicit mesh is passed. See docs/sharding.md.
 """
+from . import fsdp, sharding
 from .mesh import make_mesh, data_parallel_spec
+from .sharding import (set_mesh, get_mesh, clear_mesh, use_mesh,
+                       axis_rules, auto_shard)
 from .trainer_step import FusedTrainStep
 from .ring_attention import ring_attention, ring_self_attention
 from .ulysses import ulysses_attention, ulysses_self_attention
@@ -25,6 +35,8 @@ from .tensor_parallel import (column_parallel, row_parallel,
 from .checkpoint import (save_train_step, restore_train_step, latest_step)
 
 __all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep",
+           "sharding", "fsdp", "set_mesh", "get_mesh", "clear_mesh",
+           "use_mesh", "axis_rules", "auto_shard",
            "ring_attention", "ring_self_attention",
            "ulysses_attention", "ulysses_self_attention", "pipeline_apply",
            "spmd_pipeline", "moe_gate", "moe_ffn", "MoEFFN",

@@ -2,12 +2,14 @@
 
 Reference analogue: `module.save_checkpoint` + the kvstore server's state
 dump (each server persists its own shard of the optimizer state).
-TPU-native redesign: training state lives as sharded `jax.Array`s (ZeRO-1
-optimizer shards over dp, tp-sharded params over the mesh), so the
+TPU-native redesign: training state lives as sharded `jax.Array`s (FSDP/
+ZeRO param+state shards over dp — `FusedTrainStep(sharding='fsdp')`, see
+docs/sharding.md — or mp/tp-sharded params over the mesh), so the
 checkpoint layer must write each array AS ITS SHARDS — every host saves
 its local shards in parallel (orbax/TensorStore OCDBT), and restore
 reassembles to the SAME shardings with no gather onto one host. A
-single-chip run uses the identical API/files.
+single-chip run uses the identical API/files (CPU coverage:
+tests/test_sharded_checkpoint.py's subprocess FSDP round trip).
 
 Usage::
 

@@ -11,13 +11,27 @@ __all__ = ["make_mesh", "data_parallel_spec", "replicated", "shard_batch"]
 
 def make_mesh(axes: dict | None = None, devices=None) -> Mesh:
     """make_mesh({'dp': 4, 'tp': 2}) → Mesh over the first 8 devices.
-    A -1 axis absorbs the remaining device count (like reshape)."""
+    A single -1 axis absorbs the remaining device count (like reshape).
+    A 1-device mesh is valid (annotations all no-op to replicated), so
+    the same construction code runs from laptop to pod."""
     devices = list(devices if devices is not None else jax.devices())
     axes = dict(axes or {"dp": len(devices)})
     names = list(axes.keys())
-    sizes = list(axes.values())
+    sizes = [int(s) for s in axes.values()]
+    bad = [s for s in sizes if s == 0 or s < -1]
+    if bad:
+        raise ValueError(f"mesh axis sizes must be positive (or one -1), "
+                         f"got {dict(zip(names, sizes))}")
+    if sizes.count(-1) > 1:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} has more than one -1 axis; "
+            f"only one axis may absorb the remaining devices")
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(
+                f"mesh {dict(zip(names, sizes))}: {len(devices)} devices "
+                f"do not divide evenly by the fixed axes (product {known})")
         sizes[sizes.index(-1)] = len(devices) // known
     total = int(np.prod(sizes))
     if total > len(devices):

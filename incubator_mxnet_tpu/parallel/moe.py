@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["moe_gate", "moe_ffn", "MoEFFN"]
@@ -110,6 +111,28 @@ class MoEFFN:
         ep = self.ep_axis
         return {"gate_w": P(), "w1": P(ep, None, None), "b1": P(ep, None),
                 "w2": P(ep, None, None), "b2": P(ep, None)}
+
+    def resolve_shardings(self, mesh=None):
+        """`shardings()` resolved against a concrete mesh through the
+        shared registry (mesh=None → the process-global mesh): raw
+        PartitionSpecs become NamedShardings; an ep axis the mesh lacks —
+        or an expert count that doesn't divide it — falls back to
+        replicated, same contract as parameter resolution."""
+        from jax.sharding import NamedSharding
+        from . import sharding as _sharding
+        if mesh is None:
+            mesh = _sharding.get_mesh(required=True)
+        e = self.num_experts
+        out = {}
+        for name, spec in self.shardings().items():
+            resolved = _sharding.resolve_spec(spec, mesh)
+            if len(resolved) > 0 and resolved[0] is not None:
+                ax = resolved[0]
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                if e % int(np.prod([mesh.shape[a] for a in axes])):
+                    resolved = P()
+            out[name] = NamedSharding(mesh, resolved)
+        return out
 
     def __call__(self, params, x):
         return moe_ffn(x, params["gate_w"], params["w1"], params["b1"],

@@ -75,14 +75,37 @@ def _ring_body(q, k, v, *, axis_name, n_shards, scale, causal):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *, causal=False,
-                   scale=None, batch_axis: str | None = None):
+def _resolve_mesh_axis(mesh, axis):
+    """mesh=None → the process-global registry mesh (parallel.sharding);
+    axis=None → the mesh's 'sp'/'seq' axis. Shared by ring and Ulysses so
+    `ring_attention(q, k, v)` works after one set_mesh call."""
+    from . import sharding as _sharding
+    if mesh is None:
+        mesh = _sharding.get_mesh(required=True)
+    if axis is None:
+        for name in ("sp", "seq"):
+            if name in mesh.shape:
+                axis = name
+                break
+        else:
+            raise ValueError(
+                f"mesh axes {tuple(mesh.shape)} have no sequence axis "
+                f"('sp'/'seq'); pass axis= explicitly")
+    return mesh, axis
+
+
+def ring_attention(q, k, v, mesh: Mesh | None = None, axis: str | None = None,
+                   *, causal=False, scale=None,
+                   batch_axis: str | None = None):
     """Sequence-parallel attention on (B, H, L, D) arrays.
 
     L is sharded over mesh axis `axis`; optionally B over `batch_axis` (dp).
-    Returns (B, H, L, D) with the same sharding as q. Exact (not approximate):
-    equals single-device softmax attention up to f32 accumulation order.
+    mesh=None resolves the process-global registry mesh, axis=None its
+    'sp'/'seq' axis. Returns (B, H, L, D) with the same sharding as q.
+    Exact (not approximate): equals single-device softmax attention up to
+    f32 accumulation order.
     """
+    mesh, axis = _resolve_mesh_axis(mesh, axis)
     n = mesh.shape[axis]
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
@@ -115,11 +138,12 @@ def _self_attention_block(core, x, wqkv, wo, num_heads, mesh, axis, *,
     return out @ wo
 
 
-def ring_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
+def ring_self_attention(x, wqkv, wo, num_heads, mesh=None, axis=None, *,
                         causal=False, batch_axis=None):
     """(B, L, D) self-attention block with ring-parallel core: qkv/out
     projections run on the local sequence shard (no collective), only the
-    attention core rotates KV."""
+    attention core rotates KV. mesh/axis default through the registry."""
+    mesh, axis = _resolve_mesh_axis(mesh, axis)
     return _self_attention_block(ring_attention, x, wqkv, wo, num_heads,
                                  mesh, axis, causal=causal,
                                  batch_axis=batch_axis)

@@ -17,41 +17,58 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+from . import sharding as _sharding
+
 __all__ = ["column_parallel", "row_parallel", "annotate_bert_tp",
            "annotate_ffn_tp"]
 
 
-def column_parallel(dense, axis: str = "tp"):
-    """Split a gluon Dense over its output (units) dim."""
+def _model_axis(axis):
+    """axis=None resolves through the shared mesh registry: the global
+    mesh's model axis when one is set, else the LOGICAL 'model' name —
+    which the rule table maps to mp/tp at build, so annotations written
+    without a mesh still land on whatever mesh the run registers."""
+    if axis is not None:
+        return axis
+    return _sharding.model_axis() or "model"
+
+
+def column_parallel(dense, axis: str | None = None):
+    """Split a gluon Dense over its output (units) dim. axis=None uses
+    the registry's model axis (see _model_axis)."""
+    axis = _model_axis(axis)
     dense.weight._sharding = P(axis, None)
     if dense.bias is not None:
         dense.bias._sharding = P(axis)
     return dense
 
 
-def row_parallel(dense, axis: str = "tp"):
+def row_parallel(dense, axis: str | None = None):
     """Split a gluon Dense over its input dim; output is partial-summed by an
-    XLA all-reduce."""
+    XLA all-reduce. axis=None uses the registry's model axis."""
+    axis = _model_axis(axis)
     dense.weight._sharding = P(None, axis)
     if dense.bias is not None:
         dense.bias._sharding = P()
     return dense
 
 
-def annotate_ffn_tp(ffn, axis: str = "tp"):
+def annotate_ffn_tp(ffn, axis: str | None = None):
     """PositionwiseFFN: ffn_1 column-parallel, ffn_2 row-parallel."""
+    axis = _model_axis(axis)
     column_parallel(ffn.ffn_1, axis)
     row_parallel(ffn.ffn_2, axis)
     return ffn
 
 
-def annotate_bert_tp(bert_model, axis: str = "tp"):
+def annotate_bert_tp(bert_model, axis: str | None = None):
     """Annotate a models.bert.BERTModel for tensor parallelism.
 
     Per encoder cell: fused qkv column-parallel (heads split over tp), output
     proj row-parallel, FFN column->row. Embeddings: vocab dim split (the
     gather's all-reduce is inserted by XLA). LayerNorms stay replicated.
     """
+    axis = _model_axis(axis)
     bert_model.word_embed.weight._sharding = P(axis, None)
     for cell in bert_model.encoder.cells:
         column_parallel(cell.attention.qkv, axis)

@@ -9,6 +9,8 @@ the NCCL ring of `kvstore=dist_sync_device`, compiled away.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -22,6 +24,8 @@ from ..gluon.trainer import Trainer
 from ..ndarray import NDArray
 from ..ndarray import random as ndrandom
 from .. import optimizer as opt_mod
+from . import fsdp as _fsdp
+from . import sharding as _sharding
 
 __all__ = ["FusedTrainStep"]
 
@@ -34,10 +38,11 @@ class FusedTrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh: Mesh | None = None,
-                 data_axis: str = "dp", donate: bool = True,
+                 data_axis: str | None = None, donate: bool = True,
                  remat: bool = False, remat_policy: str | None = None,
                  shard_optimizer_states: bool = False,
-                 schedule_in_program: bool = False):
+                 schedule_in_program: bool = False,
+                 sharding: str | None = None):
         """remat=True rematerializes the forward during backward
         (jax.checkpoint with the dots-saveable policy) — the TPU-native
         form of the reference's memonger/mirror_stage memory trade:
@@ -63,22 +68,57 @@ class FusedTrainStep:
         the host never touches the scheduler inside a chunk. Falls back
         to the host-sampled per-micro-step lr table when the scheduler
         has no closed form; either way run_k matches a sequential loop
-        step-for-step (the k-granularity coarsening is gone)."""
+        step-for-step (the k-granularity coarsening is gone).
+
+        sharding='dp'|'fsdp'|'auto' picks the parallelism policy
+        (mxtpu.sharding, docs/sharding.md): 'dp' replicates params and
+        shards the batch over the data axis; 'fsdp' additionally shards
+        unannotated params AND optimizer states over the data axis
+        (all-gathered in-program by XLA — zero-style; same math, losses
+        within ~1 ulp/step of the replicated run since the collective's
+        reduction order is the compiler's); 'auto' first applies the
+        default rule table to the net
+        (Dense kernels / Embedding tables onto the model axis when the
+        mesh has one). Defaults: the Trainer's `sharding=` flag when one
+        is passed as `optimizer`, else $MXTPU_SHARDING, else 'dp'. With
+        no mesh (explicit or process-global via sharding.set_mesh) the
+        mode is a single-device no-op. Explicit Parameter annotations
+        (Block.shard / logical axis rules) are honored in EVERY mode."""
         self.net = net
         self.loss_fn = loss_fn
         if isinstance(optimizer, Trainer):
+            if sharding is None:
+                sharding = getattr(optimizer, "sharding", None)
             self.optimizer = optimizer.optimizer
         elif isinstance(optimizer, str):
             self.optimizer = opt_mod.create(optimizer)
         else:
             self.optimizer = optimizer
+        if sharding is None:
+            sharding = os.environ.get("MXTPU_SHARDING", "").strip() or None
+        if sharding is not None and sharding not in _sharding.MODES:
+            raise ValueError(f"unknown sharding mode {sharding!r}; "
+                             f"expected one of {_sharding.MODES}")
+        if mesh is None:
+            mesh = _sharding.get_mesh()
         self.mesh = mesh
+        self.sharding = (sharding or "dp") if mesh is not None else None
+        if data_axis is None:
+            data_axis = (_sharding.data_axis(mesh) or "dp") \
+                if mesh is not None else "dp"
         self.data_axis = data_axis
         self.donate = donate
         self.remat = remat
         self.remat_policy = remat_policy
         self.schedule_in_program = schedule_in_program
+        if self.sharding == "fsdp":
+            # FSDP subsumes ZeRO-1: states follow their (dp-sharded)
+            # weights; the zero1 flag additionally shards states of any
+            # still-replicated weight
+            shard_optimizer_states = True
         self.shard_optimizer_states = shard_optimizer_states and mesh is not None
+        self._stats_published = False
+        self._auto_specs = {}     # sharding='auto': ephemeral defaults
         self._jitted = None
         self._jitted_k = None
         self._stacked_sharding = None   # set by _build_k under a mesh
@@ -113,6 +153,12 @@ class FusedTrainStep:
         # corruption instead of letting the step train on garbage
         from ..runtime import cache_guard as _cg
         _cg.check()
+        # 'auto' defaults (Dense kernels / Embedding tables onto the
+        # model axis) are resolved EPHEMERALLY — the net's own
+        # annotations are never mutated, so a later sharding='dp' build
+        # of the same net stays replicated
+        self._auto_specs = (_sharding.auto_specs(self.net)
+                            if self.sharding == "auto" else {})
         # one eager pass completes deferred shapes
         try:
             all_params = list(self.net.collect_params().values())
@@ -189,43 +235,47 @@ class FusedTrainStep:
         kwargs = {}
         self._sharding_info = None
         if self.mesh is not None:
-            batch_sharding = NamedSharding(self.mesh, P(self.data_axis))
+            # batch over the data axis (replicated on a pure-mp mesh)
+            batch_spec = (P(self.data_axis)
+                          if self.data_axis in self.mesh.shape else P())
+            batch_sharding = NamedSharding(self.mesh, batch_spec)
             repl = NamedSharding(self.mesh, P())
 
-            def pspec(p):
-                spec = p._sharding if p._sharding is not None else P()
-                # replicate instead of shard when a dim doesn't divide the
-                # mesh axis (e.g. unpadded vocab under tp) — annotation is a
-                # layout hint, never a correctness constraint
-                shape = p.shape
-                for d, ax in enumerate(spec):
-                    if ax is None:
-                        continue
-                    axes = ax if isinstance(ax, tuple) else (ax,)
-                    if any(a not in self.mesh.shape for a in axes):
-                        return NamedSharding(self.mesh, P())
-                    size = int(np.prod([self.mesh.shape[a] for a in axes]))
-                    if d >= len(shape) or shape[d] % size:
-                        return NamedSharding(self.mesh, P())
-                return NamedSharding(self.mesh, spec)
+            # annotation resolution moved to mxtpu.sharding: logical axis
+            # names map through the active rule table, and a dim that
+            # doesn't divide the mesh axis (e.g. unpadded vocab under mp)
+            # falls back to replicated — a layout hint, never a
+            # correctness constraint. Under FSDP, unannotated trainable
+            # params shard their leading dim over the data axis instead
+            # of replicating (all-gathered in-program by XLA).
+            if self.sharding == "fsdp":
+                def pspec(p):
+                    return _fsdp.fsdp_sharding(p, self.mesh, self.data_axis)
+            else:
+                def pspec(p):
+                    return _sharding.resolve_param(
+                        p, self.mesh,
+                        default_spec=self._auto_specs.get(id(p)))
 
             train_sh = [pspec(params[i]) for i in self.train_idx]
-            aux_sh = [pspec(params[i]) for i in self.aux_idx]
+            # aux state (BatchNorm running stats) never FSDP-shards —
+            # explicit annotations only
+            aux_sh = [_sharding.resolve_param(params[i], self.mesh)
+                      for i in self.aux_idx]
             # optimizer state inherits its weight's sharding — or, under
             # ZeRO-1, shards its leading axis over the dp group
             def state_spec(j, leaf):
                 # only ZeRO-shard states of otherwise-replicated weights:
                 # tp/sp-sharded weights already split their state, and
-                # stacking dp on top would reshard every step
+                # stacking dp on top would reshard every step. The
+                # leading-dim-over-dp policy is fsdp_spec — ONE place
+                # for the divisibility/fallback rule.
                 if (self.shard_optimizer_states
                         and train_sh[j].spec == P()):
-                    shape = np.shape(leaf)
-                    dp = self.mesh.shape.get(self.data_axis, 1)
-                    if shape and shape[0] % dp == 0 and dp > 1:
-                        return NamedSharding(
-                            self.mesh,
-                            P(self.data_axis,
-                              *([None] * (len(shape) - 1))))
+                    spec = _fsdp.fsdp_spec(np.shape(leaf), self.mesh,
+                                           self.data_axis)
+                    if spec is not None:
+                        return NamedSharding(self.mesh, spec)
                 return train_sh[j]
 
             state_sh = [jax.tree_util.tree_map(
@@ -334,8 +384,8 @@ class FusedTrainStep:
         t = jnp.int32(self._num_update)
         key = ndrandom._key()
         xb, yb = x._data, y._data
-        if self.mesh is not None:
-            batch_sharding = NamedSharding(self.mesh, P(self.data_axis))
+        if self._sharding_info is not None:
+            batch_sharding = self._sharding_info[4]   # resolved in _build
             xb = jax.device_put(xb, batch_sharding)
             yb = jax.device_put(yb, batch_sharding)
         train_raws = [self.params[i].data()._data for i in self.train_idx]
@@ -362,6 +412,12 @@ class FusedTrainStep:
         for j, i in enumerate(self.aux_idx):
             self.params[i]._data._data = new_aux[j]
         self._states = new_states
+        if not self._stats_published and self.mesh is not None:
+            # one-time layout telemetry: the params now carry the
+            # shardings the compiled program actually produced
+            self._stats_published = True
+            _sharding.publish_param_stats(self.params, self._states,
+                                          self.mesh, self.sharding)
         # fully-fused path: forward+backward+collective+update is ONE XLA
         # dispatch per step (bench.py surfaces this in BENCH_*.json)
         _prof.set_gauge("trainer.dispatches_per_step", 1)
@@ -425,6 +481,10 @@ class FusedTrainStep:
         for j, i in enumerate(self.aux_idx):
             self.params[i]._data._data = new_aux[j]
         self._states = new_states
+        if not self._stats_published and self.mesh is not None:
+            self._stats_published = True
+            _sharding.publish_param_stats(self.params, self._states,
+                                          self.mesh, self.sharding)
         # one dispatch drives k micro-steps
         _prof.set_gauge("trainer.dispatches_per_step", round(1.0 / k, 4))
         return NDArray(losses)

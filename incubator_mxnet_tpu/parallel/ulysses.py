@@ -65,17 +65,22 @@ def _ulysses_body(q, k, v, *, axis_name, scale, causal):
     return head_to_seq(o)
 
 
-def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
+def ulysses_attention(q, k, v, mesh: Mesh | None = None,
+                      axis: str | None = None, *,
                       causal=False, scale=None,
                       batch_axis: str | None = None):
     """All-to-all sequence-parallel attention on (B, H, L, D) arrays.
 
     L sharded over mesh axis `axis` on input AND output; internally heads
     are sharded instead so the core is ordinary dense attention. Requires
-    H % n == 0 and L % n == 0. Exact: equals single-device softmax
-    attention up to f32 accumulation order; same signature as
-    `ring_attention` so callers can switch schemes with one name.
+    H % n == 0 and L % n == 0. mesh/axis default through the shared mesh
+    registry (parallel.sharding), like ring_attention. Exact: equals
+    single-device softmax attention up to f32 accumulation order; same
+    signature as `ring_attention` so callers can switch schemes with one
+    name.
     """
+    from .ring_attention import _resolve_mesh_axis
+    mesh, axis = _resolve_mesh_axis(mesh, axis)
     n = mesh.shape[axis]
     h, L = q.shape[1], q.shape[2]
     if h % n:
@@ -93,12 +98,15 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def ulysses_self_attention(x, wqkv, wo, num_heads, mesh, axis="sp", *,
+def ulysses_self_attention(x, wqkv, wo, num_heads, mesh=None, axis=None, *,
                            causal=False, batch_axis=None):
     """(B, L, D) self-attention block with the Ulysses core: projections
     run on the local sequence shard, two all-to-alls bracket the dense
-    attention (mirror of `ring_self_attention`)."""
-    from .ring_attention import _self_attention_block
+    attention (mirror of `ring_self_attention`). mesh/axis default
+    through the registry."""
+    from .ring_attention import (_resolve_mesh_axis,
+                                 _self_attention_block)
+    mesh, axis = _resolve_mesh_axis(mesh, axis)
     return _self_attention_block(ulysses_attention, x, wqkv, wo, num_heads,
                                  mesh, axis, causal=causal,
                                  batch_axis=batch_axis)

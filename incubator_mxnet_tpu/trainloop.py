@@ -30,6 +30,11 @@ What this fixes over the bench-only ``FusedTrainStep.run_k`` knob:
   selection layer (ops/select.py), so flash-attention / fused layernorm
   / fused BN+relu kernels land inside the loop program when shapes
   qualify.
+* **mesh-native parallelism** — ``Trainer(..., sharding='dp'|'fsdp'|
+  'auto')`` (or an explicit ``mesh=``) lowers the whole chunk with the
+  resolved per-param NamedShardings (mxtpu.sharding), so XLA inserts
+  the dp gradient all-reduce / FSDP all-gathers INSIDE the one compiled
+  program; see docs/sharding.md.
 
 Telemetry (domain ``trainloop``): ``trainloop.chunks`` /
 ``trainloop.steps`` counters, ``trainloop.k`` / ``trainloop.chunk_ms`` /
@@ -91,16 +96,19 @@ class TrainLoop:
     same contract as FusedTrainStep)."""
 
     def __init__(self, net, loss_fn, optimizer, chunk=None, mesh=None,
-                 data_axis="dp", donate=True, remat=False, remat_policy=None,
-                 prefetch_depth=2, schedule_in_program=True):
+                 data_axis=None, donate=True, remat=False, remat_policy=None,
+                 prefetch_depth=2, schedule_in_program=True, sharding=None):
         self.chunk = resolve_chunk(explicit=chunk, optimizer=optimizer)
         if self.chunk < 1:
             raise ValueError(f"loop chunk must be >= 1, got {self.chunk}")
         self.prefetch_depth = int(prefetch_depth)
+        # sharding mode and mesh resolve exactly like FusedTrainStep's:
+        # explicit arg > Trainer.sharding > MXTPU_SHARDING; explicit
+        # mesh > process-global sharding.set_mesh (docs/sharding.md)
         self.step = FusedTrainStep(
             net, loss_fn, optimizer, mesh=mesh, data_axis=data_axis,
             donate=donate, remat=remat, remat_policy=remat_policy,
-            schedule_in_program=schedule_in_program)
+            schedule_in_program=schedule_in_program, sharding=sharding)
         self._c_chunks = _prof.counter("trainloop.chunks", "trainloop")
         self._c_steps = _prof.counter("trainloop.steps", "trainloop")
         # cumulative host wall spent INSIDE run_chunk dispatches — the

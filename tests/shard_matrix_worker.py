@@ -1,0 +1,146 @@
+"""Subprocess worker for the CPU-mesh tier-1 matrix (tests/test_sharding.py).
+
+Runs a small fixed-seed MLP train run under one (dp, mp) layout on 4
+FAKE host devices (--xla_force_host_platform_device_count=4 — set HERE,
+before jax import, so the test process's own 8-device config can't
+leak in) and prints one JSON line with bit-exact losses (float.hex),
+the resolved per-param specs/shard shapes, per-device byte accounting
+and the diagnostics ledger census. The parent compares layouts against
+the single-device run — pod-scale layouts verified on every CPU CI run.
+
+Usage: python shard_matrix_worker.py single|dp4|dp2mp2|fsdp4 [--ckpt]
+
+--ckpt additionally exercises the sharded checkpoint path (the
+migration target of the skip-listed zero1 XLA:CPU segfault test):
+save mid-run, restore into a fresh step, and verify the resumed losses
+and restored state shardings in-process.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# isolate from the suite's persistent compile cache (the PR 4 lesson:
+# donated/sharded executables re-read from cache can deserialize wrong)
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, nd  # noqa: E402
+from incubator_mxnet_tpu.gluon import nn  # noqa: E402
+from incubator_mxnet_tpu.parallel import (FusedTrainStep, fsdp, make_mesh,  # noqa: E402
+                                          set_mesh, sharding)
+
+STEPS = 6
+BATCH = 16
+
+
+def _net():
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"),
+            nn.Dense(16, activation="relu"),
+            nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _data(seed):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(BATCH, 8).astype(np.float32)),
+            nd.array(rng.randint(0, 4, BATCH)))
+
+
+def _build_step(layout, opt="sgd"):
+    if layout == "single":
+        mode = None
+    elif layout == "dp4":
+        set_mesh(make_mesh({"dp": 4}))
+        mode = "dp"
+    elif layout == "dp2mp2":
+        set_mesh(make_mesh({"dp": 2, "mp": 2}))
+        mode = "auto"
+    elif layout == "fsdp4":
+        set_mesh(make_mesh({"dp": -1}))
+        mode = "fsdp"
+    else:
+        raise SystemExit(f"unknown layout {layout!r}")
+    return FusedTrainStep(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create(opt, learning_rate=1e-2
+                                              if opt == "adam" else 0.1),
+                          sharding=mode)
+
+
+def _losses(step, n, seed0=100):
+    out = []
+    for i in range(n):
+        x, y = _data(seed0 + i)
+        out.append(float(step(x, y)))
+    return out
+
+
+def main():
+    layout = sys.argv[1]
+    ckpt = "--ckpt" in sys.argv[2:]
+    # ckpt mode trains with adam so SHARDED optimizer state (the zero1
+    # scenario that segfaulted XLA:CPU at seed) rides through orbax
+    step = _build_step(layout, opt="adam" if ckpt else "sgd")
+    losses = _losses(step, STEPS)
+
+    result = {
+        "layout": layout,
+        "devices": len(jax.devices()),
+        # float.hex round-trips exactly — the parent's parity check is
+        # BIT-level, not a tolerance
+        "losses_hex": [float(v).hex() for v in losses],
+        "losses": losses,
+        "specs": {p.name: str(getattr(p.data()._data.sharding, "spec",
+                                      "single_device"))
+                  for p in step.params},
+        "shard0_shapes": {
+            p.name: list(next(iter(p.data()._data.addressable_shards))
+                         .data.shape)
+            for p in step.params},
+        "report": fsdp.memory_report(step),
+        "summary": sharding.summary(),
+    }
+    from incubator_mxnet_tpu.diagnostics import memory as dmem
+    rec = dmem.reconcile()
+    result["per_device_live_bytes"] = rec.get("per_device_live_bytes")
+
+    if ckpt:
+        import tempfile
+        from incubator_mxnet_tpu.parallel import (restore_train_step,
+                                                  save_train_step)
+        with tempfile.TemporaryDirectory() as tmp:
+            live_sh = [str(getattr(s, "sharding", None))
+                       for s in jax.tree_util.tree_leaves(step._states)]
+            save_train_step(tmp, step)
+            gold_tail = _losses(step, 3, seed0=200)   # uninterrupted
+            fresh = _build_step(layout, opt="adam")
+            x, y = _data(0)
+            fresh(x, y)                               # build (junk update)
+            n = restore_train_step(tmp, fresh)
+            back_sh = [str(getattr(s, "sharding", None))
+                       for s in jax.tree_util.tree_leaves(fresh._states)]
+            resumed_tail = _losses(fresh, 3, seed0=200)
+            result["ckpt"] = {
+                "restored_step": n,
+                "shardings_preserved": live_sh == back_sh,
+                "resume_exact": [float(v).hex() for v in resumed_tail]
+                                == [float(v).hex() for v in gold_tail],
+                "gold_tail": gold_tail,
+                "resumed_tail": resumed_tail,
+            }
+
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

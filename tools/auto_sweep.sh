@@ -45,6 +45,13 @@ if timeout 900 bash tools/perfscope_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) perfscope smoke FAILED (continuing; perf attribution suspect)" >> "$LOG"
 fi
+# sharding smoke (CPU-only 4-fake-device mesh matrix): dp/mp/fsdp loss
+# parity + sharding.* telemetry must hold before any pod-layout sweep
+if timeout 1800 bash tools/shard_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) shard smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) shard smoke FAILED (continuing; sharded executor suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -21,10 +21,11 @@
 * **structured event logs** (`healthmon.events` / ``mxtpu.events/1``
   JSONL, including `mxdiag merge` output) — per-record schema with the
   run_id/rank/step correlation ids, non-decreasing timestamps;
-* **healthmon counter families** — any `healthmon/*` metric appearing
-  in a flight dump or metrics series must belong to the known family
-  table with the declared kind (an unknown or re-kinded healthmon
-  metric means a producer drifted from the documented schema).
+* **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
+  `perfscope/*` or `sharding/*` metric appearing in a flight dump or
+  metrics series must belong to the known family table with the
+  declared kind (an unknown or re-kinded metric means a producer
+  drifted from the documented schema).
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -44,7 +45,8 @@ import sys
 __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_metrics_jsonl", "check_histogram_snapshot",
            "check_bench_json", "check_events_jsonl",
-           "check_healthmon_kinds", "check_perfscope_extra", "check_file"]
+           "check_healthmon_kinds", "check_perfscope_extra",
+           "check_sharding_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -84,6 +86,28 @@ IO_TRAINLOOP_FAMILIES = {
     "trainloop/trainloop.chunk_ms": "gauge",
     "trainloop/trainloop.in_program_lr": "gauge",
 }
+
+# The sharding.* (mesh-native GSPMD layout) metric families
+# (docs/sharding.md): annotation-resolution counters, the registered
+# mesh shape, per-param spec counts and the per-device byte gauges the
+# FSDP memory assertion reads.
+SHARDING_FAMILIES = {
+    "sharding/sharding.resolves": "counter",
+    "sharding/sharding.fallback_replicated": "counter",
+    "sharding/sharding.mesh_devices": "gauge",
+    "sharding/sharding.mesh_dp": "gauge",
+    "sharding/sharding.mesh_mp": "gauge",
+    "sharding/sharding.params_total": "gauge",
+    "sharding/sharding.params_model_sharded": "gauge",
+    "sharding/sharding.params_data_sharded": "gauge",
+    "sharding/sharding.params_replicated": "gauge",
+    "sharding/sharding.fsdp": "gauge",
+    "sharding/sharding.param_bytes_per_device": "gauge",
+    "sharding/sharding.state_bytes_per_device": "gauge",
+}
+
+# sharding modes a BENCH extra.sharding may declare (parallel/sharding.py)
+SHARDING_MODES = ("dp", "fsdp", "auto")
 
 # The perfscope.* (roofline attribution) metric families
 # (docs/perfscope.md): per-program verdict counters, the step-time
@@ -250,14 +274,15 @@ def check_flight(path: str) -> list:
 # ---------------------------------------------------------------------------
 
 def check_healthmon_kinds(kinds: dict) -> list:
-    """Every healthmon/*, io/*, trainloop/* and perfscope/* metric must
-    belong to its family table with the declared kind."""
+    """Every healthmon/*, io/*, trainloop/*, perfscope/* and sharding/*
+    metric must belong to its family table with the declared kind."""
     errors = []
     tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
               ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
               ("trainloop/", IO_TRAINLOOP_FAMILIES,
                "IO_TRAINLOOP_FAMILIES"),
-              ("perfscope/", PERFSCOPE_FAMILIES, "PERFSCOPE_FAMILIES"))
+              ("perfscope/", PERFSCOPE_FAMILIES, "PERFSCOPE_FAMILIES"),
+              ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
             if not k.startswith(prefix):
@@ -580,6 +605,51 @@ def check_perfscope_extra(ps) -> list:
     return errors
 
 
+def check_sharding_extra(sh) -> list:
+    """Validate an `extra.sharding` BENCH section (bench.py BENCH_MESH
+    runs): a positive mesh shape, a mode from the closed taxonomy, and
+    spec counts that add up to the param total."""
+    if sh is None:
+        return []
+    if not isinstance(sh, dict):
+        return [f"must be an object, got {type(sh).__name__}"]
+    errors = []
+    mesh = sh.get("mesh")
+    if not isinstance(mesh, dict) or not mesh:
+        errors.append(f"needs a non-empty 'mesh' axis->size object, "
+                      f"got {mesh!r}")
+    else:
+        for ax, size in mesh.items():
+            if not isinstance(size, int) or size < 1:
+                errors.append(f"mesh[{ax!r}] must be a positive int, "
+                              f"got {size!r}")
+    if sh.get("mode") not in SHARDING_MODES:
+        errors.append(f"mode {sh.get('mode')!r} not in {SHARDING_MODES}")
+    if not isinstance(sh.get("fsdp"), bool):
+        errors.append(f"fsdp must be a bool, got {sh.get('fsdp')!r}")
+    counts = {}
+    for key in ("params_total", "params_model_sharded",
+                "params_data_sharded", "params_replicated"):
+        v = sh.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(f"{key} must be an int >= 0, got {v!r}")
+        else:
+            counts[key] = v
+    if len(counts) == 4:
+        parts = (counts["params_model_sharded"]
+                 + counts["params_data_sharded"]
+                 + counts["params_replicated"])
+        if parts != counts["params_total"]:
+            errors.append(f"spec counts sum to {parts} but params_total="
+                          f"{counts['params_total']}")
+    for key in ("param_bytes_per_device", "state_bytes_per_device"):
+        v = sh.get(key)
+        if v is not None and (not _is_num(v) or v < 0):
+            errors.append(f"{key} must be numeric >= 0 or absent, "
+                          f"got {v!r}")
+    return errors
+
+
 # ---------------------------------------------------------------------------
 # bench result JSON (BENCH_*.json with serving stats)
 # ---------------------------------------------------------------------------
@@ -615,6 +685,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.perfscope: {e}"
                for e in check_perfscope_extra(
                    (doc.get("extra") or {}).get("perfscope"))]
+    errors += [f"extra.sharding: {e}"
+               for e in check_sharding_extra(
+                   (doc.get("extra") or {}).get("sharding"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
