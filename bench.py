@@ -234,6 +234,30 @@ def _bench_perfscope_start():
     return ps.enable()
 
 
+def _bench_commscope_start():
+    """Arm collective/resharding extraction (mxtpu.commscope) for the
+    run: every compile site's optimized HLO is walked for its collective
+    inventory (kind / count / payload bytes / mesh axis / analytic ICI
+    estimate), the resharding detector flags accidental all-gathers, and
+    the result lands in `extra.commscope` + the step budget's estimated
+    `collective` component. Zero cost without a mesh (no collectives to
+    find, nothing compiled); under BENCH_MESH it pays one extra XLA
+    compile per captured program. BENCH_COMMSCOPE=0 disables; commscope
+    rides perfscope's capture hooks (enable() arms perfscope), so a
+    default-on commscope DECLINES when BENCH_PERFSCOPE=0 was set —
+    the perfscope opt-out must not be silently undone. An explicit
+    BENCH_COMMSCOPE=1 wins the conflict (and says so)."""
+    if os.environ.get("BENCH_COMMSCOPE", "1") != "1":
+        return None
+    if os.environ.get("BENCH_PERFSCOPE", "1") != "1":
+        if os.environ.get("BENCH_COMMSCOPE") != "1":
+            return None
+        _log("BENCH_COMMSCOPE=1 overrides BENCH_PERFSCOPE=0: commscope "
+             "rides perfscope's capture hooks, arming both")
+    from incubator_mxnet_tpu import commscope as cs
+    return cs.enable()
+
+
 def _bench_mesh():
     """BENCH_MESH=dp4|dp2mp2|fsdp4|…: register a process-global device
     mesh (mxtpu.sharding) so the steady phase runs through the SHARDED
@@ -326,6 +350,16 @@ def _perfscope_settle(result, budget, steps, steady_s, probe_fn,
             result.setdefault("extra", {})["perfscope"] = ps.bench_extra()
         except Exception:  # noqa: BLE001
             pass
+    # the collective inventory rides along whenever commscope is armed
+    # (BENCH_MESH runs carry the real payload; unsharded runs an empty
+    # one, so the schema is uniform) — attached OUTSIDE the settle try
+    # so a failed probe can't cost the comms table too
+    try:
+        from incubator_mxnet_tpu import commscope as cs
+        if cs._CS is not None:
+            result.setdefault("extra", {})["commscope"] = cs.bench_extra()
+    except Exception as e:  # noqa: BLE001
+        _log(f"commscope attach failed ({type(e).__name__}: {e})")
 
 
 def _profiled_compile_warmup(run_compile, run_warmup):
@@ -1020,6 +1054,8 @@ def main():
         _log("healthmon armed (watchdogs + structured event log)")
     if _bench_perfscope_start() is not None:
         _log("perfscope armed (roofline cost capture + step decomposition)")
+    if _bench_commscope_start() is not None:
+        _log("commscope armed (collective inventory + resharding detector)")
     # BENCH_MESH: register the global mesh BEFORE model build so param
     # init and the executor resolve against it
     shard_mode = _bench_mesh()

@@ -55,6 +55,7 @@ from . import engine
 from . import diagnostics
 from . import healthmon
 from . import perfscope
+from . import commscope
 from . import serving
 from . import trainloop
 from .trainloop import TrainLoop
@@ -81,3 +82,6 @@ healthmon.enable_from_env()
 # MXTPU_PERFSCOPE=1: arm roofline-aware cost capture at compile sites
 # (per-program FLOPs/bytes + verdicts — see docs/perfscope.md) at import.
 perfscope.enable_from_env()
+# MXTPU_COMMSCOPE=1: arm collective/resharding extraction at the same
+# compile sites (per-program inventory + estimates — docs/commscope.md).
+commscope.enable_from_env()

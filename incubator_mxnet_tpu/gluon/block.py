@@ -462,7 +462,10 @@ class HybridBlock(Block):
             # roofline verdict for this signature's forward executable
             # (host-side lowering only; one extra trace per compile —
             # the reason jit-cache capture is gated on perfscope being
-            # armed rather than always-on)
+            # armed rather than always-on). Under a registered mesh the
+            # same hook feeds commscope's collective extraction (mode
+            # unknown for a bare forward, so its resharding detector
+            # stays conservative here — docs/commscope.md)
             shape0 = tuple(args[0].shape) if args else ()
             _perfscope.analyze_jit(
                 jitted, (dummy_key, *p_raws, *[a._data for a in args]),

@@ -398,13 +398,18 @@ class FusedTrainStep:
             # roofline capture BEFORE dispatch: analyze_jit only reads
             # shapes/dtypes, so it is safe against the donated buffers.
             # Keyed on the batch signature: a shape-driven recompile gets
-            # re-analyzed so the table describes the program being timed
+            # re-analyzed so the table describes the program being timed.
+            # mesh/mode flow through to commscope, which (when armed)
+            # walks the compiled HLO for the program's collective
+            # inventory — the thing the step budget's `collective`
+            # component is estimated from under GSPMD (docs/commscope.md)
             self._cost_analyzed["fused_step"] = sig
             _ps.analyze_jit(
                 self._jitted,
                 (train_raws, aux_raws, self._states, key, lr, wd, t,
                  rescale, xb, yb),
-                name="fused_step", dtype=xb.dtype, kind="train_step")
+                name="fused_step", dtype=xb.dtype, kind="train_step",
+                mesh=self.mesh, mode=self.sharding)
         loss, new_train, new_aux, new_states = self._jitted(
             train_raws, aux_raws, self._states, key, lr, wd, t, rescale, xb, yb)
         for j, i in enumerate(self.train_idx):
@@ -470,7 +475,7 @@ class FusedTrainStep:
                 (train_raws, aux_raws, self._states, key, lrs, wd, t0,
                  rescale, xs, ys),
                 name=f"fused_step_k{k}", dtype=xs.dtype, kind="train_step",
-                extra={"k": k})
+                extra={"k": k}, mesh=self.mesh, mode=self.sharding)
         losses, new_train, new_aux, new_states = self._jitted_k(
             train_raws, aux_raws, self._states, key, lrs, wd, t0, rescale,
             xs, ys)

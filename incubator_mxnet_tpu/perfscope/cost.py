@@ -232,28 +232,56 @@ def record_program(name: str, flops, bytes_accessed, dtype="float32",
     return rec
 
 
+def _commscope_capture(name, lowered=None, compiled=None, mesh=None,
+                       mode=None, kind="program"):
+    """Hand the program to mxtpu.commscope when armed — the collective/
+    resharding extraction rides perfscope's capture hooks (one gate, one
+    set of compile sites). Never raises."""
+    try:
+        from .. import commscope as _cs
+        if _cs._CS is not None:
+            _cs.capture(name, lowered=lowered, compiled=compiled,
+                        mesh=mesh, mode=mode, kind=kind)
+    except Exception:  # noqa: BLE001 — extraction never breaks compiles
+        pass
+
+
 def analyze_lowered(lowered, name: str, dtype="float32",
-                    kind: str = "program", extra: dict | None = None):
+                    kind: str = "program", extra: dict | None = None,
+                    compiled=None, mesh=None, mode=None):
     """Cost-analyze an already-lowered (or compiled) jax stage object.
     Never raises — a backend without cost analysis yields an "unknown"
-    record rather than breaking the compile site that called us."""
+    record rather than breaking the compile site that called us.
+
+    ``compiled``/``mesh``/``mode`` feed the commscope collective
+    extraction when armed: a site that already holds the compiled
+    executable (serving buckets) passes it so commscope reads the
+    optimized HLO for free instead of compiling again."""
     costs = None
     try:
         costs = lowered.cost_analysis()
     except Exception:  # noqa: BLE001 — backend-dependent surface
         costs = None
     flops, nbytes = _extract_costs(costs)
-    return record_program(name, flops, nbytes, dtype=dtype, kind=kind,
-                          extra=extra)
+    rec = record_program(name, flops, nbytes, dtype=dtype, kind=kind,
+                         extra=extra)
+    _commscope_capture(name, lowered=lowered, compiled=compiled,
+                       mesh=mesh, mode=mode, kind=kind)
+    return rec
 
 
 def analyze_jit(jit_fn, args, name: str, dtype="float32",
                 kind: str = "program", extra: dict | None = None,
-                kwargs: dict | None = None):
+                kwargs: dict | None = None, mesh=None, mode=None):
     """Lower ``jit_fn`` against abstract ShapeDtypeStructs of ``args``
     and cost-analyze the result. Tracing happens on the host only (no
     device compile, no buffers touched — safe to call on arguments that
-    are about to be donated). Never raises."""
+    are about to be donated). Never raises.
+
+    ``mesh``/``mode`` describe the sharded layout for commscope's
+    collective extraction (armed separately; it compiles the lowered
+    program to read the optimized HLO — see docs/commscope.md for the
+    cost model)."""
     try:
         import jax
         from ..ops import select as _sel
@@ -270,4 +298,5 @@ def analyze_jit(jit_fn, args, name: str, dtype="float32",
     except Exception:  # noqa: BLE001 — analysis must never break training
         return record_program(name, None, None, dtype=dtype, kind=kind,
                               extra=extra)
-    return analyze_lowered(lowered, name, dtype=dtype, kind=kind, extra=extra)
+    return analyze_lowered(lowered, name, dtype=dtype, kind=kind,
+                           extra=extra, mesh=mesh, mode=mode)

@@ -138,6 +138,42 @@ class StepBudget:
         return max(0.0, self._snap1.get(key, 0.0)
                    - self._snap0.get(key, 0.0))
 
+    @staticmethod
+    def _in_program_collectives() -> bool:
+        """True when a multi-device mesh means the step's collectives
+        run inside the jit program (where the kvstore counter cannot
+        see them). Checks the process-global registry AND the last
+        published layout (publish_param_stats runs with the executor's
+        ACTUAL mesh, so an explicit ``mesh=`` FusedTrainStep — which
+        never registers one — is still seen)."""
+        try:
+            from ..parallel import sharding as _sh
+            mesh = _sh.get_mesh()
+            if mesh is not None and int(getattr(mesh, "size", 1)) > 1:
+                return True
+            shape = (_sh.summary() or {}).get("mesh")
+            if isinstance(shape, dict) and shape:
+                n = 1
+                for s in shape.values():
+                    n *= int(s)
+                return n > 1
+            return False
+        except Exception:  # noqa: BLE001
+            return False
+
+    @staticmethod
+    def _commscope_estimate():
+        """The steady train program's per-step collective estimate from
+        mxtpu.commscope, or None when commscope is unarmed / captured
+        nothing."""
+        try:
+            from .. import commscope as _cs
+            if _cs._CS is None:
+                return None
+            return _cs.step_estimate()
+        except Exception:  # noqa: BLE001
+            return None
+
     def finish(self, model_flops_per_step=None, dtype="float32") -> dict:
         """Settle the budget and publish the ``perfscope.*`` gauges.
 
@@ -150,6 +186,39 @@ class StepBudget:
         step_ms = self._steady_s / steps * 1e3
         input_wait = self._delta("io/io.wait_ms") / steps
         collective = self._delta("mxtpu/kvstore.collective_ms") / steps
+        # collective PROVENANCE: the kvstore counter only times the
+        # explicit-collective path. Under a GSPMD mesh the collectives
+        # are compiler-inserted INSIDE the jit program, the counter
+        # reads ~0, and reporting `collective: 0.0` as if measured would
+        # silently fold all-reduce/all-gather time into device_compute —
+        # exactly the attribution lie this field pins down:
+        #   measured     kvstore counter (or a genuinely unsharded run)
+        #   estimated    commscope's static-HLO link-time estimate for
+        #                the steady train program (marked, never a
+        #                measurement)
+        #   unavailable  sharded in-program mode with commscope unarmed:
+        #                the component is unknown, NOT zero
+        collective_source = "measured"
+        collective_est = None
+        if collective <= 0.0:
+            # the captured train program's OWN mesh is the primary
+            # signal — it is correct even for an explicit mesh= executor
+            # that never touched the registry; the registry/last-layout
+            # check is the fallback for commscope-off runs
+            est = self._commscope_estimate()
+            if est is not None and est.get("devices", 1) > 1 \
+                    and est.get("hlo_available", True) \
+                    and isinstance(est.get("est_ms"), (int, float)):
+                # hlo_available=False means commscope LOOKED and could
+                # not read the program: that zero is ignorance, and
+                # must fall through to "unavailable", not masquerade
+                # as an estimated empty inventory
+                collective = min(float(est["est_ms"]), step_ms)
+                collective_source = "estimated"
+                collective_est = est
+            elif self._in_program_collectives() \
+                    or (est is not None and est.get("devices", 1) > 1):
+                collective_source = "unavailable"
         # host dispatch share: caller-accumulated wall, plus the whole-
         # loop executor's own dispatch counter when that path ran. On a
         # SYNCHRONOUS backend (XLA:CPU blocks in the jit call) this
@@ -163,6 +232,11 @@ class StepBudget:
             # only overstate it, and in steady state the device cannot
             # have been busy longer than the wall per step
             device = min(self._probe["median_ms"], step_ms)
+            if collective_source == "estimated":
+                # the probe's wall CONTAINS the in-program collectives;
+                # peel the estimate out so the two components don't
+                # double-count the same milliseconds
+                device = max(0.0, device - collective)
         else:
             # no probe: peel the measured host/input/collective shares
             # off the wall and attribute the middle to the device
@@ -178,6 +252,8 @@ class StepBudget:
             "step_ms": round(step_ms, 4),
             "device_compute_ms": round(device, 4),
             "collective_ms": round(collective, 4),
+            "collective_source": collective_source,
+            "collective_est": collective_est,
             "input_wait_ms": round(input_wait, 4),
             "host_gap_ms": round(host_gap, 4),
             "other_ms": round(max(0.0, other), 4),

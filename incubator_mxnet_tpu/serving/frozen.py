@@ -173,11 +173,13 @@ class FrozenModel:
             self._out_tree = self._raw_info["tree"]
         if _ps._PS is not None:
             # the bucket is already lowered — the roofline verdict is a
-            # free host-side read here (no extra trace)
+            # free host-side read here (no extra trace). The compiled
+            # executable rides along so commscope's collective
+            # extraction reads the optimized HLO without compiling again
             _ps.analyze_lowered(
                 lowered, name=f"serving:{self._block.name}:b{b}",
                 dtype=self._dtype, kind="serving_bucket",
-                extra={"bucket": b})
+                extra={"bucket": b}, compiled=self._exec[b])
         _prof.counter("serving.compiles", "serving").increment()
         if warmup:
             x0 = np.zeros(shape, self._dtype)

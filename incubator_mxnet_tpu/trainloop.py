@@ -40,7 +40,11 @@ Telemetry (domain ``trainloop``): ``trainloop.chunks`` /
 ``trainloop.steps`` counters, ``trainloop.k`` / ``trainloop.chunk_ms`` /
 ``trainloop.in_program_lr`` gauges — plus the existing
 ``trainer.dispatches_per_step`` gauge, which reads 1/k under the
-executor (the smoke test asserts < 1).
+executor (the smoke test asserts < 1). The chunk program's compile
+capture (perfscope roofline + commscope collective inventory) rides
+FusedTrainStep's ``fused_step_k<k>`` hook — a scan-body inventory is
+static, i.e. PER MICRO-STEP, which is exactly the granularity the step
+budget's estimated ``collective`` component needs (docs/commscope.md).
 
 See docs/trainloop.md for lifecycle, remat-policy knobs, prefetch-depth
 tuning and the Pallas selection table.

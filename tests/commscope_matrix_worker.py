@@ -1,0 +1,141 @@
+"""Subprocess worker for commscope's CPU-mesh matrix (tests/test_commscope.py).
+
+Trains a small fixed-seed MLP through FusedTrainStep under one layout on
+4 FAKE host devices (--xla_force_host_platform_device_count=4 — set
+HERE, before jax import) with commscope armed, and prints one JSON line
+with the captured collective inventory for the `fused_step` program:
+per-kind counts, per-axis attribution, payload bytes, the resharding
+verdict, and a real StepBudget settle so the collective component's
+provenance is asserted against a REAL mesh (the in-process tests can
+only stub one).
+
+Layouts:
+    single        no mesh — the no-collectives baseline
+    dp4           pure data parallel: all-reduce-only signature
+    dp2mp2        2x2 (dp, mp), Dense kernels on mp: model-axis
+                  collectives must appear
+    fsdp4         zero-style: all-gather + reduce-scatter (XLA:CPU
+                  spells the latter all-to-all + local reduce)
+    misannotated  dp4 with a Dense weight deliberately pinned onto the
+                  dp axis — the "accidental all-gather" fixture that
+                  must trip the resharding detector
+
+Usage: python commscope_matrix_worker.py <layout>
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# isolate from the suite's persistent compile cache (the PR 4 lesson)
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import commscope, gluon, nd, perfscope  # noqa: E402
+from incubator_mxnet_tpu.gluon import nn  # noqa: E402
+from incubator_mxnet_tpu.parallel import (FusedTrainStep, make_mesh,  # noqa: E402
+                                          set_mesh)
+
+STEPS = 4
+BATCH = 16
+
+
+def _net():
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"),
+            nn.Dense(16, activation="relu"),
+            nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _data(seed):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(BATCH, 8).astype(np.float32)),
+            nd.array(rng.randint(0, 4, BATCH)))
+
+
+def main():
+    layout = sys.argv[1]
+    commscope.enable()           # arms perfscope too (capture hooks)
+    mode = None
+    net = _net()
+    if layout == "single":
+        pass
+    elif layout == "dp4":
+        set_mesh(make_mesh({"dp": 4}))
+        mode = "dp"
+    elif layout == "dp2mp2":
+        set_mesh(make_mesh({"dp": 2, "mp": 2}))
+        mode = "auto"
+    elif layout == "fsdp4":
+        set_mesh(make_mesh({"dp": -1}))
+        mode = "fsdp"
+    elif layout == "misannotated":
+        set_mesh(make_mesh({"dp": 4}))
+        mode = "dp"
+        # the deliberate mistake: a Dense kernel pinned onto the DATA
+        # axis in a data-parallel program — the computation needs it
+        # replicated, so GSPMD inserts the accidental all-gather
+        net[0].shard(weight=P("dp", None))
+    else:
+        raise SystemExit(f"unknown layout {layout!r}")
+
+    import warnings
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create("sgd", learning_rate=0.1),
+                          sharding=mode)
+    budget = perfscope.StepBudget().begin()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        t0 = time.perf_counter()
+        losses = []
+        for i in range(STEPS):
+            x, y = _data(100 + i)
+            losses.append(float(step(x, y)))
+        dt = time.perf_counter() - t0
+    budget.end(steps=STEPS, steady_s=dt)
+    decomp = budget.finish()
+
+    progs = {p["name"]: p for p in commscope.programs()}
+    rec = progs.get("fused_step") or {}
+    kinds = {}
+    axes = set()
+    for c in rec.get("collectives") or []:
+        kinds[c["kind"]] = kinds.get(c["kind"], 0) + c["count"]
+        if c.get("axis"):
+            axes.add(c["axis"])
+    from incubator_mxnet_tpu import profiler as prof
+    counters = {k: v for k, v in prof.counters().items()
+                if k.startswith("commscope/")}
+    print(json.dumps({
+        "layout": layout,
+        "devices": len(jax.devices()),
+        "losses": losses,
+        "program": {k: rec.get(k) for k in
+                    ("name", "mode", "mesh", "totals",
+                     "resharding_collectives", "resharding",
+                     "hlo_available", "collectives")},
+        "kinds": kinds,
+        "axes": sorted(axes),
+        "step_estimate": commscope.step_estimate(),
+        "collective_source": decomp.get("collective_source"),
+        "collective_ms": decomp.get("collective_ms"),
+        "counters": counters,
+        "resharding_warned": any("commscope" in str(w.message)
+                                 for w in caught),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,13 @@ if timeout 1800 bash tools/shard_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) shard smoke FAILED (continuing; sharded executor suspect)" >> "$LOG"
 fi
+# commscope smoke (CPU-only fsdp4 mesh): collective inventory nonzero,
+# resharding detector quiet, step-budget collective provenance=estimated
+if timeout 900 bash tools/comms_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) comms smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) comms smoke FAILED (continuing; collective observability suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -52,6 +52,14 @@ if timeout 1800 bash tools/shard_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) shard smoke FAILED (continuing; sharded executor suspect)" >> "$LOG"
 fi
+# commscope smoke (CPU-only fsdp4 mesh): the collective inventory +
+# resharding detector + estimated step-budget provenance must hold
+# before trusting any sharded layout's attribution
+if timeout 900 bash tools/comms_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) comms smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) comms smoke FAILED (continuing; collective observability suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -16,9 +16,21 @@ failures ("rank 1 went quiet 40 s before rank 0's collective timed
 out"). `-o merged.jsonl` additionally writes the merged timeline as
 `mxtpu.events/1` records (validated by tools/trace_check.py).
 
+`perf`: the MFU-decomposition report from a BENCH json
+(`extra.perfscope`) — step budget with per-component shares (the
+`collective` row carries its provenance: measured / estimated /
+unavailable), counterfactual MFU table, per-program roofline verdicts.
+
+`comms`: the collective-inventory report from a BENCH json
+(`extra.commscope`) — per compiled program, one row per (op kind, mesh
+axis) with count / payload MiB / analytic ICI estimate, plus any
+resharding findings with the offending operand shapes.
+
 Usage:
     python tools/mxdiag.py DUMP.json [--events N]
     python tools/mxdiag.py metrics.jsonl
+    python tools/mxdiag.py perf BENCH.json
+    python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
@@ -219,7 +231,16 @@ def print_perf(doc: dict) -> int:
                 continue
             share = ms / step if step else 0.0
             bar = "#" * int(round(share * 40))
-            print(f"    {comp:<15} {ms:>10.3f} ms  {share:>6.1%}  {bar}")
+            tag = ""
+            if comp == "collective":
+                src = d.get("collective_source")
+                if src == "estimated":
+                    tag = "  [estimated: commscope static-HLO]"
+                elif src == "unavailable":
+                    tag = ("  [UNAVAILABLE: in-program collectives, "
+                           "commscope off — not a measured zero]")
+            print(f"    {comp:<15} {ms:>10.3f} ms  {share:>6.1%}  "
+                  f"{bar}{tag}")
         print(f"    {'(coverage':<15} {d.get('coverage')})")
         if d.get("mfu") is not None:
             print(f"\n  MFU decomposition:  achieved {d['mfu']:.4f}")
@@ -261,6 +282,93 @@ def _perf_main(argv) -> int:
         print(f"perf: {e}", file=sys.stderr)
         return 1
     return print_perf(doc)
+
+
+# ---------------------------------------------------------------------------
+# comms: per-program collective tables from a BENCH json (extra.commscope)
+# ---------------------------------------------------------------------------
+
+def print_comms(doc: dict) -> int:
+    """The "what collectives does my layout run" report: per compiled
+    program, one row per (op kind, mesh axis) with count / payload /
+    analytic ICI estimate, plus any resharding findings — the evidence
+    behind the step budget's estimated `collective` component."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')}, batch "
+          f"{extra.get('batch')}, {extra.get('dtype')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    cs = extra.get("commscope")
+    if not isinstance(cs, dict):
+        print("  no extra.commscope section (commscope was off — rerun "
+              "without BENCH_COMMSCOPE=0, with a BENCH_MESH layout)")
+        return 1
+    peaks = cs.get("peaks") or {}
+    print(f"  ICI peaks: {peaks.get('device_kind')} "
+          f"(table row {peaks.get('table_row')})  "
+          f"{_fmt_bytes(peaks.get('ici_bytes_per_s'))}/s  "
+          f"(estimates are analytic ring lower bounds, not measurements)")
+    step = cs.get("step")
+    if isinstance(step, dict):
+        est = step.get("est_ms")
+        line = f"  steady train program: {step.get('program')}"
+        if _is_numlike(est):
+            line += (f"  {_fmt_bytes(step.get('bytes'))}/step  "
+                     f"est {est:.4f} ms/step")
+        print(line)
+    progs = cs.get("programs") or []
+    if not progs:
+        print("  no programs captured")
+        return 0
+    for p in progs:
+        mesh = p.get("mesh")
+        mesh_s = "x".join(f"{k}{v}" for k, v in (mesh or {}).items()) \
+            or "no mesh"
+        t = p.get("totals") or {}
+        flag = ""
+        if p.get("resharding_collectives"):
+            flag = (f"  !! {p['resharding_collectives']} RESHARDING "
+                    f"collective(s)")
+        print(f"\n  {p.get('name')}  (mode={p.get('mode')}, {mesh_s})  "
+              f"{t.get('count', 0)} collectives, "
+              f"{_fmt_bytes(t.get('bytes', 0))}, "
+              f"est {t.get('est_ms', 0):.4f} ms{flag}")
+        rows = p.get("collectives") or []
+        if not rows and p.get("hlo_available") is False:
+            print("      (optimized HLO unavailable — inventory unknown)")
+        for c in rows:
+            print(f"      {c.get('kind', '?'):<19} x{c.get('count', 0):<4} "
+                  f"{_fmt_bytes(c.get('bytes', 0)):>12}  "
+                  f"est {c.get('est_ms', 0):.4f} ms  "
+                  f"axis {c.get('axis') or '?'}")
+        for r in p.get("resharding") or []:
+            print(f"      RESHARD {r.get('kind')} ({r.get('reason')}): "
+                  f"result {r.get('result_shape')}  operands "
+                  f"{r.get('operand_shapes')}")
+    return 0
+
+
+def _is_numlike(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _comms_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py comms",
+        description="per-program collective tables from a BENCH json "
+                    "(extra.commscope)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"comms: {e}", file=sys.stderr)
+        return 1
+    return print_comms(doc)
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +505,8 @@ def main(argv=None) -> int:
         return _merge_main(argv[1:])
     if argv and argv[0] == "perf":
         return _perf_main(argv[1:])
+    if argv and argv[0] == "comms":
+        return _comms_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

@@ -13,9 +13,12 @@ baseline every later run "beats". This tool:
   an ``error`` field, a null ``parsed`` wrapper, or a non-positive
   value. They describe the environment, not the code;
 * **compares the metrics that matter** — headline throughput
-  (``value``), ``extra.mfu`` (ROADMAP item 1's regression metric), and
-  serving ``p99_ms`` — relative, per metric, only when both sides carry
-  the number;
+  (``value``), ``extra.mfu`` (ROADMAP item 1's regression metric),
+  serving ``p99_ms``, and the per-step collective payload
+  (``extra.commscope.step.bytes`` — a LAYOUT regression: a new
+  accidental reshard inflates in-program collective bytes even when
+  the CPU-bench wall time barely moves) — relative, per metric, only
+  when both sides carry the number;
 * **is noise-aware** — in trajectory mode (``--dir``) the baseline is
   the MEDIAN of all usable prior artifacts and the effective threshold
   is ``max(--threshold, --noise-mult × observed relative spread)``, so
@@ -46,6 +49,10 @@ __all__ = ["load_artifact", "compare", "trajectory", "main"]
 
 DEFAULT_THRESHOLD = 0.05       # 5% relative drop on value / MFU
 DEFAULT_P99_THRESHOLD = 0.25   # 25% relative increase on p99
+# collective payload is DETERMINISTIC for a fixed model+layout (static
+# HLO inventory, no timing noise), so the gate is tight: a real layout
+# change moves it by integer factors, measurement scatter by zero
+DEFAULT_COLL_THRESHOLD = 0.10  # 10% relative increase on bytes/step
 DEFAULT_NOISE_MULT = 2.0
 
 
@@ -80,6 +87,10 @@ def load_artifact(path):
         return None, f"non-positive value {value!r}"
     extra = doc.get("extra") or {}
     serving = extra.get("serving") or {}
+    commscope = extra.get("commscope") or {}
+    step = commscope.get("step") if isinstance(commscope.get("step"),
+                                               dict) else {}
+    coll = step.get("bytes")
     rec = {
         "path": path,
         "metric": doc.get("metric"),
@@ -89,6 +100,14 @@ def load_artifact(path):
                                               (int, float)) else None,
         "p99_ms": serving.get("p99_ms") if isinstance(
             serving.get("p99_ms"), (int, float)) else None,
+        # per-step in-program collective payload (commscope static-HLO
+        # inventory of the steady train program) — the layout-regression
+        # metric; None when the run carried no commscope step summary
+        "coll_bytes": float(coll) if isinstance(coll, (int, float))
+                      and not isinstance(coll, bool) else None,
+        "resharding": step.get("resharding_collectives")
+                      if isinstance(step.get("resharding_collectives"),
+                                    int) else None,
     }
     return rec, None
 
@@ -106,11 +125,15 @@ def _rel_spread(values):
 
 def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
             p99_threshold=DEFAULT_P99_THRESHOLD, noise=0.0,
-            noise_mult=DEFAULT_NOISE_MULT):
+            noise_mult=DEFAULT_NOISE_MULT,
+            coll_threshold=DEFAULT_COLL_THRESHOLD):
     """Compare two loaded records → (regressions, notes): lists of
     human-readable strings. Lower-is-worse metrics (value, mfu) regress
-    on a relative DROP beyond the effective threshold; p99 regresses on
-    a relative INCREASE."""
+    on a relative DROP beyond the effective threshold; p99 and the
+    per-step collective payload regress on a relative INCREASE — with
+    collectives appearing where the baseline had NONE always flagged
+    (0 → anything is the accidental-reshard signature, and a relative
+    threshold on a zero baseline would wave it through)."""
     regressions, notes = [], []
     if baseline["metric"] != candidate["metric"]:
         notes.append(f"metric mismatch ({baseline['metric']!r} vs "
@@ -142,6 +165,42 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
             regressions.append("REGRESSION " + line)
         else:
             notes.append("ok " + line)
+    bcb, ccb = baseline.get("coll_bytes"), candidate.get("coll_bytes")
+    if bcb is not None and ccb is not None:
+        if bcb <= 0:
+            if ccb > 0:
+                regressions.append(
+                    f"REGRESSION collective bytes/step: 0 -> {ccb:.0f} "
+                    f"(in-program collectives appeared where the "
+                    f"baseline layout had none — accidental reshard?)")
+            else:
+                notes.append("ok collective bytes/step: 0 -> 0")
+        else:
+            rise = (ccb - bcb) / bcb
+            line = (f"collective bytes/step: {bcb:.0f} -> {ccb:.0f} "
+                    f"({rise:+.2%} vs threshold +{coll_threshold:.1%})")
+            # no noise widening: the static inventory has no scatter
+            if rise > coll_threshold:
+                regressions.append("REGRESSION " + line)
+            else:
+                notes.append("ok " + line)
+    cr = candidate.get("resharding")
+    if cr:
+        br = baseline.get("resharding")
+        if br is None:
+            # same contract as the bytes gate: a baseline that carried
+            # no commscope data cannot indict a pre-existing count
+            notes.append(f"note: candidate carries {cr} resharding "
+                         f"collective(s); baseline has no commscope "
+                         f"data — nothing to gate")
+        elif cr > br:
+            regressions.append(
+                f"REGRESSION resharding collectives: {br} -> {cr} "
+                f"(an annotation/axis-rule no longer matches the "
+                f"computation — see mxdiag.py comms)")
+        else:
+            notes.append(f"note: candidate carries {cr} resharding "
+                         f"collective(s) (not new vs baseline)")
     return regressions, notes
 
 
@@ -151,7 +210,8 @@ def _natural_key(path):
 
 
 def trajectory(paths, threshold, p99_threshold, noise_mult,
-               candidate_path=None):
+               candidate_path=None,
+               coll_threshold=DEFAULT_COLL_THRESHOLD):
     """Directory mode: newest usable artifact vs the median of all
     earlier usable ones, thresholds widened by the observed spread.
     Returns (exit_code, lines)."""
@@ -193,7 +253,8 @@ def trajectory(paths, threshold, p99_threshold, noise_mult,
                  f"(median value {base['value']:.4g})")
     regs, notes = compare(base, cand, threshold=threshold,
                           p99_threshold=p99_threshold, noise=noise,
-                          noise_mult=noise_mult)
+                          noise_mult=noise_mult,
+                          coll_threshold=coll_threshold)
     lines.extend(notes + regs)
     return (1 if regs else 0), lines
 
@@ -220,6 +281,11 @@ def main(argv=None) -> int:
     ap.add_argument("--noise-mult", type=float, default=DEFAULT_NOISE_MULT,
                     help="noise-band multiplier in trajectory mode "
                          "(default 2.0)")
+    ap.add_argument("--coll-threshold", type=float,
+                    default=DEFAULT_COLL_THRESHOLD,
+                    help="relative increase threshold for per-step "
+                         "collective bytes (default 0.10; a zero "
+                         "baseline flags ANY appearance)")
     args = ap.parse_args(argv)
 
     if args.dir:
@@ -230,7 +296,8 @@ def main(argv=None) -> int:
             return 2
         rc, lines = trajectory(paths, args.threshold, args.p99_threshold,
                                args.noise_mult,
-                               candidate_path=args.candidate)
+                               candidate_path=args.candidate,
+                               coll_threshold=args.coll_threshold)
         for ln in lines:
             print(ln)
         print("perf_regress: " + ("REGRESSION" if rc else "OK"))
@@ -251,7 +318,8 @@ def main(argv=None) -> int:
               f"possible")
         return 0
     regs, notes = compare(base, cand, threshold=args.threshold,
-                          p99_threshold=args.p99_threshold)
+                          p99_threshold=args.p99_threshold,
+                          coll_threshold=args.coll_threshold)
     for ln in notes + regs:
         print(ln)
     print("perf_regress: " + ("REGRESSION" if regs else "OK"))

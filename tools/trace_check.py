@@ -22,10 +22,10 @@
   JSONL, including `mxdiag merge` output) — per-record schema with the
   run_id/rank/step correlation ids, non-decreasing timestamps;
 * **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
-  `perfscope/*` or `sharding/*` metric appearing in a flight dump or
-  metrics series must belong to the known family table with the
-  declared kind (an unknown or re-kinded metric means a producer
-  drifted from the documented schema).
+  `perfscope/*`, `commscope/*` or `sharding/*` metric appearing in a
+  flight dump or metrics series must belong to the known family table
+  with the declared kind (an unknown or re-kinded metric means a
+  producer drifted from the documented schema).
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -46,7 +46,7 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_metrics_jsonl", "check_histogram_snapshot",
            "check_bench_json", "check_events_jsonl",
            "check_healthmon_kinds", "check_perfscope_extra",
-           "check_sharding_extra", "check_file"]
+           "check_commscope_extra", "check_sharding_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -129,6 +129,34 @@ PERFSCOPE_FAMILIES = {
 }
 
 ROOFLINE_VERDICTS = ("compute_bound", "hbm_bound", "trivial", "unknown")
+
+# The commscope.* (collective & resharding observability) metric
+# families (docs/commscope.md): per-program inventory counters, one
+# counter per op kind in the closed taxonomy, and the steady train
+# program's estimated per-step gauges.
+COMMSCOPE_FAMILIES = {
+    "commscope/commscope.programs_analyzed": "counter",
+    "commscope/commscope.collectives": "counter",
+    "commscope/commscope.payload_bytes": "counter",
+    "commscope/commscope.resharding_collectives": "counter",
+    "commscope/commscope.all_reduce": "counter",
+    "commscope/commscope.all_gather": "counter",
+    "commscope/commscope.reduce_scatter": "counter",
+    "commscope/commscope.all_to_all": "counter",
+    "commscope/commscope.collective_permute": "counter",
+    "commscope/commscope.other": "counter",
+    "commscope/commscope.step_collective_est_ms": "gauge",
+    "commscope/commscope.step_collective_bytes": "gauge",
+}
+
+# the closed collective op-kind taxonomy an `extra.commscope` record may
+# use (commscope/hlo.py COLLECTIVE_KINDS — unknown HLO spellings are
+# bucketed as "other" by the producer, never invented here)
+COMMSCOPE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute", "other")
+
+# provenance values the step budget's collective component may declare
+COLLECTIVE_SOURCES = ("measured", "estimated", "unavailable")
 
 # decomposition components that must sum (with "other" absorbing the
 # residual) to the measured step time
@@ -274,14 +302,16 @@ def check_flight(path: str) -> list:
 # ---------------------------------------------------------------------------
 
 def check_healthmon_kinds(kinds: dict) -> list:
-    """Every healthmon/*, io/*, trainloop/*, perfscope/* and sharding/*
-    metric must belong to its family table with the declared kind."""
+    """Every healthmon/*, io/*, trainloop/*, perfscope/*, commscope/*
+    and sharding/* metric must belong to its family table with the
+    declared kind."""
     errors = []
     tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
               ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
               ("trainloop/", IO_TRAINLOOP_FAMILIES,
                "IO_TRAINLOOP_FAMILIES"),
               ("perfscope/", PERFSCOPE_FAMILIES, "PERFSCOPE_FAMILIES"),
+              ("commscope/", COMMSCOPE_FAMILIES, "COMMSCOPE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -602,6 +632,112 @@ def check_perfscope_extra(ps) -> list:
     mfu = d.get("mfu")
     if mfu is not None and (not _is_num(mfu) or not 0.0 <= mfu <= 1.5):
         errors.append(f"decomposition.mfu={mfu!r} outside [0, 1.5]")
+    src = d.get("collective_source")
+    if src is not None and src not in COLLECTIVE_SOURCES:
+        errors.append(f"decomposition.collective_source={src!r} not in "
+                      f"{COLLECTIVE_SOURCES}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# commscope bench section (extra.commscope)
+# ---------------------------------------------------------------------------
+
+def check_commscope_extra(cs) -> list:
+    """Validate an `extra.commscope` BENCH section: per-program
+    collective inventories drawn from the closed op-kind taxonomy with
+    non-negative bytes/counts and numeric estimates, an ICI peak table,
+    and a well-formed (or null) steady-step summary."""
+    if cs is None:
+        return []
+    if not isinstance(cs, dict):
+        return [f"must be an object, got {type(cs).__name__}"]
+    errors = []
+    peaks = cs.get("peaks")
+    if not isinstance(peaks, dict):
+        errors.append("needs a 'peaks' object")
+    else:
+        v = peaks.get("ici_bytes_per_s")
+        if not _is_num(v) or v <= 0:
+            errors.append(f"peaks['ici_bytes_per_s'] must be positive, "
+                          f"got {v!r}")
+    progs = cs.get("programs")
+    if not isinstance(progs, list):
+        errors.append("needs a 'programs' list")
+        progs = []
+    for i, p in enumerate(progs):
+        if not isinstance(p, dict):
+            errors.append(f"programs[{i}]: not an object")
+            continue
+        where = f"programs[{i}] ({p.get('name')!r})"
+        if not isinstance(p.get("name"), str) or not p["name"]:
+            errors.append(f"programs[{i}]: missing/empty 'name'")
+        totals = p.get("totals")
+        if not isinstance(totals, dict):
+            errors.append(f"{where}: missing 'totals' object")
+            totals = {}
+        for key in ("count", "bytes"):
+            v = totals.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}: totals[{key!r}] must be an int "
+                              f">= 0, got {v!r}")
+        est = totals.get("est_ms")
+        if not _is_num(est) or est < 0:
+            errors.append(f"{where}: totals['est_ms'] must be numeric "
+                          f">= 0, got {est!r}")
+        colls = p.get("collectives")
+        if not isinstance(colls, list):
+            errors.append(f"{where}: missing 'collectives' list")
+            colls = []
+        kind_count = 0
+        for j, c in enumerate(colls):
+            if not isinstance(c, dict):
+                errors.append(f"{where}: collectives[{j}] not an object")
+                continue
+            if c.get("kind") not in COMMSCOPE_KINDS:
+                errors.append(f"{where}: collectives[{j}] kind "
+                              f"{c.get('kind')!r} not in {COMMSCOPE_KINDS}")
+            n = c.get("count")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                errors.append(f"{where}: collectives[{j}] count must be "
+                              f"an int >= 1, got {n!r}")
+            else:
+                kind_count += n
+            b = c.get("bytes")
+            if not _is_num(b) or b < 0:
+                errors.append(f"{where}: collectives[{j}] bytes must be "
+                              f">= 0, got {b!r}")
+            e = c.get("est_ms")
+            if not _is_num(e) or e < 0:
+                errors.append(f"{where}: collectives[{j}] est_ms must be "
+                              f"numeric >= 0, got {e!r}")
+            ax = c.get("axis")
+            if ax is not None and not isinstance(ax, str):
+                errors.append(f"{where}: collectives[{j}] axis must be a "
+                              f"string or null, got {ax!r}")
+        if isinstance(totals.get("count"), int) \
+                and kind_count != totals["count"] \
+                and not any(not isinstance(c, dict) or
+                            not isinstance(c.get("count"), int)
+                            for c in colls):
+            errors.append(f"{where}: per-kind counts sum to {kind_count} "
+                          f"but totals.count={totals['count']}")
+        r = p.get("resharding_collectives")
+        if not isinstance(r, int) or isinstance(r, bool) or r < 0:
+            errors.append(f"{where}: resharding_collectives must be an "
+                          f"int >= 0, got {r!r}")
+    step = cs.get("step")
+    if step is not None:
+        if not isinstance(step, dict):
+            errors.append("'step' must be an object or null")
+        else:
+            e = step.get("est_ms")
+            if e is not None and (not _is_num(e) or e < 0):
+                errors.append(f"step.est_ms must be numeric >= 0 or null, "
+                              f"got {e!r}")
+            b = step.get("bytes")
+            if b is not None and (not _is_num(b) or b < 0):
+                errors.append(f"step.bytes must be >= 0 or null, got {b!r}")
     return errors
 
 
@@ -685,6 +821,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.perfscope: {e}"
                for e in check_perfscope_extra(
                    (doc.get("extra") or {}).get("perfscope"))]
+    errors += [f"extra.commscope: {e}"
+               for e in check_commscope_extra(
+                   (doc.get("extra") or {}).get("commscope"))]
     errors += [f"extra.sharding: {e}"
                for e in check_sharding_extra(
                    (doc.get("extra") or {}).get("sharding"))]
