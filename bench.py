@@ -258,6 +258,41 @@ def _bench_commscope_start():
     return cs.enable()
 
 
+def _bench_devicescope_start():
+    """BENCH_DEVICESCOPE=1: arm measured device-timeline capture
+    (mxtpu.devicescope) — one bounded window (BENCH_DEVICESCOPE_STEPS,
+    default 10) of the steady phase runs under jax.profiler.trace; the
+    artifact is ingested into measured busy fraction / top-K device ops
+    / idle-gap taxonomy, the step budget's provenance upgrades to
+    measured(profile), and `extra.devicescope` carries the
+    analytic-vs-measured reconciliation. OFF by default: the traced
+    steps pay profiler overhead, so the window must be asked for.
+    Artifact dirs rotate (MXTPU_DEVICESCOPE_KEEP, default 3)."""
+    if os.environ.get("BENCH_DEVICESCOPE", "0") != "1":
+        return None
+    from incubator_mxnet_tpu import devicescope as ds
+    return ds.enable()
+
+
+def _devicescope_window(total_steps, steps_per_dispatch=1):
+    """A started capture window over the first N steady steps when
+    devicescope is armed, else None (zero overhead: the loops guard
+    every mark with `if win is not None`)."""
+    from incubator_mxnet_tpu import devicescope as ds
+    if ds._DS is None:
+        return None
+    n = int(os.environ.get("BENCH_DEVICESCOPE_STEPS", "10"))
+    n = max(int(steps_per_dispatch), min(n, int(total_steps)))
+    win = ds.capture(steps=n).start()
+    if win.active:
+        _log(f"devicescope: capture window armed ({n} steps) -> "
+             f"{win.logdir}")
+    else:
+        _log("devicescope: capture window DECLINED (profiler busy or "
+             "unavailable)")
+    return win
+
+
 def _bench_mesh():
     """BENCH_MESH=dp4|dp2mp2|fsdp4|…: register a process-global device
     mesh (mxtpu.sharding) so the steady phase runs through the SHARDED
@@ -360,6 +395,17 @@ def _perfscope_settle(result, budget, steps, steady_s, probe_fn,
             result.setdefault("extra", {})["commscope"] = cs.bench_extra()
     except Exception as e:  # noqa: BLE001
         _log(f"commscope attach failed ({type(e).__name__}: {e})")
+    # the measured device-timeline summary rides along whenever
+    # devicescope is armed (window summary + reconciliation; the
+    # armed-but-declined shape is `{"window": null}` so the schema is
+    # uniform) — also outside the settle try, for the same reason
+    try:
+        from incubator_mxnet_tpu import devicescope as dsc
+        if dsc._DS is not None:
+            result.setdefault("extra", {})["devicescope"] = \
+                dsc.bench_extra()
+    except Exception as e:  # noqa: BLE001
+        _log(f"devicescope attach failed ({type(e).__name__}: {e})")
 
 
 def _profiled_compile_warmup(run_compile, run_warmup):
@@ -945,15 +991,22 @@ def _record_data_bench(mode, batch, steps, dtype):
 
     _log(f"timing {steps} end-to-end steps @ batch {batch} ({mode})")
     budget = _perfscope_budget()
+    ds_win = _devicescope_window(steps)
     t0 = time.time()
     with prof.record_function("bench.steady", "bench", sync=False):
         for _ in range(steps):
             td = time.perf_counter()
             loss = step(*next_batch())
+            disp_s = time.perf_counter() - td
             if budget is not None:
-                budget.add_dispatch(time.perf_counter() - td)
+                budget.add_dispatch(disp_s)
+            if ds_win is not None:
+                ds_win.step(1, dispatch_ms=disp_s * 1e3,
+                            sync=lambda: float(loss))
         loss_val = float(loss)                    # host fetch = barrier
     dt = time.time() - t0
+    if ds_win is not None:
+        ds_win.stop()
     e2e = batch * steps / dt
     bottleneck = ("input-bound (decode/host)" if data_rate < 1.2 * e2e
                   else "chip-bound")
@@ -1056,6 +1109,8 @@ def main():
         _log("perfscope armed (roofline cost capture + step decomposition)")
     if _bench_commscope_start() is not None:
         _log("commscope armed (collective inventory + resharding detector)")
+    if _bench_devicescope_start() is not None:
+        _log("devicescope armed (windowed device-timeline capture)")
     # BENCH_MESH: register the global mesh BEFORE model build so param
     # init and the executor resolve against it
     shard_mode = _bench_mesh()
@@ -1159,6 +1214,10 @@ def main():
                 yield x, y
 
         budget = _perfscope_budget(steps_per_dispatch=loop_k)
+        # loop mode: run_chunk marks the active devicescope window itself
+        # (it knows one dispatch was loop_k steps), so no per-step marks
+        ds_win = _devicescope_window(chunks * loop_k,
+                                     steps_per_dispatch=loop_k)
         with loop._prefetcher(batches(), cycle=False) as pf:
             t0 = time.time()
             with prof.record_function("bench.steady", "bench", sync=False):
@@ -1168,6 +1227,8 @@ def main():
                     _healthmon_mark_step()   # one mark per dispatched chunk
                 loss_val = float(losses[loop_k - 1])    # host fetch = barrier
             dt = time.time() - t0
+        if ds_win is not None:
+            ds_win.stop()
         steps = chunks * loop_k
         k = loop_k
         # loop-mode host_gap rides trainloop.dispatch_ms (run_chunk's own
@@ -1187,31 +1248,50 @@ def main():
         _log(f"timing {chunks} chunks x {k} micro-steps @ batch {batch} "
              f"{dtype}")
         budget = _perfscope_budget(steps_per_dispatch=k)
+        ds_win = _devicescope_window(chunks * k, steps_per_dispatch=k)
         t0 = time.time()
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(chunks):
                 td = time.perf_counter()
                 losses = step.run_k(xs, ys)
+                disp_s = time.perf_counter() - td
                 if budget is not None:
-                    budget.add_dispatch(time.perf_counter() - td)
+                    budget.add_dispatch(disp_s)
+                if ds_win is not None:
+                    # sync thunk = loss fetch, the one true barrier: a
+                    # window closing at this mark must not close with
+                    # its own steps still in flight (async dispatch)
+                    ds_win.step(k, dispatch_ms=disp_s * 1e3,
+                                sync=lambda: float(losses[k - 1]))
                 _healthmon_mark_step()     # one mark per dispatched chunk
             loss_val = float(losses[k - 1])         # host fetch = barrier
         dt = time.time() - t0
+        if ds_win is not None:
+            ds_win.stop()
         steps = chunks * k
         probe_fn = lambda: float(step.run_k(xs, ys)[k - 1])  # noqa: E731
     else:
         _log(f"timing {steps} steps @ batch {batch} {dtype}")
         budget = _perfscope_budget()
+        ds_win = _devicescope_window(steps)
         t0 = time.time()
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(steps):
                 td = time.perf_counter()
                 loss = step(x, y)
+                disp_s = time.perf_counter() - td
                 if budget is not None:
-                    budget.add_dispatch(time.perf_counter() - td)
+                    budget.add_dispatch(disp_s)
+                if ds_win is not None:
+                    # see run_k path: the sync fetch only runs at the
+                    # window boundary, so the other steps stay async
+                    ds_win.step(1, dispatch_ms=disp_s * 1e3,
+                                sync=lambda: float(loss))
                 _healthmon_mark_step()
             loss_val = float(loss)
         dt = time.time() - t0
+        if ds_win is not None:
+            ds_win.stop()
         probe_fn = lambda: float(step(x, y))         # noqa: E731
     from incubator_mxnet_tpu import healthmon as _hm_mod
     if _hm_mod._HM is not None:

@@ -56,6 +56,7 @@ from . import diagnostics
 from . import healthmon
 from . import perfscope
 from . import commscope
+from . import devicescope
 from . import serving
 from . import trainloop
 from .trainloop import TrainLoop
@@ -85,3 +86,7 @@ perfscope.enable_from_env()
 # MXTPU_COMMSCOPE=1: arm collective/resharding extraction at the same
 # compile sites (per-program inventory + estimates — docs/commscope.md).
 commscope.enable_from_env()
+# MXTPU_DEVICESCOPE=1: arm measured device-timeline capture (windowed
+# jax-profiler trace + ingestion + analytic-vs-measured reconciliation
+# — see docs/devicescope.md).
+devicescope.enable_from_env()

@@ -47,10 +47,10 @@ import os
 
 from . import extract
 from . import hlo
-from .extract import (attribute_axis, axis_for_groups, capture,
-                      detect_resharding, estimate_ms, expected_kinds,
-                      ici_peaks, programs, record_inventory,
-                      reset_programs, step_estimate,
+from .extract import (attribute_axis, axis_by_kind, axis_for_groups,
+                      capture, detect_resharding, estimate_ms,
+                      expected_kinds, ici_peaks, programs,
+                      record_inventory, reset_programs, step_estimate,
                       EXPECTED_KINDS, ICI_TABLE)
 from .hlo import (chases_to_parameter, parse_collectives,
                   parse_instructions, parse_replica_groups, parse_shape,
@@ -59,6 +59,7 @@ from .hlo import (chases_to_parameter, parse_collectives,
 __all__ = ["enable", "disable", "enabled", "enable_from_env",
            "bench_extra", "capture", "programs", "reset_programs",
            "step_estimate", "ici_peaks", "estimate_ms", "attribute_axis",
+           "axis_by_kind",
            "axis_for_groups", "detect_resharding", "expected_kinds",
            "record_inventory", "parse_collectives", "parse_instructions",
            "parse_replica_groups", "parse_shape", "shape_bytes",

@@ -45,7 +45,7 @@ from . import hlo as _hlo
 __all__ = ["ici_peaks", "estimate_ms", "attribute_axis", "axis_for_groups",
            "expected_kinds", "detect_resharding", "record_inventory",
            "capture", "programs", "reset_programs", "step_estimate",
-           "EXPECTED_KINDS", "ICI_TABLE"]
+           "axis_by_kind", "EXPECTED_KINDS", "ICI_TABLE"]
 
 # Per-chip aggregate ICI bandwidth (bytes/s, one direction). Published
 # per-chip interconnect numbers: v4 ≈ 2.4 Tb/s, v5e ≈ 1.6 Tb/s,
@@ -273,6 +273,37 @@ def step_estimate():
             # budget must report 'unavailable', never an estimated zero
             "hlo_available": bool(rec.get("hlo_available", True)),
             "resharding_collectives": rec.get("resharding_collectives", 0)}
+
+
+def axis_by_kind(program) -> dict:
+    """``op kind -> mesh axis`` for one captured program — the join
+    mxtpu.devicescope uses to attribute MEASURED collective-lane time
+    to a mesh axis (the trace's op events carry kind but not replica
+    groups; the static inventory carries both).
+
+    ``program``: a program name (looked up in the capture table) or a
+    record dict. A kind whose rows span more than one axis maps to
+    None — ambiguous attribution is reported as unknown, never
+    guessed. Returns {} for unknown programs. Never raises."""
+    try:
+        rec = program
+        if not isinstance(rec, dict):
+            with _plock:
+                rec = _PROGRAMS.get(program)
+        if not isinstance(rec, dict):
+            return {}
+        out = {}
+        for row in rec.get("collectives") or []:
+            k = row.get("kind")
+            if k is None:
+                continue
+            if k in out and out[k] != row.get("axis"):
+                out[k] = None
+            else:
+                out[k] = row.get("axis")
+        return out
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 _KIND_COUNTER = {k: "commscope." + k.replace("-", "_")

@@ -129,6 +129,17 @@ def _env_float(name, default):
         return float(default)
 
 
+def _devicescope_window_path():
+    """Artifact dir of the last completed devicescope capture window,
+    or None — attached to stall/NaN alerts so the post-mortem has the
+    measured device timeline, not just host state. Never raises."""
+    try:
+        from .. import devicescope as _ds
+        return _ds.last_window_path()
+    except Exception:   # noqa: BLE001 — alerting must never crash
+        return None
+
+
 class HealthMonitor:
     """One per process; owns the timeline, sentinels, watchdog thread,
     and the structured event log. Constructed via :func:`enable`."""
@@ -187,6 +198,14 @@ class HealthMonitor:
             family = "healthmon.stall_alerts"
         else:
             family = "healthmon.step_time_regressions"
+        if name == "stall" or name.startswith("nan_"):
+            # post-mortem breadcrumb: the last completed devicescope
+            # capture window (if any run made one) holds the DEVICE
+            # timeline for the steps before things went wrong — the
+            # host-state dump alone can't show a wedged collective lane
+            p = _devicescope_window_path()
+            if p:
+                args = dict(args, devicescope_window=p)
         _counter(family, "healthmon").increment()
         if _flight._REC is not None:
             _flight.record("alert", "healthmon." + name, args)

@@ -232,6 +232,18 @@ def record_program(name: str, flops, bytes_accessed, dtype="float32",
     return rec
 
 
+def _devicescope_register(name, lowered):
+    """Record the program's HLO module name with mxtpu.devicescope when
+    armed — the join key between measured trace lanes (whose op events
+    carry ``hlo_module``) and this program table. Never raises."""
+    try:
+        from .. import devicescope as _ds
+        if _ds._DS is not None and lowered is not None:
+            _ds.register_program(name, _ds.module_name_of(lowered))
+    except Exception:  # noqa: BLE001 — registration never breaks compiles
+        pass
+
+
 def _commscope_capture(name, lowered=None, compiled=None, mesh=None,
                        mode=None, kind="program"):
     """Hand the program to mxtpu.commscope when armed — the collective/
@@ -265,6 +277,7 @@ def analyze_lowered(lowered, name: str, dtype="float32",
     flops, nbytes = _extract_costs(costs)
     rec = record_program(name, flops, nbytes, dtype=dtype, kind=kind,
                          extra=extra)
+    _devicescope_register(name, lowered)
     _commscope_capture(name, lowered=lowered, compiled=compiled,
                        mesh=mesh, mode=mode, kind=kind)
     return rec

@@ -15,9 +15,11 @@ observability layers already export into one per-step budget::
   this repo runs on (through the axon relay ``block_until_ready()``
   returns at enqueue — PERF.md's protocol note), so the synchronized
   per-step wall minus the measured host dispatch share is the device
-  time. On TPU runs with ``profile_xla`` a jax-profiler device trace is
-  the higher-fidelity source; the probe is the portable fallback that
-  works on the CPU tier-1 path.
+  time. When an ``mxtpu.devicescope`` capture window completed for the
+  run, the window's MEASURED device busy time replaces the probe value
+  and the budget's provenance upgrades to ``measured(profile)`` (the
+  probe stays beside it in the reconciliation block); the probe is the
+  portable fallback that works with no window on the CPU tier-1 path.
 * **collective** — delta of the ``kvstore.collective_ms`` counter over
   the steady phase (zero on single-process runs).
 * **input_wait** — delta of ``io.wait_ms`` (DevicePrefetcher's consumer
@@ -100,6 +102,7 @@ class StepBudget:
         self._steps = 0
         self._steady_s = 0.0
         self._probe = None
+        self._begin_monotonic = None
 
     _TRACKED = ("io/io.wait_ms", "mxtpu/kvstore.collective_ms",
                 "trainloop/trainloop.dispatch_ms")
@@ -110,6 +113,11 @@ class StepBudget:
 
     def begin(self):
         self._snap0 = self._snapshot()
+        # steady-phase start marker: the devicescope reconciliation only
+        # accepts capture windows completed AFTER this point — a window
+        # from an earlier run in the same process measured someone
+        # else's steady phase
+        self._begin_monotonic = time.monotonic()
         return self
 
     def add_dispatch(self, seconds: float):
@@ -242,6 +250,39 @@ class StepBudget:
             # off the wall and attribute the middle to the device
             device = max(0.0, step_ms - min(disp_ms, step_ms)
                          - input_wait - collective)
+        budget_source = "probe" if self._probe is not None else "residual"
+        # devicescope reconciliation: when a completed capture window
+        # measured the device timeline, the MEASURED busy/collective
+        # numbers replace the probe/estimate (provenance upgraded to
+        # measured(profile)); the analytic values stay beside them in
+        # the reconciliation block, and a >25% disagreement fires the
+        # loud drift warning (docs/devicescope.md). With no window this
+        # whole branch is one predicate and the budget settles exactly
+        # as above — pinned by tests both ways.
+        reconciliation = None
+        try:
+            from .. import devicescope as _ds
+            upd = _ds.budget_overrides(
+                step_ms=step_ms, device=device, collective=collective,
+                collective_source=collective_source,
+                source=budget_source, since=self._begin_monotonic)
+        except Exception:  # noqa: BLE001 — measurement must never
+            upd = None                 # destroy the settled budget
+        if upd is not None:
+            device = upd["device_compute_ms"]
+            collective = upd["collective_ms"]
+            collective_source = upd["collective_source"]
+            budget_source = upd["source"]
+            reconciliation = upd["reconciliation"]
+            # prefetch wait can OVERLAP measured device busy (that is
+            # the prefetcher's whole point), but the budget is a wall-
+            # time accounting identity: the measured device/collective
+            # claims are the strong ones, so input_wait keeps only the
+            # share the device was actually idle for — otherwise an
+            # input-starved-but-overlapped run sums past step_ms and
+            # trace_check rejects the artifact as malformed
+            input_wait = min(input_wait,
+                             max(0.0, step_ms - device - collective))
         # host gap: steady time neither the device nor input/collective
         # explains, capped by the host time actually measured inside
         # dispatch calls (a gap the host didn't spend can't be its fault)
@@ -261,7 +302,8 @@ class StepBudget:
             "dispatch_ms": round(disp_ms, 4),   # raw host-dispatch share
             "steps": steps,
             "probe": self._probe,
-            "source": "probe" if self._probe is not None else "residual",
+            "source": budget_source,
+            "reconciliation": reconciliation,
         }
         comp_sum = (decomp["device_compute_ms"] + decomp["collective_ms"]
                     + decomp["input_wait_ms"] + decomp["host_gap_ms"]

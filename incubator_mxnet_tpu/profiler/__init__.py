@@ -58,6 +58,10 @@ __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
 # --------------------------------------------------------------------------
 _ACTIVE = False
 _RUNNING = False
+# True only while a profile_xla device trace WE started is running —
+# set_state must never stop a trace owned by someone else (a
+# devicescope capture window holds the one-per-process jax trace)
+_xla_trace_owned = False
 
 _config = {
     "filename": "profile.json",
@@ -149,10 +153,18 @@ def set_state(state: str = "stop"):
     _ACTIVE = _RUNNING
     _install_hooks(_RUNNING)
     if _config["profile_xla"] and was_running != _RUNNING:
+        global _xla_trace_owned
         if _RUNNING:
-            _tpu.start_device_trace(_config["xla_logdir"])
-        else:
+            # jax allows ONE trace per process: if a devicescope
+            # capture window (or anyone else) is already tracing,
+            # start returns False and this session must NOT stop the
+            # trace it failed to start — stopping would kill the
+            # window's capture mid-flight while it still counts steps
+            _xla_trace_owned = _tpu.start_device_trace(
+                _config["xla_logdir"])
+        elif _xla_trace_owned:
             _tpu.stop_device_trace()
+            _xla_trace_owned = False
 
 
 def start():

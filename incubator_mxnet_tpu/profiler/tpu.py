@@ -11,7 +11,12 @@ Two jobs:
   weight in the hot path.
 * :func:`start_device_trace` / :func:`stop_device_trace` — drive
   ``jax.profiler`` for a full XLA capture when
-  ``set_config(profile_xla=True)``.
+  ``set_config(profile_xla=True)`` — and for mxtpu.devicescope's
+  bounded capture windows, which need to know whether the capture
+  actually armed (jax allows ONE active trace per process, so a window
+  opened while ``profile_xla`` is tracing must DECLINE, not silently
+  share the artifact): ``start_device_trace`` returns True only when
+  this call started a fresh trace.
 
 Backend detection is done once and cached; everything degrades to a no-op
 if jax's profiler is unavailable (e.g. stripped builds)."""
@@ -43,14 +48,22 @@ def annotation(name: str):
         return None
 
 
-def start_device_trace(logdir: str):
+def start_device_trace(logdir: str) -> bool:
+    """Start a jax profiler trace into ``logdir``. Returns True when
+    THIS call armed a fresh trace; False when one is already running
+    (ours or anyone's — jax allows one per process) or the profiler is
+    unavailable. Callers that need exclusivity (devicescope windows)
+    key off the return value."""
     global _tracing
+    if _tracing:
+        return False
     try:
         import jax
         jax.profiler.start_trace(logdir)
         _tracing = True
+        return True
     except Exception:
-        pass                      # already tracing / profiler unavailable
+        return False              # already tracing / profiler unavailable
 
 
 def stop_device_trace():
@@ -61,3 +74,8 @@ def stop_device_trace():
     except Exception:
         pass                      # never started / profiler unavailable
     _tracing = False
+
+
+def tracing() -> bool:
+    """True while a device trace started here is running."""
+    return _tracing

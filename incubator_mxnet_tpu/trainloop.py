@@ -56,6 +56,7 @@ import time
 
 import numpy as np
 
+from . import devicescope as _devicescope
 from . import profiler as _prof
 from .io.prefetch import DevicePrefetcher
 from .parallel.trainer_step import FusedTrainStep
@@ -160,6 +161,21 @@ class TrainLoop:
                         "trainloop")
         _prof.set_gauge("trainloop.in_program_lr",
                         int(self.in_program_lr), "trainloop")
+        # devicescope capture windows bound themselves in STEPS, and the
+        # executor is the only one who knows a dispatch was k of them —
+        # mark the active window so `with devicescope.capture(): fit()`
+        # needs no user-side plumbing (one predicate when no window).
+        # The sync thunk fetches this chunk's last loss, a true barrier
+        # (steps chain through donated params), so a window closing at
+        # this mark never closes with its own steps still in flight —
+        # it only runs if this mark IS the window boundary. No
+        # dispatch_ms here: the trainloop.dispatch_ms counter above
+        # already carries this chunk's wall, and the window reads that
+        # counter's delta — passing it again would double-count the
+        # dispatch share in the gap taxonomy
+        win = _devicescope.active_window()
+        if win is not None:
+            win.step(k, sync=lambda: float(losses[k - 1]))
         return losses
 
     def fit(self, data, steps=None, epochs=None, cycle=None):

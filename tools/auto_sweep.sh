@@ -60,6 +60,13 @@ if timeout 900 bash tools/comms_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) comms smoke FAILED (continuing; collective observability suspect)" >> "$LOG"
 fi
+# devicescope smoke (CPU-only): the measured device-timeline window +
+# reconciliation the sweep's MFU claims are checked against
+if timeout 1200 bash tools/devicescope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) devicescope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) devicescope smoke FAILED (continuing; measured device timeline suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -26,11 +26,17 @@ unavailable), counterfactual MFU table, per-program roofline verdicts.
 axis) with count / payload MiB / analytic ICI estimate, plus any
 resharding findings with the offending operand shapes.
 
+`device`: the measured device-timeline report from a BENCH json
+(`extra.devicescope`) — busy fraction, top-K device ops joined to their
+roofline verdicts, measured collective lanes, idle-gap taxonomy, and
+the analytic-vs-measured reconciliation.
+
 Usage:
     python tools/mxdiag.py DUMP.json [--events N]
     python tools/mxdiag.py metrics.jsonl
     python tools/mxdiag.py perf BENCH.json
     python tools/mxdiag.py comms BENCH.json
+    python tools/mxdiag.py device BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
@@ -185,6 +191,36 @@ def print_metrics(path: str) -> None:
 # perf: MFU-decomposition report from a BENCH json (extra.perfscope)
 # ---------------------------------------------------------------------------
 
+def _print_reconciliation(recon: dict, indent: str = "  ") -> None:
+    """The analytic-vs-measured table a devicescope window produced —
+    shared by `perf` and `device` so the two reports can't drift apart
+    on the reconciliation schema."""
+    ana, mea = recon.get("analytic") or {}, recon.get("measured") or {}
+    thr = recon.get("threshold")
+    drift = recon.get("drift") or {}
+    print(f"\n{indent}analytic vs measured (devicescope window"
+          + (f", drift threshold {thr:.0%}" if thr else "") + "):")
+    for comp in ("device_compute", "collective"):
+        a, m = ana.get(comp + "_ms"), mea.get(comp + "_ms")
+        if a is None or m is None:
+            continue
+        dr = drift.get(comp)
+        src = (f"analytic({ana.get('source')})"
+               if comp == "device_compute"
+               else f"analytic({ana.get('collective_source')})")
+        line = (f"{indent}  {comp:<15} measured {m:>10.3f} ms   "
+                f"{src} {a:>10.3f} ms")
+        if dr is not None:
+            line += f"   delta {dr:>6.1%}"
+            if thr is not None and dr > thr:
+                line += "  << DRIFT"
+        print(line)
+    if recon.get("drift_warning"):
+        print(f"{indent}  DRIFT WARNING: analytic and measured disagree "
+              f"beyond the threshold — an estimate (probe / ring model "
+              f"/ peak table) has gone stale; trust the measured window "
+              f"(docs/devicescope.md)")
+
 def _load_bench(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -222,6 +258,8 @@ def print_perf(doc: dict) -> int:
     d = ps.get("decomposition")
     if isinstance(d, dict) and d.get("step_ms"):
         step = d["step_ms"]
+        recon = d.get("reconciliation") \
+            if isinstance(d.get("reconciliation"), dict) else None
         print(f"\n  step budget ({d.get('steps')} steps, source="
               f"{d.get('source')}):  step_ms = {step:.3f}")
         for comp in ("device_compute", "collective", "input_wait",
@@ -236,12 +274,19 @@ def print_perf(doc: dict) -> int:
                 src = d.get("collective_source")
                 if src == "estimated":
                     tag = "  [estimated: commscope static-HLO]"
+                elif src == "measured(profile)":
+                    tag = "  [measured: devicescope window]"
                 elif src == "unavailable":
                     tag = ("  [UNAVAILABLE: in-program collectives, "
                            "commscope off — not a measured zero]")
             print(f"    {comp:<15} {ms:>10.3f} ms  {share:>6.1%}  "
                   f"{bar}{tag}")
         print(f"    {'(coverage':<15} {d.get('coverage')})")
+        if recon:
+            # BOTH sources exist: show the analytic numbers (probe /
+            # ring estimate) beside the measured window, with the delta
+            # — never only one source when the run carried both
+            _print_reconciliation(recon)
         if d.get("mfu") is not None:
             print(f"\n  MFU decomposition:  achieved {d['mfu']:.4f}")
             if d.get("mfu_device_only") is not None:
@@ -369,6 +414,120 @@ def _comms_main(argv) -> int:
         print(f"comms: {e}", file=sys.stderr)
         return 1
     return print_comms(doc)
+
+
+# ---------------------------------------------------------------------------
+# device: measured device-timeline report from a BENCH json
+# (extra.devicescope)
+# ---------------------------------------------------------------------------
+
+def print_device(doc: dict) -> int:
+    """The "what did the chip actually do" report: measured busy
+    fraction, top-K device ops joined to their roofline verdicts,
+    measured collective lanes, the idle-gap taxonomy, and the
+    analytic-vs-measured reconciliation — everything a devicescope
+    capture window ingested (docs/devicescope.md)."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')}, batch "
+          f"{extra.get('batch')}, {extra.get('dtype')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    ds = extra.get("devicescope")
+    if not isinstance(ds, dict):
+        print("  no extra.devicescope section (devicescope was off — "
+              "rerun with BENCH_DEVICESCOPE=1)")
+        return 1
+    win = ds.get("window")
+    if not isinstance(win, dict):
+        print("  devicescope was armed but no capture window completed "
+              "(profiler busy, or the run ended before the window)")
+        return 1
+    wall = win.get("wall_ms")
+    wall_s = f"{wall:.1f} ms" if _is_numlike(wall) else str(wall)
+    print(f"  window: {win.get('steps')} steps over {wall_s}  "
+          f"(requested {win.get('requested_steps')}, "
+          f"complete={win.get('complete')})")
+    print(f"    artifact: {win.get('path')}")
+    if ds.get("error"):
+        print(f"    INGEST ERROR: {ds['error']}")
+    bf = ds.get("busy_fraction")
+    if bf is not None:
+        bar = "#" * int(round(bf * 40))
+        print(f"\n  device busy fraction: {bf:.1%}  {bar}")
+    per = ds.get("per_step") or {}
+    if per:
+        print(f"    per step: busy {per.get('device_busy_ms')} ms  "
+              f"collective {per.get('collective_ms')} ms  "
+              f"idle {per.get('idle_ms')} ms  "
+              f"(over {ds.get('device_events')} device events, "
+              f"{len(ds.get('lanes') or [])} lanes)")
+    tops = ds.get("top_ops") or []
+    if tops:
+        print(f"\n  top device ops ({len(tops)}):")
+        width = max(len(t.get("op", "?")) for t in tops)
+        for t in tops:
+            prog = t.get("program") or t.get("module") or "?"
+            verdict = f"  [{t['verdict']}]" if t.get("verdict") else ""
+            print(f"    {t.get('op', '?'):<{width}}  "
+                  f"{t.get('total_ms', 0):>10.3f} ms  "
+                  f"x{t.get('count', 0):<5} "
+                  f"{prog}{verdict}")
+    colls = ds.get("collectives") or {}
+    rows = colls.get("by_kind") or []
+    if rows:
+        print(f"\n  measured collectives (union "
+              f"{colls.get('union_ms')} ms):")
+        for r in rows:
+            print(f"    {r.get('kind', '?'):<19} x{r.get('count', 0):<5} "
+                  f"{r.get('total_ms', 0):>10.3f} ms  "
+                  f"axis {r.get('axis') or '?'}")
+    gaps = ds.get("gaps")
+    if isinstance(gaps, dict):
+        tax = gaps.get("taxonomy") or {}
+        print(f"\n  idle gaps: {gaps.get('count')} gaps, "
+              f"{gaps.get('total_ms')} ms total, "
+              f"max {gaps.get('max_ms')} ms")
+        hist = gaps.get("histogram_ms") or {}
+        if hist:
+            print("    duration histogram (ms): "
+                  + "  ".join(f"<={k}: {v}" for k, v in hist.items()))
+        idle = sum(v for v in tax.values()
+                   if isinstance(v, (int, float))) or None
+        for key, label in (("input_starved_ms", "input-starved"),
+                           ("dispatch_serialized_ms",
+                            "dispatch-serialized"),
+                           ("host_gap_ms", "host-gap")):
+            v = tax.get(key)
+            if v is None:
+                continue
+            share = f"  {v / idle:>6.1%}" if idle else ""
+            print(f"    {label:<20} {v:>10.3f} ms{share}")
+    recon = ds.get("reconciliation")
+    if isinstance(recon, dict):
+        _print_reconciliation(recon)
+    elif bf is not None:
+        print("\n  no reconciliation block (the step budget settled "
+              "without this window — was perfscope off?)")
+    return 0
+
+
+def _device_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py device",
+        description="measured device-timeline report from a BENCH json "
+                    "(extra.devicescope)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"device: {e}", file=sys.stderr)
+        return 1
+    return print_device(doc)
 
 
 # ---------------------------------------------------------------------------
@@ -507,6 +666,8 @@ def main(argv=None) -> int:
         return _perf_main(argv[1:])
     if argv and argv[0] == "comms":
         return _comms_main(argv[1:])
+    if argv and argv[0] == "device":
+        return _device_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

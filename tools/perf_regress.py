@@ -14,11 +14,17 @@ baseline every later run "beats". This tool:
   value. They describe the environment, not the code;
 * **compares the metrics that matter** — headline throughput
   (``value``), ``extra.mfu`` (ROADMAP item 1's regression metric),
-  serving ``p99_ms``, and the per-step collective payload
+  serving ``p99_ms``, the per-step collective payload
   (``extra.commscope.step.bytes`` — a LAYOUT regression: a new
   accidental reshard inflates in-program collective bytes even when
-  the CPU-bench wall time barely moves) — relative, per metric, only
-  when both sides carry the number;
+  the CPU-bench wall time barely moves), and the MEASURED device busy
+  fraction (``extra.devicescope.busy_fraction`` — the ground-truth
+  utilization a devicescope capture window measured; a drop means the
+  chip got idler even if wall-clock noise hides it) — relative, per
+  metric, only when both sides carry the number. The busy gate follows
+  the same both-sides contract as the collective-bytes gate: a run
+  whose baseline carried no devicescope window (the 0→nonzero window
+  transition) is noted, never indicted;
 * **is noise-aware** — in trajectory mode (``--dir``) the baseline is
   the MEDIAN of all usable prior artifacts and the effective threshold
   is ``max(--threshold, --noise-mult × observed relative spread)``, so
@@ -53,6 +59,9 @@ DEFAULT_P99_THRESHOLD = 0.25   # 25% relative increase on p99
 # HLO inventory, no timing noise), so the gate is tight: a real layout
 # change moves it by integer factors, measurement scatter by zero
 DEFAULT_COLL_THRESHOLD = 0.10  # 10% relative increase on bytes/step
+# measured device busy fraction (devicescope window): a >10% relative
+# drop means the chip spent measurably more of the window idle
+DEFAULT_BUSY_THRESHOLD = 0.10
 DEFAULT_NOISE_MULT = 2.0
 
 
@@ -109,6 +118,14 @@ def load_artifact(path):
                       if isinstance(step.get("resharding_collectives"),
                                     int) else None,
     }
+    # measured device busy fraction from a devicescope capture window —
+    # None when the run carried no window (gate skipped: both-sides
+    # contract, same as the commscope bytes gate)
+    dsc = extra.get("devicescope") or {}
+    bf = dsc.get("busy_fraction") if isinstance(dsc, dict) else None
+    rec["busy_fraction"] = (float(bf)
+                            if isinstance(bf, (int, float))
+                            and not isinstance(bf, bool) else None)
     return rec, None
 
 
@@ -126,7 +143,8 @@ def _rel_spread(values):
 def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
             p99_threshold=DEFAULT_P99_THRESHOLD, noise=0.0,
             noise_mult=DEFAULT_NOISE_MULT,
-            coll_threshold=DEFAULT_COLL_THRESHOLD):
+            coll_threshold=DEFAULT_COLL_THRESHOLD,
+            busy_threshold=DEFAULT_BUSY_THRESHOLD):
     """Compare two loaded records → (regressions, notes): lists of
     human-readable strings. Lower-is-worse metrics (value, mfu) regress
     on a relative DROP beyond the effective threshold; p99 and the
@@ -184,6 +202,26 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
                 regressions.append("REGRESSION " + line)
             else:
                 notes.append("ok " + line)
+    bbf, cbf = baseline.get("busy_fraction"), candidate.get("busy_fraction")
+    if bbf is not None and cbf is not None and bbf > 0:
+        drop = (bbf - cbf) / bbf
+        effbf = max(busy_threshold, noise_mult * noise)
+        line = (f"busy fraction: {bbf:.4f} -> {cbf:.4f} "
+                f"({-drop:+.2%} vs threshold -{effbf:.1%})")
+        if drop > effbf:
+            regressions.append(
+                "REGRESSION " + line + " (the chip measurably idler — "
+                "see mxdiag.py device for the gap taxonomy)")
+        else:
+            notes.append("ok " + line)
+    elif (bbf is None) != (cbf is None):
+        # 0→nonzero (or nonzero→0) window transition: only one side ran
+        # a devicescope capture window — there is no measured pair to
+        # gate on, and inventing one would indict the act of measuring
+        side = "candidate" if bbf is None else "baseline"
+        notes.append(f"note: only the {side} carries a devicescope "
+                     f"busy fraction — busy gate skipped (needs a "
+                     f"window on both sides)")
     cr = candidate.get("resharding")
     if cr:
         br = baseline.get("resharding")
@@ -211,7 +249,8 @@ def _natural_key(path):
 
 def trajectory(paths, threshold, p99_threshold, noise_mult,
                candidate_path=None,
-               coll_threshold=DEFAULT_COLL_THRESHOLD):
+               coll_threshold=DEFAULT_COLL_THRESHOLD,
+               busy_threshold=DEFAULT_BUSY_THRESHOLD):
     """Directory mode: newest usable artifact vs the median of all
     earlier usable ones, thresholds widened by the observed spread.
     Returns (exit_code, lines)."""
@@ -254,7 +293,8 @@ def trajectory(paths, threshold, p99_threshold, noise_mult,
     regs, notes = compare(base, cand, threshold=threshold,
                           p99_threshold=p99_threshold, noise=noise,
                           noise_mult=noise_mult,
-                          coll_threshold=coll_threshold)
+                          coll_threshold=coll_threshold,
+                          busy_threshold=busy_threshold)
     lines.extend(notes + regs)
     return (1 if regs else 0), lines
 
@@ -286,6 +326,11 @@ def main(argv=None) -> int:
                     help="relative increase threshold for per-step "
                          "collective bytes (default 0.10; a zero "
                          "baseline flags ANY appearance)")
+    ap.add_argument("--busy-threshold", type=float,
+                    default=DEFAULT_BUSY_THRESHOLD,
+                    help="relative drop threshold for the measured "
+                         "device busy fraction (default 0.10; skipped "
+                         "unless BOTH sides carry a devicescope window)")
     args = ap.parse_args(argv)
 
     if args.dir:
@@ -297,7 +342,8 @@ def main(argv=None) -> int:
         rc, lines = trajectory(paths, args.threshold, args.p99_threshold,
                                args.noise_mult,
                                candidate_path=args.candidate,
-                               coll_threshold=args.coll_threshold)
+                               coll_threshold=args.coll_threshold,
+                               busy_threshold=args.busy_threshold)
         for ln in lines:
             print(ln)
         print("perf_regress: " + ("REGRESSION" if rc else "OK"))
@@ -319,7 +365,8 @@ def main(argv=None) -> int:
         return 0
     regs, notes = compare(base, cand, threshold=args.threshold,
                           p99_threshold=args.p99_threshold,
-                          coll_threshold=args.coll_threshold)
+                          coll_threshold=args.coll_threshold,
+                          busy_threshold=args.busy_threshold)
     for ln in notes + regs:
         print(ln)
     print("perf_regress: " + ("REGRESSION" if regs else "OK"))

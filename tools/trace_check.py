@@ -22,10 +22,10 @@
   JSONL, including `mxdiag merge` output) — per-record schema with the
   run_id/rank/step correlation ids, non-decreasing timestamps;
 * **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
-  `perfscope/*`, `commscope/*` or `sharding/*` metric appearing in a
-  flight dump or metrics series must belong to the known family table
-  with the declared kind (an unknown or re-kinded metric means a
-  producer drifted from the documented schema).
+  `perfscope/*`, `commscope/*`, `devicescope/*` or `sharding/*` metric
+  appearing in a flight dump or metrics series must belong to the known
+  family table with the declared kind (an unknown or re-kinded metric
+  means a producer drifted from the documented schema).
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -46,7 +46,8 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_metrics_jsonl", "check_histogram_snapshot",
            "check_bench_json", "check_events_jsonl",
            "check_healthmon_kinds", "check_perfscope_extra",
-           "check_commscope_extra", "check_sharding_extra", "check_file"]
+           "check_commscope_extra", "check_devicescope_extra",
+           "check_sharding_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -155,8 +156,30 @@ COMMSCOPE_FAMILIES = {
 COMMSCOPE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
                    "all-to-all", "collective-permute", "other")
 
-# provenance values the step budget's collective component may declare
-COLLECTIVE_SOURCES = ("measured", "estimated", "unavailable")
+# provenance values the step budget's collective component may declare:
+# kvstore-counter / devicescope-window measurements, commscope's static
+# estimate, or an honest unknown
+COLLECTIVE_SOURCES = ("measured", "measured(profile)", "estimated",
+                      "unavailable")
+
+# The devicescope.* (measured device-timeline) metric families
+# (docs/devicescope.md): window lifecycle counters plus the last
+# window's measured per-step gauges.
+DEVICESCOPE_FAMILIES = {
+    "devicescope/devicescope.windows": "counter",
+    "devicescope/devicescope.steps_captured": "counter",
+    "devicescope/devicescope.declined": "counter",
+    "devicescope/devicescope.ingest_errors": "counter",
+    "devicescope/devicescope.drift_warnings": "counter",
+    "devicescope/devicescope.busy_fraction": "gauge",
+    "devicescope/devicescope.device_busy_ms": "gauge",
+    "devicescope/devicescope.collective_ms": "gauge",
+    "devicescope/devicescope.idle_ms": "gauge",
+}
+
+# idle-gap taxonomy buckets an `extra.devicescope` gaps block classifies
+DEVICESCOPE_GAP_TAXONOMY = ("input_starved_ms", "dispatch_serialized_ms",
+                            "host_gap_ms")
 
 # decomposition components that must sum (with "other" absorbing the
 # residual) to the measured step time
@@ -302,9 +325,9 @@ def check_flight(path: str) -> list:
 # ---------------------------------------------------------------------------
 
 def check_healthmon_kinds(kinds: dict) -> list:
-    """Every healthmon/*, io/*, trainloop/*, perfscope/*, commscope/*
-    and sharding/* metric must belong to its family table with the
-    declared kind."""
+    """Every healthmon/*, io/*, trainloop/*, perfscope/*, commscope/*,
+    devicescope/* and sharding/* metric must belong to its family table
+    with the declared kind."""
     errors = []
     tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
               ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
@@ -312,6 +335,8 @@ def check_healthmon_kinds(kinds: dict) -> list:
                "IO_TRAINLOOP_FAMILIES"),
               ("perfscope/", PERFSCOPE_FAMILIES, "PERFSCOPE_FAMILIES"),
               ("commscope/", COMMSCOPE_FAMILIES, "COMMSCOPE_FAMILIES"),
+              ("devicescope/", DEVICESCOPE_FAMILIES,
+               "DEVICESCOPE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -741,6 +766,144 @@ def check_commscope_extra(cs) -> list:
     return errors
 
 
+# ---------------------------------------------------------------------------
+# devicescope bench section (extra.devicescope)
+# ---------------------------------------------------------------------------
+
+def check_devicescope_extra(ds) -> list:
+    """Validate an `extra.devicescope` BENCH section: a window header
+    (or the armed-but-no-window `window: null` shape), a busy fraction
+    in [0, 1], top-K rows with non-negative measured times, measured
+    collective kinds from the closed commscope taxonomy, a gap taxonomy
+    whose buckets are numeric, and — when present — a reconciliation
+    block whose analytic and measured sides both carry numeric
+    components."""
+    if ds is None:
+        return []
+    if not isinstance(ds, dict):
+        return [f"must be an object, got {type(ds).__name__}"]
+    errors = []
+    win = ds.get("window")
+    if win is None:
+        # armed but no completed window: everything else must be empty
+        if ds.get("busy_fraction") is not None:
+            errors.append("window is null but busy_fraction is set")
+        return errors
+    if not isinstance(win, dict):
+        return [f"'window' must be an object or null, "
+                f"got {type(win).__name__}"]
+    steps = win.get("steps")
+    # 0 is legal: a window stopped before its first step mark still
+    # reports honestly (its per-step numbers just use a 1-step floor)
+    if not isinstance(steps, int) or isinstance(steps, bool) or steps < 0:
+        errors.append(f"window.steps must be an int >= 0, got {steps!r}")
+    wall = win.get("wall_ms")
+    if wall is not None and (not _is_num(wall) or wall <= 0):
+        errors.append(f"window.wall_ms must be positive or null, "
+                      f"got {wall!r}")
+    if not isinstance(win.get("path"), str) or not win["path"]:
+        errors.append("window needs a non-empty 'path'")
+    bf = ds.get("busy_fraction")
+    if bf is not None and (not _is_num(bf) or not 0.0 <= bf <= 1.0):
+        errors.append(f"busy_fraction={bf!r} outside [0, 1]")
+    per = ds.get("per_step")
+    if per is not None:
+        if not isinstance(per, dict):
+            errors.append("per_step must be an object or null")
+        else:
+            for key in ("device_busy_ms", "collective_ms", "idle_ms"):
+                v = per.get(key)
+                if not _is_num(v) or v < 0:
+                    errors.append(f"per_step[{key!r}] must be numeric "
+                                  f">= 0, got {v!r}")
+    tops = ds.get("top_ops")
+    if not isinstance(tops, list):
+        errors.append("needs a 'top_ops' list")
+    else:
+        for i, t in enumerate(tops):
+            if not isinstance(t, dict):
+                errors.append(f"top_ops[{i}]: not an object")
+                continue
+            if not isinstance(t.get("op"), str) or not t["op"]:
+                errors.append(f"top_ops[{i}]: missing/empty 'op'")
+            n = t.get("count")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                errors.append(f"top_ops[{i}] ({t.get('op')!r}): count "
+                              f"must be an int >= 1, got {n!r}")
+            v = t.get("total_ms")
+            if not _is_num(v) or v < 0:
+                errors.append(f"top_ops[{i}] ({t.get('op')!r}): total_ms "
+                              f"must be >= 0, got {v!r}")
+            verdict = t.get("verdict")
+            if verdict is not None and verdict not in ROOFLINE_VERDICTS:
+                errors.append(f"top_ops[{i}] ({t.get('op')!r}): verdict "
+                              f"{verdict!r} not in {ROOFLINE_VERDICTS}")
+    colls = ds.get("collectives")
+    if colls is not None:
+        if not isinstance(colls, dict):
+            errors.append("collectives must be an object or null")
+        else:
+            for row in colls.get("by_kind") or []:
+                if not isinstance(row, dict):
+                    errors.append("collectives.by_kind row not an object")
+                    continue
+                if row.get("kind") not in COMMSCOPE_KINDS:
+                    errors.append(f"collectives kind {row.get('kind')!r} "
+                                  f"not in {COMMSCOPE_KINDS}")
+                v = row.get("total_ms")
+                if not _is_num(v) or v < 0:
+                    errors.append(f"collectives[{row.get('kind')!r}] "
+                                  f"total_ms must be >= 0, got {v!r}")
+    gaps = ds.get("gaps")
+    if gaps is not None:
+        if not isinstance(gaps, dict):
+            errors.append("gaps must be an object or null")
+        else:
+            tax = gaps.get("taxonomy")
+            if not isinstance(tax, dict):
+                errors.append("gaps needs a 'taxonomy' object")
+            else:
+                for key in DEVICESCOPE_GAP_TAXONOMY:
+                    v = tax.get(key)
+                    if not _is_num(v) or v < 0:
+                        errors.append(f"gaps.taxonomy[{key!r}] must be "
+                                      f"numeric >= 0, got {v!r}")
+    recon = ds.get("reconciliation")
+    if recon is not None:
+        if not isinstance(recon, dict):
+            errors.append("reconciliation must be an object or null")
+        else:
+            for side in ("analytic", "measured"):
+                blk = recon.get(side)
+                if not isinstance(blk, dict):
+                    errors.append(f"reconciliation needs a {side!r} "
+                                  f"object")
+                    continue
+                for key in ("device_compute_ms", "collective_ms"):
+                    v = blk.get(key)
+                    if not _is_num(v) or v < 0:
+                        errors.append(f"reconciliation.{side}[{key!r}] "
+                                      f"must be >= 0, got {v!r}")
+            src = (recon.get("analytic") or {}).get("collective_source")
+            if src is not None and src not in COLLECTIVE_SOURCES:
+                errors.append(f"reconciliation analytic "
+                              f"collective_source={src!r} not in "
+                              f"{COLLECTIVE_SOURCES}")
+            drift = recon.get("drift")
+            if drift is not None and not isinstance(drift, dict):
+                errors.append("reconciliation.drift must be an object")
+            elif isinstance(drift, dict):
+                for k, v in drift.items():
+                    if v is not None and (not _is_num(v) or v < 0):
+                        errors.append(f"reconciliation.drift[{k!r}] must "
+                                      f"be numeric >= 0 or null, "
+                                      f"got {v!r}")
+            if not isinstance(recon.get("drift_warning"), bool):
+                errors.append(f"reconciliation.drift_warning must be a "
+                              f"bool, got {recon.get('drift_warning')!r}")
+    return errors
+
+
 def check_sharding_extra(sh) -> list:
     """Validate an `extra.sharding` BENCH section (bench.py BENCH_MESH
     runs): a positive mesh shape, a mode from the closed taxonomy, and
@@ -824,6 +987,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.commscope: {e}"
                for e in check_commscope_extra(
                    (doc.get("extra") or {}).get("commscope"))]
+    errors += [f"extra.devicescope: {e}"
+               for e in check_devicescope_extra(
+                   (doc.get("extra") or {}).get("devicescope"))]
     errors += [f"extra.sharding: {e}"
                for e in check_sharding_extra(
                    (doc.get("extra") or {}).get("sharding"))]
