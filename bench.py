@@ -702,8 +702,20 @@ def _serving_bench():
     import threading
     import urllib.request
 
+    from incubator_mxnet_tpu import devicescope
     from incubator_mxnet_tpu import profiler as prof
-    from incubator_mxnet_tpu import serving
+    from incubator_mxnet_tpu import servescope, serving
+
+    # request-lifecycle tracing + tail-latency attribution rides every
+    # serving bench by default (BENCH_SERVESCOPE=0 opts out) —
+    # extra.servescope in the BENCH json. Sampled at a stride of 4
+    # unless MXTPU_SERVESCOPE_SAMPLE says otherwise: the bench's
+    # p50/p95/p99/QPS are the perf_regress-gated headline numbers, and
+    # tracing EVERY sub-ms predict would measure the instrumentation,
+    # not the server, against pre-servescope baselines
+    if os.environ.get("BENCH_SERVESCOPE", "1") != "0":
+        servescope.enable(
+            sample=os.environ.get("MXTPU_SERVESCOPE_SAMPLE", 4))
 
     name = os.environ.get("BENCH_SERVING_MODEL", "lenet")
     if name not in _SERVING_SHAPES:
@@ -748,6 +760,14 @@ def _serving_bench():
                 failures.append((i, f"{type(e).__name__}: {e}"))
 
     _log(f"firing {clients} clients x {per_client} requests")
+    # BENCH_DEVICESCOPE=1: one measured device window over the serving
+    # dispatches (the batcher marks each executed batch), upgrading the
+    # attribution's device_exec provenance to measured(profile)
+    ds_win = None
+    if os.environ.get("BENCH_DEVICESCOPE", "") == "1":
+        ds_win = devicescope.capture(
+            steps=int(os.environ.get("BENCH_DEVICESCOPE_STEPS", "10"))
+        ).start()
     t0 = time.time()
     with prof.record_function("bench.steady", "bench", sync=False):
         threads = [threading.Thread(target=client, args=(c,))
@@ -757,8 +777,11 @@ def _serving_bench():
         for t in threads:
             t.join()
     serve_s = time.time() - t0
-    stats = srv.stats()
-    srv.stop()                      # graceful drain
+    if ds_win is not None:
+        ds_win.stop()
+    stats = srv.stats()             # ONE registry snapshot: every
+    srv.stop()                      # derived number below reads it
+    #                                 (graceful drain)
 
     if failures:
         raise RuntimeError(f"{len(failures)}/{n_req} requests failed; "
@@ -804,7 +827,11 @@ def _serving_bench():
                            f"(responses != submitted)")
 
     qps = n_req / serve_s
-    hist = prof.counters().get("serving/serving.latency_ms") or {}
+    # the histogram comes from the SAME snapshot as the percentiles —
+    # a second counters() read here could see a later epoch than the
+    # stats-derived numbers and trip the validator's lost-observations
+    # check under concurrent traffic
+    hist = stats.get("serving.latency_ms") or {}
     extra_serving = {
         "model": name, "clients": clients, "per_client": per_client,
         "requests": n_req,
@@ -814,6 +841,8 @@ def _serving_bench():
         "rejected_queue_full": int(stats.get("serving.rejected_queue_full",
                                              0)),
         "rejected_deadline": int(stats.get("serving.rejected_deadline", 0)),
+        "rejected_deadline_post_batch": int(stats.get(
+            "serving.rejected_deadline_post_batch", 0)),
         "rejected_invalid": int(stats.get("serving.rejected_invalid", 0)),
         "qps": round(qps, 2),
         "p50_ms": stats.get("p50_ms"),
@@ -841,6 +870,12 @@ def _serving_bench():
         # serving has no train-step budget, but the per-bucket roofline
         # verdicts still ride along
         result["extra"]["perfscope"] = _psmod.bench_extra(None)
+    if servescope._SS is not None:
+        # the tail-latency attribution (per-bucket components + the
+        # roofline/resharding verdict join — docs/servescope.md)
+        result["extra"]["servescope"] = servescope.bench_extra()
+    if ds_win is not None:
+        result["extra"]["devicescope"] = devicescope.bench_extra()
     _finish_profile(result, trace_path, compile_s=compile_s,
                     warmup_s=warmup_s, steady_s=serve_s)
     return result
@@ -1002,7 +1037,7 @@ def _record_data_bench(mode, batch, steps, dtype):
                 budget.add_dispatch(disp_s)
             if ds_win is not None:
                 ds_win.step(1, dispatch_ms=disp_s * 1e3,
-                            sync=lambda: float(loss))
+                            sync=lambda: float(loss), workload="train")
         loss_val = float(loss)                    # host fetch = barrier
     dt = time.time() - t0
     if ds_win is not None:
@@ -1262,7 +1297,8 @@ def main():
                     # window closing at this mark must not close with
                     # its own steps still in flight (async dispatch)
                     ds_win.step(k, dispatch_ms=disp_s * 1e3,
-                                sync=lambda: float(losses[k - 1]))
+                                sync=lambda: float(losses[k - 1]),
+                                workload="train")
                 _healthmon_mark_step()     # one mark per dispatched chunk
             loss_val = float(losses[k - 1])         # host fetch = barrier
         dt = time.time() - t0
@@ -1286,7 +1322,8 @@ def main():
                     # see run_k path: the sync fetch only runs at the
                     # window boundary, so the other steps stay async
                     ds_win.step(1, dispatch_ms=disp_s * 1e3,
-                                sync=lambda: float(loss))
+                                sync=lambda: float(loss),
+                                workload="train")
                 _healthmon_mark_step()
             loss_val = float(loss)
         dt = time.time() - t0

@@ -57,6 +57,7 @@ from . import healthmon
 from . import perfscope
 from . import commscope
 from . import devicescope
+from . import servescope
 from . import serving
 from . import trainloop
 from .trainloop import TrainLoop
@@ -90,3 +91,7 @@ commscope.enable_from_env()
 # jax-profiler trace + ingestion + analytic-vs-measured reconciliation
 # — see docs/devicescope.md).
 devicescope.enable_from_env()
+# MXTPU_SERVESCOPE=1: arm request-lifecycle tracing + tail-latency
+# attribution on the serving path (sampled via MXTPU_SERVESCOPE_SAMPLE
+# — see docs/servescope.md).
+servescope.enable_from_env()

@@ -288,6 +288,14 @@ def budget_overrides(step_ms, device, collective, collective_source,
     if since is not None and w is not None \
             and (w.completed_at is None or w.completed_at < float(since)):
         return None               # stale window: predates this budget
+    if w is not None and getattr(w, "workload", None) \
+            not in (None, "train"):
+        # workload identity, not just freshness: a window stepped by
+        # the serving batcher (or by both loops — "mixed") measured
+        # dispatches this TRAIN budget never issued; upgrading from it
+        # would pin measured(profile) on someone else's busy time.
+        # None stays accepted for steppers that predate the stamp.
+        return None
     try:
         s = window_summary()
     except Exception:  # noqa: BLE001

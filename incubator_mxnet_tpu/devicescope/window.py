@@ -97,6 +97,10 @@ class CaptureWindow:
         self.logdir = logdir
         self.steps_done = 0
         self.dispatch_ms = 0.0        # caller-accumulated dispatch wall
+        self.workload = None          # who stepped it: "train"/"serving"
+                                      # ("mixed" if both) — consumers
+                                      # joining against a window must
+                                      # check this, not just freshness
         self.wall_ms = None
         self.state = "created"
         self.completed_at = None      # time.monotonic() at trace stop —
@@ -131,7 +135,8 @@ class CaptureWindow:
         _set_active(self)
         return self
 
-    def step(self, n: int = 1, dispatch_ms: float = 0.0, sync=None):
+    def step(self, n: int = 1, dispatch_ms: float = 0.0, sync=None,
+             workload: str | None = None):
         """Mark n train steps (one dispatch). Stops the trace the
         moment the requested step count is reached.
 
@@ -142,9 +147,18 @@ class CaptureWindow:
         close with its own steps still in flight and under-count busy
         time. Pass a host value fetch of the step's result (bench
         fetches the latest loss — steps chain through params, so that
-        one fetch completes them all). Never raises."""
+        one fetch completes them all). Never raises.
+
+        ``workload``: identity stamp ("train"/"serving") so consumers
+        that join against the last window (servescope's device_exec
+        upgrade) can tell whose dispatches it measured — a fresh
+        window is not enough when train and serve share a process.
+        Steppers with different stamps degrade the window to "mixed"."""
         if self.state != "active":
             return
+        if workload is not None:
+            self.workload = (workload if self.workload in (None, workload)
+                             else "mixed")
         self.steps_done += max(1, int(n))
         self.dispatch_ms += float(dispatch_ms or 0.0)
         if self.steps_done >= self.requested_steps:

@@ -1,0 +1,208 @@
+"""Per-request lifecycle spans for the serving path.
+
+Every sampled request gets a :class:`RequestSpan` — a handful of
+monotonic (``time.perf_counter``) marks stamped by the batcher as the
+request moves through
+
+    admitted -> queued -> coalesced(batch_id, bucket, pad_slot)
+             -> dispatched -> device_done -> unpadded -> responded
+
+plus the per-phase timings :meth:`FrozenModel.predict_batch` fills in
+(pad / exec / unpad). The span is pure data; :func:`components_of`
+turns it into the five-way latency attribution
+
+    e2e = queue_wait + coalesce_delay + pad_overhead + device_exec
+          + respond
+
+which sums to the request's measured end-to-end latency **exactly** (an
+accounting identity over the marks, not an estimate — pinned by a
+hand-computed test):
+
+* **queue_wait** — admitted until the dispatcher began assembling the
+  batch that took this request (the dispatcher was busy with an earlier
+  batch, or asleep). A p99 dominated by queue_wait means the dispatch
+  pipeline is saturated: raise ``max_batch`` / add replicas, don't
+  touch the kernel.
+* **coalesce_delay** — time inside the coalescing window (the
+  dispatcher deliberately holding the batch open for more requests,
+  bounded by ``max_delay_ms``) plus the host-side batch assembly.
+* **pad_overhead** — the price of bucketed AOT executables: the host
+  pad copy plus the share of device time spent computing junk rows,
+  ``exec * (bucket - real) / bucket`` (equivalently
+  ``padded_slots / real_slots x device_exec``).
+* **device_exec** — the real-work share of the executable's wall,
+  ``exec * real / bucket``.
+* **respond** — unpad slicing, per-request result assembly, and the
+  fulfil fan-out back to the waiting client.
+
+Sampling is deterministic and cheap: request sequence numbers modulo
+``sample_every`` (resolved from ``MXTPU_SERVESCOPE_SAMPLE``), so
+steady-state overhead stays inside healthmon's <5% budget — an
+unsampled request pays one counter increment and one modulo.
+
+Completed spans land on three surfaces at once (the healthmon alert
+discipline): the ``servescope.*`` counter family, a flight-recorder
+breadcrumb, and a ``serving.request`` record in ``mxtpu.events/1``
+carrying the run_id/batch_id correlation ids.
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import profiler as _prof
+from ..diagnostics import flight as _flight
+from ..healthmon import events as _events
+
+__all__ = ["RequestSpan", "COMPONENTS", "components_of", "begin",
+           "mark_gather", "mark_batch", "finish", "reject"]
+
+# the closed component taxonomy (docs/servescope.md); trace_check
+# validates every published attribution against exactly this set
+COMPONENTS = ("queue_wait_ms", "coalesce_delay_ms", "pad_overhead_ms",
+              "device_exec_ms", "respond_ms")
+
+# request sequence counter (sampling + request_id); one lock, touched
+# once per submit only while servescope is armed
+_seq_lock = threading.Lock()
+_seq = [0]
+
+
+class RequestSpan:
+    """One sampled request's lifecycle marks. All timestamps are
+    ``time.perf_counter`` seconds; ``timings`` is the pad/exec/unpad
+    millisecond split :meth:`FrozenModel.predict_batch` measured."""
+
+    __slots__ = ("request_id", "t_admit", "gather_start", "t_dispatched",
+                 "t_device_done", "t_respond", "bucket", "real",
+                 "batch_id", "batch_index", "timings", "status")
+
+    def __init__(self, request_id: int, t_admit: float):
+        self.request_id = request_id
+        self.t_admit = float(t_admit)
+        self.gather_start = None
+        self.t_dispatched = None
+        self.t_device_done = None
+        self.t_respond = None
+        self.bucket = None
+        self.real = None
+        self.batch_id = None
+        self.batch_index = None
+        self.timings = None
+        self.status = "admitted"
+
+
+def components_of(span: RequestSpan) -> dict:
+    """The five-way attribution for one responded span (milliseconds).
+
+    Exact accounting identity: the components sum to
+    ``(t_respond - t_admit) * 1e3`` by construction. The pad/exec/unpad
+    split inside the predict wall comes from the model's measured
+    timings; the (tiny) call-overhead residual the three don't cover is
+    folded into ``respond`` so the identity survives."""
+    admit = span.t_admit
+    gstart = span.gather_start if span.gather_start is not None else admit
+    t_disp = span.t_dispatched
+    t_done = span.t_device_done
+    t_resp = span.t_respond
+    e2e = (t_resp - admit) * 1e3
+    queue_wait = max(0.0, (gstart - admit) * 1e3)
+    coalesce = max(0.0, (t_disp - max(admit, gstart)) * 1e3)
+    predict_wall = max(0.0, (t_done - t_disp) * 1e3)
+    t = span.timings or {}
+    exec_ms = float(t.get("exec_ms", predict_wall))
+    pad_ms = float(t.get("pad_ms", 0.0))
+    unpad_ms = float(t.get("unpad_ms", 0.0))
+    # predict_wall >= pad + exec + unpad (the wall contains the calls);
+    # clamp a torn timings dict rather than going negative
+    residual = max(0.0, predict_wall - pad_ms - exec_ms - unpad_ms)
+    bucket = max(1, int(span.bucket or 1))
+    real = min(bucket, max(1, int(span.real or bucket)))
+    device_exec = exec_ms * real / bucket
+    pad_overhead = pad_ms + exec_ms * (bucket - real) / bucket
+    respond = max(0.0, (t_resp - t_done) * 1e3) + unpad_ms + residual
+    return {
+        "e2e_ms": e2e,
+        "queue_wait_ms": queue_wait,
+        "coalesce_delay_ms": coalesce,
+        "pad_overhead_ms": pad_overhead,
+        "device_exec_ms": device_exec,
+        "respond_ms": respond,
+    }
+
+
+# ---------------------------------------------------------------------------
+# batcher-facing lifecycle hooks (callers guard with `_ss._SS is not None`)
+# ---------------------------------------------------------------------------
+
+def begin(t_admit: float, sample_every: int):
+    """Sampling decision at submit: every ``sample_every``-th request
+    gets a span (deterministic, no RNG on the hot path); the rest cost
+    one counter increment. Returns the span or None."""
+    with _seq_lock:
+        _seq[0] += 1
+        rid = _seq[0]
+    if sample_every > 1 and rid % sample_every:
+        _prof.counter("servescope.sampled_out", "servescope").increment()
+        return None
+    return RequestSpan(rid, t_admit)
+
+
+def mark_gather(span, gather_start: float):
+    span.gather_start = float(gather_start)
+    span.status = "coalesced"
+
+
+def mark_batch(span, batch_id: int, bucket: int, real: int,
+               t_dispatched: float, t_device_done: float,
+               timings: dict | None):
+    span.batch_id = int(batch_id)
+    span.bucket = int(bucket)
+    span.real = int(real)
+    span.t_dispatched = float(t_dispatched)
+    span.t_device_done = float(t_device_done)
+    span.timings = timings
+    span.status = "device_done"
+
+
+def finish(span, t_respond: float, batch_index=None) -> dict:
+    """Settle a responded span: compute the attribution, feed the
+    budget/counters, and emit the correlation record. Returns the
+    component dict (the batcher hands it to nothing else)."""
+    span.t_respond = float(t_respond)
+    span.batch_index = batch_index
+    span.status = "responded"
+    comp = components_of(span)
+    _prof.counter("servescope.requests_traced", "servescope").increment()
+    for key in COMPONENTS:
+        _prof.observe("servescope." + key, comp[key], "servescope")
+    _prof.observe("servescope.e2e_ms", comp["e2e_ms"], "servescope")
+    _emit(span, comp)
+    return comp
+
+
+def reject(span, reason: str, t_now: float):
+    """Settle a rejected span (deadline pre/post batch, drain, batch
+    error): counted + emitted with the phase it reached, never fed to
+    the latency budget (a rejection has no response latency)."""
+    span.t_respond = float(t_now)
+    span.status = reason
+    _prof.counter("servescope.rejections_traced", "servescope").increment()
+    _emit(span, None)
+
+
+def _emit(span, comp):
+    """The correlation record: flight breadcrumb + mxtpu.events/1
+    ``serving.request`` (run_id comes from the event log itself;
+    batch_id joins against the per-dispatch ``serving.batch`` record)."""
+    args = {"request_id": span.request_id, "status": span.status,
+            "bucket": span.bucket, "batch_id": span.batch_id}
+    if comp is not None:
+        args["e2e_ms"] = round(comp["e2e_ms"], 3)
+        for key in COMPONENTS:
+            args[key] = round(comp[key], 3)
+    elif span.t_respond is not None:
+        args["age_ms"] = round((span.t_respond - span.t_admit) * 1e3, 3)
+    if _flight._REC is not None:
+        _flight.record("serving", "serving.request", args)
+    if _events._LOG is not None:
+        _events.emit("serving", "serving.request", args=args)

@@ -41,6 +41,7 @@ import time
 import numpy as np
 
 from .. import profiler as _prof
+from .. import servescope as _ss
 from ..diagnostics import flight as _flight
 from ..healthmon import events as _events
 from .errors import (DeadlineExceededError, QueueFullError,
@@ -58,7 +59,8 @@ class Request:
     error) and sets the event; the submitting thread blocks in `wait`."""
 
     __slots__ = ("x", "enqueued_at", "deadline", "batch_size",
-                 "batch_id", "batch_index", "_event", "_result", "_error")
+                 "batch_id", "batch_index", "span",
+                 "_event", "_result", "_error")
 
     def __init__(self, x, timeout_ms):
         self.x = x
@@ -68,6 +70,7 @@ class Request:
         self.batch_size = None          # size of the batch that served us
         self.batch_id = None            # dispatch sequence number
         self.batch_index = None         # our row within that batch
+        self.span = None                # servescope lifecycle span (sampled)
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -171,13 +174,23 @@ class DynamicBatcher:
         req = Request(np.ascontiguousarray(x),
                       self.default_timeout_ms if timeout_ms is None
                       else timeout_ms)
+        ss = _ss._SS    # snapshot: disable() must not race the two reads
+        if ss is not None:
+            # sampled lifecycle span: admitted at the enqueue timestamp
+            req.span = _ss.spans.begin(req.enqueued_at, ss.sample_every)
         with self._cond:
             if self._closed:
                 _c("serving.rejected_closed").increment()
+                if req.span is not None:
+                    _ss.spans.reject(req.span, "rejected_closed",
+                                     time.perf_counter())
                 raise ServerClosedError("server is draining; not "
                                         "accepting new requests")
             if len(self._q) >= self.queue_limit:
                 _c("serving.rejected_queue_full").increment()
+                if req.span is not None:
+                    _ss.spans.reject(req.span, "rejected_queue_full",
+                                     time.perf_counter())
                 raise QueueFullError(
                     f"request queue at capacity ({self.queue_limit})")
             self._q.append(req)
@@ -202,6 +215,11 @@ class DynamicBatcher:
                 if self._stopped:
                     return []
                 self._cond.wait(0.05)
+            # servescope boundary between queue_wait and coalesce_delay:
+            # from here on the dispatcher is assembling THIS batch —
+            # any further waiting is the deliberate coalescing window,
+            # not dispatcher backlog
+            gather_start = time.perf_counter()
             first = self._q[0]
             dispatch_at = first.enqueued_at + self.max_delay_s
             while len(self._q) < self.max_batch:
@@ -213,6 +231,10 @@ class DynamicBatcher:
             while self._q and len(batch) < self.max_batch:
                 batch.append(self._q.popleft())
             _prof.set_gauge("serving.queue_depth", len(self._q), "serving")
+            if _ss._SS is not None:
+                for req in batch:
+                    if req.span is not None:
+                        _ss.spans.mark_gather(req.span, gather_start)
             return batch
 
     def _run(self):
@@ -230,6 +252,8 @@ class DynamicBatcher:
         live = []
         for req in batch:
             if req.deadline is not None and now > req.deadline:
+                if req.span is not None:
+                    _ss.spans.reject(req.span, "rejected_deadline", now)
                 req._fulfil(error=DeadlineExceededError(
                     f"deadline exceeded after "
                     f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
@@ -239,43 +263,104 @@ class DynamicBatcher:
         if not live:
             return
         self.last_batch_ts = time.time()
+        bid = self._dispatch_seq
+        self._dispatch_seq = bid + 1
+        n = len(live)
+        ss = _ss._SS    # snapshot: disable() mid-batch must not race
+        spanned = (ss is not None
+                   and any(r.span is not None for r in live))
         try:
+            bucket = self.model.bucket_for(n)
             x = np.stack([r.x for r in live])
+            timings = {} if spanned else None
             t0 = time.perf_counter()
-            outs = self.model.predict_batch(x)
-            exec_ms = (time.perf_counter() - t0) * 1e3
+            outs = self.model.predict_batch(x, timings=timings)
+            t_done = time.perf_counter()
+            exec_ms = (t_done - t0) * 1e3
         except Exception as e:  # noqa: BLE001 — a bad batch must not kill
-            for req in live:    # the dispatcher; reject and keep serving
+            if spanned:         # the dispatcher; reject and keep serving
+                terr = time.perf_counter()
+                for req in live:
+                    if req.span is not None:
+                        _ss.spans.reject(req.span, "batch_error", terr)
+            for req in live:
                 req._fulfil(error=e if isinstance(e, Exception) else
                             RuntimeError(str(e)))
             _c("serving.batch_errors").increment()
             return
-        n = len(live)
+        if spanned:
+            for req in live:
+                if req.span is not None:
+                    _ss.spans.mark_batch(req.span, bid, bucket, n,
+                                         t0, t_done, timings)
+        # a devicescope capture window over serving dispatches: one mark
+        # per executed batch (predict_batch converts outputs to host
+        # arrays, so the dispatch is already synced — no barrier needed)
+        try:
+            from .. import devicescope as _ds
+            if _ds._DS is not None:
+                win = _ds.active_window()
+                if win is not None:
+                    win.step(1, dispatch_ms=exec_ms, workload="serving")
+        except Exception:  # noqa: BLE001 — measurement never breaks serving
+            pass
         _c("serving.batches").increment()
         _c("serving.batched_requests").increment(n)
         _prof.observe("serving.batch_exec_ms", exec_ms, "serving")
         _prof.observe("serving.batch_size", float(n), "serving")
         if _flight._REC is not None:
             _flight.record("serving", "serving.batch",
-                           {"n": n, "bucket": self.model.bucket_for(n),
+                           {"n": n, "bucket": bucket, "batch_id": bid,
                             "exec_ms": round(exec_ms, 3)})
         if _events._LOG is not None:
             _events.emit("serving", "serving.batch",
-                         args={"n": n,
-                               "bucket": self.model.bucket_for(n),
+                         args={"n": n, "bucket": bucket, "batch_id": bid,
                                "exec_ms": round(exec_ms, 3)})
         self.last_response_ts = time.time()
         done = time.perf_counter()
-        bid = self._dispatch_seq
-        self._dispatch_seq = bid + 1
+        # a deadline that expired DURING batch execution is a rejection,
+        # not a success: the deadline is the client's stated SLA, and a
+        # result produced after it is past-deadline work — fulfilling it
+        # as a 200 would hide exactly the tail the deadline exists to
+        # bound (waiters do linger past the deadline, so they receive a
+        # crisp DeadlineExceededError, not a silently late success).
+        # Counted under its own name — these were lost entirely before
+        # (neither a response nor any rejection counter).
+        responded, late = [], []
         for i, req in enumerate(live):
             req.batch_size = n
             req.batch_id = bid
             req.batch_index = i
-            req._fulfil(result=[o[i] for o in outs])
+            if req.deadline is not None and done > req.deadline:
+                late.append(req)
+            else:
+                responded.append((i, req))
+        # telemetry BEFORE fulfil: a /stats (or bench snapshot) taken the
+        # instant a client's predict() returns must already contain that
+        # request — observing after _fulfil let percentiles/responses mix
+        # epochs mid-read (the waiting client races the counter updates)
+        for _, req in responded:
             _prof.observe("serving.latency_ms",
                           (done - req.enqueued_at) * 1e3, "serving")
-            _c("serving.responses").increment()
+        if responded:
+            _c("serving.responses").increment(len(responded))
+        if late:
+            _c("serving.rejected_deadline_post_batch").increment(len(late))
+        if spanned:
+            for i, req in responded:
+                if req.span is not None:
+                    comp = _ss.spans.finish(req.span, done, batch_index=i)
+                    ss.budget.observe(req.span, comp)
+            for req in late:
+                if req.span is not None:
+                    _ss.spans.reject(req.span,
+                                     "rejected_deadline_post_batch", done)
+        for _, req in responded:
+            req._fulfil(result=[o[req.batch_index] for o in outs])
+        for req in late:
+            req._fulfil(error=DeadlineExceededError(
+                f"deadline exceeded during batch execution "
+                f"({exec_ms:.1f} ms in bucket {bucket})"))
 
     # -- stats ------------------------------------------------------------
     @staticmethod

@@ -28,6 +28,7 @@ artifact (`prefix-symbol.json` + `prefix-0000.params`, via SymbolBlock).
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -177,7 +178,7 @@ class FrozenModel:
             # executable rides along so commscope's collective
             # extraction reads the optimized HLO without compiling again
             _ps.analyze_lowered(
-                lowered, name=f"serving:{self._block.name}:b{b}",
+                lowered, name=self.program_name(b),
                 dtype=self._dtype, kind="serving_bucket",
                 extra={"bucket": b}, compiled=self._exec[b])
         _prof.counter("serving.compiles", "serving").increment()
@@ -200,6 +201,56 @@ class FrozenModel:
     @property
     def max_batch(self) -> int:
         return self.buckets[-1]
+
+    def program_name(self, b: int) -> str:
+        """The perfscope/commscope program-table name of one bucket's
+        AOT executable — the ONE join key servescope, /healthz and
+        /stats use to attach roofline + resharding verdicts."""
+        return f"serving:{self._block.name}:b{b}"
+
+    def comm_verdicts(self) -> dict:
+        """Per-bucket commscope resharding verdict for the compiled
+        executables: ``{bucket: {resharding_collectives, hlo_available,
+        collective_count, collective_bytes}}``. An accidental
+        all-gather on the serve path is a per-request p99 catastrophe
+        (docs/commscope.md), so the deep /healthz and /stats surface
+        this verdict. Empty when commscope never captured the buckets
+        (unarmed, or compiled before arming). Never raises."""
+        out = {}
+        try:
+            from .. import commscope as _cs
+            progs = {p.get("name"): p for p in _cs.programs()}
+        except Exception:  # noqa: BLE001
+            return out
+        for b in self.buckets:
+            rec = progs.get(self.program_name(b))
+            if not isinstance(rec, dict):
+                continue
+            totals = rec.get("totals") or {}
+            out[str(b)] = {
+                "resharding_collectives":
+                    rec.get("resharding_collectives", 0),
+                "hlo_available": rec.get("hlo_available", True),
+                "collective_count": totals.get("count"),
+                "collective_bytes": totals.get("bytes"),
+            }
+        return out
+
+    def roofline_verdicts(self) -> dict:
+        """Per-bucket perfscope roofline verdict for the compiled
+        executables (``{bucket: verdict}``); empty when perfscope never
+        captured them. Never raises."""
+        out = {}
+        try:
+            from .. import perfscope as _ps_mod
+            progs = {p.get("name"): p for p in _ps_mod.programs()}
+        except Exception:  # noqa: BLE001
+            return out
+        for b in self.buckets:
+            rec = progs.get(self.program_name(b))
+            if isinstance(rec, dict):
+                out[str(b)] = rec.get("verdict")
+        return out
 
     def bucket_for(self, n: int) -> int:
         """Smallest compiled bucket that fits n samples."""
@@ -230,18 +281,42 @@ class FrozenModel:
                 f"no compiled bucket for batch {n}; buckets={self.buckets}")
         return ex(self._key, self._param_raws, jax.numpy.asarray(x))
 
-    def predict_batch(self, x: np.ndarray) -> list:
+    def predict_batch(self, x: np.ndarray, timings: dict | None = None) \
+            -> list:
         """Serve a host batch of n <= max_batch samples: pad up to the
         bucket, execute, slice back to n. Returns the per-output list of
         np arrays (length n each). Rows are independent in inference
-        graphs, so padding rows never changes real rows' values."""
+        graphs, so padding rows never changes real rows' values.
+
+        ``timings``: when a dict is passed (servescope's sampled path)
+        it is filled with the per-phase wall split ``{"pad_ms",
+        "exec_ms", "unpad_ms"}`` — pad copy, executable wall (transfer
+        + device, closed by an explicit ``block_until_ready`` so the
+        boundary is real on async backends), and the unpad slice/host
+        conversion. With ``timings=None`` the path is unchanged (the
+        conversion itself is the sync)."""
         n = int(x.shape[0])
         b = self.bucket_for(n)
+        if timings is None:
+            if b != n:
+                pad = np.zeros((b - n,) + self._input_shape, self._dtype)
+                x = np.concatenate([np.ascontiguousarray(x), pad], axis=0)
+            outs = self.run_raw(x)
+            return [np.asarray(o)[:n] for o in outs]
+        t0 = time.perf_counter()
         if b != n:
             pad = np.zeros((b - n,) + self._input_shape, self._dtype)
             x = np.concatenate([np.ascontiguousarray(x), pad], axis=0)
+        t1 = time.perf_counter()
         outs = self.run_raw(x)
-        return [np.asarray(o)[:n] for o in outs]
+        jax.block_until_ready(outs)
+        t2 = time.perf_counter()
+        res = [np.asarray(o)[:n] for o in outs]
+        t3 = time.perf_counter()
+        timings["pad_ms"] = (t1 - t0) * 1e3
+        timings["exec_ms"] = (t2 - t1) * 1e3
+        timings["unpad_ms"] = (t3 - t2) * 1e3
+        return res
 
     def __call__(self, x):
         """NDArray-level convenience matching `block(x)`: accepts an

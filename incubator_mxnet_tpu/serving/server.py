@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import healthmon as _healthmon
 from .. import profiler as _prof
+from .. import servescope as _ss
 from .batcher import DynamicBatcher
 from .errors import InvalidInputError, ServingError
 from .frozen import FrozenModel
@@ -163,8 +164,17 @@ class ModelServer:
             def log_message(self, *a):   # stay quiet on stderr
                 pass
 
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default accept backlog is 5 — under a
+            # concurrent-client burst the SYN queue overflows and
+            # clients pay kernel retransmit timeouts (a measured 1s/3s
+            # p99 quantization that has nothing to do with serving).
+            # Size it like the admission queue: beyond this the 429
+            # backpressure path is the bounded-latency answer.
+            request_queue_size = max(128, self.batcher.queue_limit)
+
         self.batcher.start()
-        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd = _Server((self.host, self.port), _Handler)
         self.port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever,
                              name="mxtpu-serving-http", daemon=True)
@@ -229,6 +239,24 @@ class ModelServer:
                 "healthmon/healthmon.stall_alerts", 0),
             "nan_alerts": snap.get("healthmon/healthmon.nan_alerts", 0),
         }
+        # commscope's last resharding verdict per compiled bucket: an
+        # accidental all-gather on the serve path is a per-request p99
+        # catastrophe (docs/commscope.md). Report-only, like healthmon —
+        # a layout verdict is for the operator, not a reason for the LB
+        # to drop an otherwise-serving replica — but flagged loudly.
+        verdicts = self.model.comm_verdicts()
+        if verdicts:
+            flagged = sorted(b for b, v in verdicts.items()
+                             if v.get("resharding_collectives"))
+            checks["resharding"] = {
+                "buckets": verdicts,
+                "buckets_flagged": flagged,
+            }
+        # servescope's current p99 attribution: WHAT the tail is, not
+        # just how tall (docs/servescope.md)
+        brief = _ss.attribution_brief()
+        if brief is not None:
+            checks["servescope_p99"] = brief
         problems = []
         if not b.running:
             problems.append("batcher_dead")
@@ -258,6 +286,13 @@ class ModelServer:
 
     # -- stats ------------------------------------------------------------
     def stats(self) -> dict:
+        """One consistent registry snapshot per call: every derived
+        number (percentiles, fill, qps) comes from the SINGLE
+        ``batcher.stats()`` read — a second read mid-traffic would mix
+        epochs (the histogram and the response counter advancing
+        between reads). Callers that also want the raw latency
+        histogram read it from this same dict
+        (``s["serving.latency_ms"]``), never from a fresh snapshot."""
         s = self.batcher.stats()
         uptime = (time.time() - self._started_at) if self._started_at \
             else 0.0
@@ -269,4 +304,10 @@ class ModelServer:
         s["max_batch"] = self.batcher.max_batch
         s["max_delay_ms"] = self.batcher.max_delay_s * 1e3
         s["queue_limit"] = self.batcher.queue_limit
+        verdicts = self.model.comm_verdicts()
+        if verdicts:
+            s["resharding"] = verdicts
+        brief = _ss.attribution_brief()
+        if brief is not None:
+            s["servescope"] = brief
         return s

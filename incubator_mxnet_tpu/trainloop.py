@@ -175,7 +175,8 @@ class TrainLoop:
         # dispatch share in the gap taxonomy
         win = _devicescope.active_window()
         if win is not None:
-            win.step(k, sync=lambda: float(losses[k - 1]))
+            win.step(k, sync=lambda: float(losses[k - 1]),
+                     workload="train")
         return losses
 
     def fit(self, data, steps=None, epochs=None, cycle=None):

@@ -651,6 +651,33 @@ class TestBudgetReconciliation:
         assert d["source"] == "residual"
         assert d["reconciliation"] is None
 
+    def test_serving_stamped_window_never_upgrades_a_train_budget(
+            self, tmp_path):
+        """A fresh window stepped by the SERVING batcher (train and
+        serve share a process) measured dispatches this train budget
+        never issued — workload identity, not just freshness, gates
+        the measured(profile) upgrade. A 'mixed' window is rejected
+        the same way; an unstamped (None) one stays accepted."""
+        ps.enable()
+        f, x = _run_jit_steps()
+        b = ps.StepBudget().begin()
+        with ds.capture(steps=1, logdir=str(tmp_path / "w")) as win:
+            float(f(x))
+            win.step(1, workload="serving")
+        assert ds.window_summary()["busy_fraction"] is not None
+        assert ds.last_window().workload == "serving"
+        b.end(steps=4, steady_s=0.4)
+        d = b.finish()
+        assert d["source"] == "residual"
+        assert d["reconciliation"] is None
+
+    def test_mixed_steppers_degrade_window_to_mixed(self, tmp_path):
+        with ds.capture(steps=5, logdir=str(tmp_path / "w")) as win:
+            win.step(1, workload="train")
+            win.step(1, workload="serving")
+            win.step(1)                    # unstamped mark: no change
+        assert win.workload == "mixed"
+
     def test_end_to_end_real_window(self, tmp_path):
         """A REAL capture window around real jit steps upgrades a real
         budget — the full measured path with no monkeypatching."""

@@ -1,0 +1,849 @@
+"""mxtpu.servescope — request-lifecycle tracing & tail-latency
+attribution for the serving path.
+
+Covers the acceptance surface of the seventh observability layer: span
+lifecycle through the batcher (including every rejection path and the
+drain), the hand-computed five-way attribution identity, batch_id
+correlation across the mxtpu.events/1 stream, quantile-cohort
+attribution summing to measured e2e latency, the sampling/off-path
+overhead contract, the /stats-/healthz satellites (single-snapshot
+consistency, resharding + attribution verdicts), serve_load's knee
+detection and env-failure artifact, and the trace_check / perf_regress
+/ mxdiag tooling integration.
+"""
+import importlib.util
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, servescope, serving
+from incubator_mxnet_tpu import profiler as prof
+from incubator_mxnet_tpu.servescope import spans as ss_spans
+from incubator_mxnet_tpu.servescope.budget import (LatencyBudget,
+                                                   quantile_cohorts)
+from incubator_mxnet_tpu.serving import (DeadlineExceededError,
+                                         DynamicBatcher, FrozenModel,
+                                         ServerClosedError)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name, f"tools/{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mlp(in_units=6, out=3, seed=0):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=in_units, activation="relu"),
+            gluon.nn.Dense(out, in_units=16))
+    net.initialize(init=mx.init.Xavier())
+    rng = np.random.RandomState(seed)
+    for p in net.collect_params().values():
+        p.set_data(nd.array(rng.randn(*p.shape).astype(np.float32) * 0.1))
+    return net
+
+
+@pytest.fixture
+def frozen():
+    return FrozenModel(_mlp(), input_shape=(6,), batch_buckets=(1, 2, 4, 8))
+
+
+@pytest.fixture
+def armed():
+    """Servescope armed with a fresh budget; disarmed after."""
+    servescope.enable()
+    yield servescope._SS
+    servescope.disable()
+
+
+def _drive(batcher, n=12, timeout_ms=None):
+    results = [None] * n
+    xs = np.random.RandomState(4).randn(n, 6).astype(np.float32)
+
+    def client(i):
+        results[i] = batcher.predict(xs[i], timeout_ms=timeout_ms)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# span lifecycle
+# ---------------------------------------------------------------------------
+
+def test_span_lifecycle_through_batcher(frozen, armed):
+    b = DynamicBatcher(frozen, max_delay_ms=20, queue_limit=64).start()
+    _drive(b, 12)
+    b.stop()
+    att = servescope.attribution()
+    assert att["requests"] == 12
+    overall = att["overall"]
+    assert overall["count"] == 12
+    # every component distribution exists and the taxonomy is closed
+    assert set(overall["component_dist"]) == set(ss_spans.COMPONENTS)
+    snap = prof.counters()
+    assert snap["servescope/servescope.requests_traced"] == 12
+    assert snap["servescope/servescope.e2e_ms"]["count"] == 12
+
+
+def test_span_rejection_deadline_path(frozen, armed):
+    b = DynamicBatcher(frozen, max_delay_ms=1, queue_limit=8)
+    # batcher not started: the request ages past its deadline in queue
+    req = b.submit(np.zeros(6, np.float32), timeout_ms=20)
+    assert req.span is not None
+    time.sleep(0.08)
+    b.start()
+    with pytest.raises(DeadlineExceededError):
+        req.wait(5.0)
+    b.stop()
+    assert req.span.status == "rejected_deadline"
+    snap = prof.counters()
+    assert snap["servescope/servescope.rejections_traced"] >= 1
+    # rejections never feed the latency budget
+    assert servescope.attribution()["requests"] == 0
+
+
+def test_span_drain_rejection_path(frozen, armed):
+    b = DynamicBatcher(frozen, queue_limit=8)
+    reqs = [b.submit(np.zeros(6, np.float32)) for _ in range(3)]
+    b.stop(drain=False)
+    for r in reqs:
+        with pytest.raises(ServerClosedError):
+            r.wait(1.0)
+    # drain=False rejections are fulfilled without touching the span
+    # machinery's responded path
+    assert servescope.attribution()["requests"] == 0
+
+
+def test_post_batch_deadline_rejected_and_counted(frozen, armed,
+                                                  monkeypatch):
+    """A deadline that expires DURING batch execution is a rejection
+    under its own counter — previously these were lost entirely."""
+    prof.reset_counters()
+    orig = frozen.predict_batch
+
+    def slow_predict(x, timings=None):
+        out = orig(x, timings=timings)
+        time.sleep(0.08)            # the batch outlives the deadline
+        return out
+
+    monkeypatch.setattr(frozen, "predict_batch", slow_predict)
+    b = DynamicBatcher(frozen, max_delay_ms=1, queue_limit=8).start()
+    req = b.submit(np.zeros(6, np.float32), timeout_ms=50)
+    with pytest.raises(DeadlineExceededError) as ei:
+        req.wait(5.0)
+    b.stop()
+    assert "during batch execution" in str(ei.value)
+    snap = prof.counters()
+    assert snap.get(
+        "serving/serving.rejected_deadline_post_batch", 0) == 1
+    # distinct from the pre-batch counter, and NOT a response
+    assert snap.get("serving/serving.rejected_deadline", 0) == 0
+    assert snap.get("serving/serving.responses", 0) == 0
+    assert req.span.status == "rejected_deadline_post_batch"
+
+
+def test_batch_error_rejects_spans(frozen, armed, monkeypatch):
+    def boom(x, timings=None):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(frozen, "predict_batch", boom)
+    b = DynamicBatcher(frozen, max_delay_ms=1, queue_limit=8).start()
+    req = b.submit(np.zeros(6, np.float32), timeout_ms=0)
+    with pytest.raises(RuntimeError):
+        req.wait(5.0)
+    b.stop()
+    assert req.span.status == "batch_error"
+
+
+# ---------------------------------------------------------------------------
+# attribution math (hand-computed)
+# ---------------------------------------------------------------------------
+
+def test_components_hand_computed():
+    """The five-way split on a synthetic span with known marks:
+    admitted t=0, gather at 10 ms, dispatched at 15 ms, predict wall
+    20 ms (pad 2 + exec 16 + unpad 1 + residual 1), responded 1 ms
+    after device_done; bucket 8, 6 real rows."""
+    span = ss_spans.RequestSpan(1, 0.0)
+    span.gather_start = 0.010
+    span.t_dispatched = 0.015
+    span.t_device_done = 0.035
+    span.t_respond = 0.036
+    span.bucket, span.real = 8, 6
+    span.timings = {"pad_ms": 2.0, "exec_ms": 16.0, "unpad_ms": 1.0}
+    c = ss_spans.components_of(span)
+    assert c["queue_wait_ms"] == pytest.approx(10.0)
+    assert c["coalesce_delay_ms"] == pytest.approx(5.0)
+    # device_exec = exec * real/bucket = 16 * 6/8
+    assert c["device_exec_ms"] == pytest.approx(12.0)
+    # pad_overhead = pad copy + exec * padded/bucket = 2 + 16 * 2/8
+    assert c["pad_overhead_ms"] == pytest.approx(6.0)
+    # respond = fulfil delta + unpad + unattributed predict residual
+    assert c["respond_ms"] == pytest.approx(3.0)
+    assert c["e2e_ms"] == pytest.approx(36.0)
+    # the accounting identity, exactly
+    assert sum(c[k] for k in ss_spans.COMPONENTS) == \
+        pytest.approx(c["e2e_ms"])
+
+
+def test_components_arrived_mid_coalesce():
+    """A request admitted AFTER the gather started has zero queue_wait;
+    its whole pre-dispatch time is coalesce delay."""
+    span = ss_spans.RequestSpan(2, 0.020)
+    span.gather_start = 0.010          # batch window opened earlier
+    span.t_dispatched = 0.030
+    span.t_device_done = 0.040
+    span.t_respond = 0.040
+    span.bucket = span.real = 4
+    c = ss_spans.components_of(span)
+    assert c["queue_wait_ms"] == 0.0
+    assert c["coalesce_delay_ms"] == pytest.approx(10.0)
+    assert c["pad_overhead_ms"] == 0.0
+    assert sum(c[k] for k in ss_spans.COMPONENTS) == \
+        pytest.approx(c["e2e_ms"])
+
+
+def test_attribution_sums_to_measured_e2e(frozen, armed):
+    """Real traffic: every quantile cohort's component sum equals its
+    cohort mean e2e exactly, and sits within the 10% neighborhood of
+    the quantile by construction."""
+    b = DynamicBatcher(frozen, max_delay_ms=10, queue_limit=128).start()
+    _drive(b, 24)
+    b.stop()
+    att = servescope.attribution()
+    for grp in [att["overall"]] + list(att["per_bucket"].values()):
+        for q, a in grp["attribution"].items():
+            comp_sum = sum(a["components"].values())
+            assert comp_sum == pytest.approx(a["sum_ms"], abs=0.01)
+            assert a["sum_ms"] >= a["e2e_ms"] - 0.01
+            assert a["sum_ms"] <= a["e2e_ms"] * 1.11, \
+                f"{q}: cohort mean outside the neighborhood cap"
+
+
+def test_quantile_cohort_outlier_cannot_smear_p99():
+    """A lone 20x outlier above p99 must not inflate the p99
+    attribution (the value-capped cohort excludes it)."""
+    entries = []
+    for i in range(199):
+        entries.append({"e2e_ms": 10.0 + i * 0.01,
+                        "queue_wait_ms": 5.0 + i * 0.01,
+                        "coalesce_delay_ms": 2.0, "pad_overhead_ms": 1.0,
+                        "device_exec_ms": 1.5, "respond_ms": 0.5})
+    entries.append({"e2e_ms": 250.0, "queue_wait_ms": 245.0,
+                    "coalesce_delay_ms": 2.0, "pad_overhead_ms": 1.0,
+                    "device_exec_ms": 1.5, "respond_ms": 0.5})
+    att = quantile_cohorts(entries)
+    p99 = att["p99"]
+    assert p99["e2e_ms"] < 12.1          # the nearest-rank p99, not 250
+    assert p99["sum_ms"] <= p99["e2e_ms"] * 1.11
+    assert p99["top_component"] == "queue_wait_ms"
+
+
+def test_quantile_cohorts_single_entry():
+    e = {"e2e_ms": 7.0, "queue_wait_ms": 1.0, "coalesce_delay_ms": 2.0,
+         "pad_overhead_ms": 0.5, "device_exec_ms": 3.0, "respond_ms": 0.5}
+    att = quantile_cohorts([e])
+    for q in ("p50", "p95", "p99"):
+        assert att[q]["e2e_ms"] == 7.0
+        assert att[q]["sum_ms"] == pytest.approx(7.0)
+        assert att[q]["cohort"] == 1
+
+
+# ---------------------------------------------------------------------------
+# correlation (mxtpu.events/1) + flight
+# ---------------------------------------------------------------------------
+
+def test_batch_id_correlation_across_events(frozen, armed, tmp_path):
+    from incubator_mxnet_tpu.healthmon import events as hm_events
+    hm_events.open_log(str(tmp_path / "ev.jsonl"), run_id="t-ss", rank=0)
+    b = DynamicBatcher(frozen, max_delay_ms=20, queue_limit=64).start()
+    _drive(b, 8)
+    b.stop()
+    hm_events.close_log()
+    recs = [json.loads(ln) for ln in open(tmp_path / "ev.jsonl")
+            if ln.strip()]
+    req_recs = [r for r in recs if r["name"] == "serving.request"]
+    batch_ids = {(r.get("args") or {}).get("batch_id")
+                 for r in recs if r["name"] == "serving.batch"}
+    assert len(req_recs) == 8
+    for r in req_recs:
+        args = r["args"]
+        assert args["status"] == "responded"
+        assert args["batch_id"] in batch_ids
+        assert args["bucket"] in (1, 2, 4, 8)
+        # components travel with the event
+        for key in ss_spans.COMPONENTS:
+            assert isinstance(args[key], (int, float))
+        assert r["run_id"] == "t-ss"
+
+
+def test_spans_land_in_flight_ring(frozen, armed):
+    from incubator_mxnet_tpu import diagnostics as diag
+    from incubator_mxnet_tpu.diagnostics import flight as _flight
+    diag.enable_flight_recorder(dump_on_crash=False, record_ops=False)
+    try:
+        b = DynamicBatcher(frozen, max_delay_ms=5).start()
+        b.predict(np.zeros(6, np.float32))
+        b.stop()
+        path = _flight.dump(reason="test")
+        doc = json.load(open(path))
+        assert any(e["name"] == "serving.request" for e in doc["events"])
+    finally:
+        diag.disable_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# sampling / off-path contract
+# ---------------------------------------------------------------------------
+
+def test_sampling_stride_resolution(monkeypatch):
+    assert servescope._resolve_sample(None) == 1
+    assert servescope._resolve_sample(0.1) == 10
+    assert servescope._resolve_sample(0.25) == 4
+    assert servescope._resolve_sample(8) == 8
+    assert servescope._resolve_sample("garbage") == 1
+    assert servescope._resolve_sample(0) == 1
+    monkeypatch.setenv("MXTPU_SERVESCOPE_SAMPLE", "0.5")
+    assert servescope._resolve_sample(None) == 2
+
+
+def test_sampled_mode_traces_subset_counts_rest(frozen):
+    prof.reset_counters()
+    servescope.enable(sample=3)
+    try:
+        b = DynamicBatcher(frozen, max_delay_ms=5, queue_limit=64).start()
+        _drive(b, 9)
+        b.stop()
+        snap = prof.counters()
+        traced = snap.get("servescope/servescope.requests_traced", 0)
+        skipped = snap.get("servescope/servescope.sampled_out", 0)
+        assert traced == 3            # every 3rd of 9
+        assert skipped == 6
+        assert snap["servescope/servescope.sample_every"] == 3
+        # serving-side accounting still sees every request
+        assert snap["serving/serving.responses"] == 9
+    finally:
+        servescope.disable()
+
+
+def test_off_path_pays_one_predicate(frozen):
+    """With servescope off, requests carry no span and no servescope
+    metric is ever touched — the disabled path is byte-identical to
+    the pre-servescope batcher."""
+    servescope.disable()
+    prof.reset_counters()
+    b = DynamicBatcher(frozen, max_delay_ms=5).start()
+    req = b.submit(np.zeros(6, np.float32))
+    req.wait(5.0)
+    b.stop()
+    assert req.span is None
+    assert not any(k.startswith("servescope/")
+                   for k in prof.counters())
+
+
+def test_enable_from_env(monkeypatch):
+    servescope.disable()
+    monkeypatch.setenv("MXTPU_SERVESCOPE", "1")
+    monkeypatch.setenv("MXTPU_SERVESCOPE_SAMPLE", "4")
+    servescope.enable_from_env()
+    try:
+        assert servescope.enabled()
+        assert servescope._SS.sample_every == 4
+    finally:
+        servescope.disable()
+
+
+# ---------------------------------------------------------------------------
+# /stats + /healthz satellites
+# ---------------------------------------------------------------------------
+
+def test_stats_consistent_the_instant_predict_returns(frozen):
+    """The epoch-mixing bugfix: telemetry lands BEFORE the client is
+    fulfilled, so a /stats read the moment predict() returns already
+    contains that request on every surface."""
+    servescope.disable()
+    prof.reset_counters()
+    b = DynamicBatcher(frozen, max_delay_ms=1).start()
+    for k in range(1, 6):
+        b.predict(np.zeros(6, np.float32))
+        s = b.stats()
+        assert s["serving.responses"] == k
+        assert s["serving.latency_ms"]["count"] == k
+        assert s["p50_ms"] is not None
+    b.stop()
+
+
+def test_healthz_and_stats_carry_verdicts(frozen):
+    import urllib.request
+    from incubator_mxnet_tpu import commscope, perfscope
+    prof.reset_counters()
+    perfscope.enable()
+    commscope.enable()
+    servescope.enable()
+    try:
+        # recompile under armed scopes so the bucket programs register
+        fm = FrozenModel(_mlp(), input_shape=(6,),
+                         batch_buckets=(1, 2, 4))
+        srv = serving.ModelServer(fm, max_delay_ms=2)
+        host, port = srv.start()
+        base = f"http://{host}:{port}"
+        for _ in range(3):
+            body = json.dumps({"data": [0.0] * 6}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/predict", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=30).read()
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            doc = json.loads(r.read())
+        checks = doc["checks"]
+        assert set(checks["resharding"]["buckets"]) == {"1", "2", "4"}
+        for v in checks["resharding"]["buckets"].values():
+            assert v["resharding_collectives"] == 0
+        assert checks["resharding"]["buckets_flagged"] == []
+        assert checks["servescope_p99"]["top_component"] in \
+            ss_spans.COMPONENTS
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert "resharding" in stats and "servescope" in stats
+        assert stats["servescope"]["requests_traced"] == 3
+        srv.stop()
+    finally:
+        servescope.disable()
+        commscope.disable()
+        perfscope.disable()
+
+
+def test_attribution_joins_bucket_verdicts(frozen):
+    from incubator_mxnet_tpu import commscope, perfscope
+    perfscope.enable()
+    commscope.enable()
+    servescope.enable()
+    try:
+        fm = FrozenModel(_mlp(), input_shape=(6,), batch_buckets=(1, 4))
+        b = DynamicBatcher(fm, max_delay_ms=10, queue_limit=64).start()
+        _drive(b, 8)
+        b.stop()
+        att = servescope.attribution()
+        for grp in att["per_bucket"].values():
+            assert grp["verdict"] in ("compute_bound", "hbm_bound",
+                                      "trivial", "unknown")
+            assert grp["resharding_collectives"] == 0
+            assert grp["hlo_available"] is True
+        assert att["device_exec_source"] == "host_wall"
+        assert att["advice"]
+    finally:
+        servescope.disable()
+        commscope.disable()
+        perfscope.disable()
+
+
+# ---------------------------------------------------------------------------
+# devicescope upgrade (stale-window / drift rules)
+# ---------------------------------------------------------------------------
+
+class _FakeWindow:
+    def __init__(self, completed_at, busy_ms=9.0, dispatches=5,
+                 dispatch_ms=50.0, workload="serving"):
+        self.completed_at = completed_at
+        self.logdir = "/tmp/fake_win"
+        self.dispatch_ms = dispatch_ms      # accumulated host exec wall
+        self.steps_done = dispatches
+        self.workload = workload            # who stepped it
+        self._busy = busy_ms
+
+    def summary(self):
+        return {"per_step": {"device_busy_ms": self._busy}}
+
+
+def test_device_window_upgrades_provenance(frozen, armed, monkeypatch):
+    """A devicescope window completed AFTER the budget began upgrades
+    device_exec to measured(profile); one completed BEFORE it (someone
+    else's traffic) is rejected — PR 10's stale-window rule."""
+    from incubator_mxnet_tpu import devicescope as ds
+    b = DynamicBatcher(frozen, max_delay_ms=5).start()
+    b.predict(np.zeros(6, np.float32))
+    b.stop()
+    ds.enable()
+    try:
+        # stale: completed before this budget's begin marker
+        monkeypatch.setattr(ds, "last_window", lambda: _FakeWindow(0.0))
+        att = servescope.attribution()
+        assert att["device_exec_source"] == "host_wall"
+        assert att["device_window"] is None
+        # fresh but stepped by the TRAIN loop (train and serve share a
+        # process): wrong workload identity, rejected despite freshness
+        monkeypatch.setattr(
+            ds, "last_window",
+            lambda: _FakeWindow(time.monotonic(), workload="train"))
+        att = servescope.attribution()
+        assert att["device_exec_source"] == "host_wall"
+        assert att["device_window"] is None
+        # fresh: measured busy 9 ms vs host wall 50/5 = 10 ms per
+        # dispatch -> 10% drift, under the 25% threshold
+        monkeypatch.setattr(ds, "last_window",
+                            lambda: _FakeWindow(time.monotonic()))
+        att = servescope.attribution()
+        assert att["device_exec_source"] == "measured(profile)"
+        w = att["device_window"]
+        assert w["measured_busy_ms_per_dispatch"] == 9.0
+        assert w["host_wall_ms_per_dispatch"] == pytest.approx(10.0)
+        assert w["drift"] == pytest.approx(0.1)
+        assert w["drift_warning"] is False
+    finally:
+        ds.disable()
+
+
+def test_device_window_drift_warns_loudly(frozen, armed, monkeypatch):
+    import warnings as _warnings
+    from incubator_mxnet_tpu import devicescope as ds
+    prof.reset_counters()
+    b = DynamicBatcher(frozen, max_delay_ms=5).start()
+    b.predict(np.zeros(6, np.float32))
+    b.stop()
+    ds.enable()
+    try:
+        # measured 2 ms vs host 10 ms -> 80% drift, over the threshold
+        monkeypatch.setattr(
+            ds, "last_window",
+            lambda: _FakeWindow(time.monotonic(), busy_ms=2.0))
+        with _warnings.catch_warnings(record=True) as rec:
+            _warnings.simplefilter("always")
+            att = servescope.attribution()
+        assert att["device_window"]["drift_warning"] is True
+        assert any("disagree" in str(w.message) for w in rec)
+        snap = prof.counters()
+        assert snap.get(
+            "servescope/servescope.device_drift_warnings", 0) == 1
+        # warned once per budget, counted once
+        att = servescope.attribution()
+        assert prof.counters().get(
+            "servescope/servescope.device_drift_warnings", 0) == 1
+    finally:
+        ds.disable()
+
+
+def test_batcher_marks_active_devicescope_window(frozen, armed,
+                                                 monkeypatch):
+    from incubator_mxnet_tpu import devicescope as ds
+    ds.enable()
+    marks = []
+
+    class _Rec:
+        def step(self, n=1, dispatch_ms=0.0, sync=None, workload=None):
+            marks.append((n, dispatch_ms, workload))
+
+    try:
+        monkeypatch.setattr(ds, "active_window", lambda: _Rec())
+        b = DynamicBatcher(frozen, max_delay_ms=5).start()
+        b.predict(np.zeros(6, np.float32))
+        b.stop()
+        assert len(marks) == 1
+        assert marks[0][0] == 1 and marks[0][1] > 0   # one mark, exec wall
+        assert marks[0][2] == "serving"               # identity stamp
+    finally:
+        ds.disable()
+
+
+# ---------------------------------------------------------------------------
+# serve_load units
+# ---------------------------------------------------------------------------
+
+def test_find_knee_throughput_saturation():
+    sl = _load_tool("serve_load")
+    levels = [
+        {"concurrency": 4, "qps": 100.0, "p99_ms": 5.0},
+        {"concurrency": 8, "qps": 200.0, "p99_ms": 5.5},
+        {"concurrency": 16, "qps": 400.0, "p99_ms": 6.0},
+        {"concurrency": 32, "qps": 410.0, "p99_ms": 12.0},
+        {"concurrency": 64, "qps": 415.0, "p99_ms": 30.0},
+    ]
+    idx, reason = sl.find_knee(levels)
+    assert idx == 2                      # last level that still scaled
+    assert "saturated" in reason
+
+
+def test_find_knee_p99_inflection():
+    sl = _load_tool("serve_load")
+    levels = [
+        {"concurrency": 4, "qps": 100.0, "p99_ms": 5.0},
+        {"concurrency": 8, "qps": 200.0, "p99_ms": 6.0},
+        {"concurrency": 16, "qps": 390.0, "p99_ms": 40.0},  # inflected
+    ]
+    idx, reason = sl.find_knee(levels)
+    assert idx == 1
+    assert "inflected" in reason
+
+
+def test_find_knee_no_saturation_and_base_saturated():
+    sl = _load_tool("serve_load")
+    scaling = [{"concurrency": c, "qps": 100.0 * c, "p99_ms": 5.0}
+               for c in (4, 8, 16)]
+    idx, reason = sl.find_knee(scaling)
+    assert idx == 2 and "no saturation" in reason
+    flat = [{"concurrency": 4, "qps": 100.0, "p99_ms": 5.0},
+            {"concurrency": 8, "qps": 101.0, "p99_ms": 9.0}]
+    idx, _ = sl.find_knee(flat)
+    assert idx == 0
+
+
+def test_run_level_closed_loop_and_server_death():
+    sl = _load_tool("serve_load")
+    calls = []
+
+    def ok_send(i):
+        calls.append(i)
+        time.sleep(0.001)
+
+    lv = sl.run_level(ok_send, concurrency=4, total_requests=20)
+    assert lv["ok"] == 20 and lv["errors"] == 0
+    assert sorted(calls) == list(range(20))     # closed loop covers all
+    assert lv["p50_ms"] <= lv["p95_ms"] <= lv["p99_ms"]
+    assert lv["qps"] > 0
+
+    def dead_send(i):
+        raise ConnectionRefusedError("server gone")
+
+    with pytest.raises(sl.ServerDied):
+        sl.run_level(dead_send, concurrency=4, total_requests=8)
+
+
+def test_env_failure_artifact_on_server_death(tmp_path):
+    sl = _load_tool("serve_load")
+    out = tmp_path / "BENCH_dead.json"
+    doc = sl.write_env_failure(str(out), "serve_load_lenet_qps_at_knee",
+                               "all requests failed: connection refused")
+    assert doc["status"] == "env_failure" and doc["value"] == 0.0
+    # perf_regress must SKIP it, never adopt it as a baseline
+    pr = _load_tool("perf_regress")
+    rec, why = pr.load_artifact(str(out))
+    assert rec is None and "env_failure" in why
+
+
+def test_build_result_shape_validates(tmp_path):
+    sl = _load_tool("serve_load")
+    tc = _load_tool("trace_check")
+    levels = [
+        {"concurrency": 4, "qps": 100.0, "p50_ms": 3.0, "p95_ms": 4.0,
+         "p99_ms": 5.0, "requests": 50, "ok": 50, "errors": 0,
+         "wall_s": 0.5, "mean_ms": 3.2, "first_error": None},
+        {"concurrency": 8, "qps": 105.0, "p50_ms": 6.0, "p95_ms": 8.0,
+         "p99_ms": 10.0, "requests": 50, "ok": 50, "errors": 0,
+         "wall_s": 0.5, "mean_ms": 6.2, "first_error": None},
+    ]
+    h = prof.Histogram("t.sl", "serving")
+    for v in (3.0, 4.0, 5.0):
+        h.observe(v)
+    stats = {"serving.requests": 100, "serving.responses": 3,
+             "serving.batches": 2, "batch_fill": 1.5,
+             "serving.latency_ms": h.value}
+    doc = sl.build_result("lenet", levels, 0, "test", stats)
+    p = tmp_path / "BENCH_sl.json"
+    p.write_text(json.dumps(doc))
+    assert tc.check_bench_json(str(p)) == []
+    assert doc["value"] == 100.0
+    assert doc["extra"]["serving"]["p99_ms"] == 5.0
+    assert doc["extra"]["serve_load"]["knee_concurrency"] == 4
+
+
+# ---------------------------------------------------------------------------
+# trace_check schema enforcement
+# ---------------------------------------------------------------------------
+
+def test_trace_check_servescope_families():
+    tc = _load_tool("trace_check")
+    ok = dict.fromkeys(
+        ["servescope/servescope.requests_traced",
+         "servescope/servescope.sampled_out"], "counter")
+    ok["servescope/servescope.e2e_ms"] = "histogram"
+    ok["servescope/servescope.sample_every"] = "gauge"
+    assert tc.check_healthmon_kinds(ok) == []
+    bad = {"servescope/servescope.made_up": "counter"}
+    assert tc.check_healthmon_kinds(bad)
+    flipped = {"servescope/servescope.requests_traced": "gauge"}
+    assert tc.check_healthmon_kinds(flipped)
+
+
+def _good_group():
+    comps = {"queue_wait_ms": 4.0, "coalesce_delay_ms": 1.0,
+             "pad_overhead_ms": 0.5, "device_exec_ms": 2.0,
+             "respond_ms": 0.5}
+    att = {"e2e_ms": 8.0, "cohort": 2, "components": comps,
+           "sum_ms": 8.0, "top_component": "queue_wait_ms",
+           "top_share": 0.5}
+    return {"count": 10,
+            "e2e_ms": {"p50": 5.0, "p95": 7.0, "p99": 8.0, "mean": 5.5,
+                       "max": 8.5},
+            "component_dist": {k: {"p50": 1.0, "p95": 2.0, "p99": 3.0,
+                                   "mean": 1.5} for k in comps},
+            "attribution": {"p50": dict(att), "p95": dict(att),
+                            "p99": dict(att)}}
+
+
+def test_trace_check_servescope_extra_good_and_bad():
+    tc = _load_tool("trace_check")
+    good = {"sample_every": 1, "requests": 10,
+            "components": list(tc.SERVESCOPE_COMPONENTS),
+            "device_exec_source": "host_wall",
+            "overall": _good_group(),
+            "per_bucket": {"4": dict(_good_group(), bucket=4,
+                                     verdict="compute_bound",
+                                     resharding_collectives=0,
+                                     hlo_available=True)}}
+    assert tc.check_servescope_extra(None) == []
+    assert tc.check_servescope_extra(good) == []
+    # sum far from the quantile -> structural error
+    bad = json.loads(json.dumps(good))
+    bad["overall"]["attribution"]["p99"]["components"]["queue_wait_ms"] \
+        = 40.0
+    bad["overall"]["attribution"]["p99"]["sum_ms"] = 44.0
+    assert tc.check_servescope_extra(bad)
+    # unknown component name
+    bad2 = json.loads(json.dumps(good))
+    bad2["overall"]["attribution"]["p99"]["components"]["gpu_ms"] = 1.0
+    assert tc.check_servescope_extra(bad2)
+    # bad verdict taxonomy
+    bad3 = json.loads(json.dumps(good))
+    bad3["per_bucket"]["4"]["verdict"] = "warp_bound"
+    assert tc.check_servescope_extra(bad3)
+    # bad provenance
+    bad4 = json.loads(json.dumps(good))
+    bad4["device_exec_source"] = "vibes"
+    assert tc.check_servescope_extra(bad4)
+
+
+def test_trace_check_serve_load_extra():
+    tc = _load_tool("trace_check")
+    good = {"levels": [
+        {"concurrency": 4, "qps": 100.0, "p50_ms": 1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0},
+        {"concurrency": 8, "qps": 110.0, "p50_ms": 2.0, "p95_ms": 3.0,
+         "p99_ms": 4.0}],
+        "knee_index": 1, "knee_concurrency": 8, "qps_at_knee": 110.0,
+        "p99_at_knee_ms": 4.0}
+    assert tc.check_serve_load_extra(None) == []
+    assert tc.check_serve_load_extra(good) == []
+    bad = json.loads(json.dumps(good))
+    bad["knee_index"] = 5
+    assert tc.check_serve_load_extra(bad)
+    bad2 = json.loads(json.dumps(good))
+    bad2["levels"][1]["concurrency"] = 4       # not ascending
+    assert tc.check_serve_load_extra(bad2)
+    bad3 = json.loads(json.dumps(good))
+    bad3["qps_at_knee"] = 999.0                 # disagrees with the level
+    assert tc.check_serve_load_extra(bad3)
+
+
+# ---------------------------------------------------------------------------
+# perf_regress gates
+# ---------------------------------------------------------------------------
+
+def _serve_load_artifact(tmp_path, name, qps, p99, knee=8):
+    doc = {"metric": "serve_load_lenet_qps_at_knee", "value": qps,
+           "unit": "requests/sec",
+           "extra": {"serving": {"p99_ms": p99},
+                     "serve_load": {"knee_concurrency": knee}}}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_perf_regress_serve_load_gates(tmp_path):
+    pr = _load_tool("perf_regress")
+    base, _ = pr.load_artifact(
+        _serve_load_artifact(tmp_path, "a.json", 100.0, 50.0))
+    same, _ = pr.load_artifact(
+        _serve_load_artifact(tmp_path, "b.json", 100.0, 50.0))
+    regs, notes = pr.compare(base, same, p99_threshold=0.15)
+    assert not regs
+    assert any("saturation knee" in n for n in notes)
+    # injected 20% p99 degradation flagged at the serving threshold
+    worse, _ = pr.load_artifact(
+        _serve_load_artifact(tmp_path, "c.json", 100.0, 60.0))
+    regs, _ = pr.compare(base, worse, p99_threshold=0.15)
+    assert any("p99_ms" in r for r in regs)
+    # knee shift alone is a note (discrete ramp), not a regression
+    shifted, _ = pr.load_artifact(
+        _serve_load_artifact(tmp_path, "d.json", 100.0, 50.0, knee=4))
+    regs, notes = pr.compare(base, shifted, p99_threshold=0.15)
+    assert not regs
+    assert any("knee moved down" in n for n in notes)
+    # both-sides contract: a baseline without a sweep yields a note
+    plain = dict(base, knee_concurrency=None)
+    regs, notes = pr.compare(plain, shifted, p99_threshold=0.15)
+    assert not regs
+    assert any("needs a sweep on both sides" in n for n in notes)
+
+
+# ---------------------------------------------------------------------------
+# mxdiag serve renderer
+# ---------------------------------------------------------------------------
+
+def test_mxdiag_serve_renders(tmp_path, capsys):
+    md = _load_tool("mxdiag")
+    doc = {"metric": "serve_load_lenet_qps_at_knee", "value": 100.0,
+           "unit": "requests/sec",
+           "extra": {
+               "model": "serve_load_lenet",
+               "serving": {"requests": 10, "responses": 10, "batches": 4,
+                           "batch_fill": 2.5, "rejected_queue_full": 0,
+                           "rejected_deadline": 0,
+                           "rejected_deadline_post_batch": 0,
+                           "rejected_invalid": 0},
+               "serve_load": {"levels": [
+                   {"concurrency": 4, "qps": 100.0, "p50_ms": 1.0,
+                    "p95_ms": 2.0, "p99_ms": 3.0, "errors": 0}],
+                   "knee_index": 0, "knee_reason": "test"},
+               "servescope": {"sample_every": 1, "requests": 10,
+                              "device_exec_source": "host_wall",
+                              "overall": _good_group(),
+                              "per_bucket": {"4": dict(
+                                  _good_group(), bucket=4, fill=0.9,
+                                  verdict="compute_bound",
+                                  resharding_collectives=0)},
+                              "advice": "p99 is 50% queue_wait at "
+                                        "bucket 4 - raise max_batch"}}}
+    p = tmp_path / "BENCH_sl.json"
+    p.write_text(json.dumps(doc))
+    assert md.main(["serve", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "KNEE" in out
+    assert "queue_wait" in out and "<< TAIL" in out
+    assert "ADVICE" in out
+    # env-failure artifact renders the failure, rc 1
+    bad = tmp_path / "BENCH_bad.json"
+    bad.write_text(json.dumps({"metric": "m", "value": 0.0,
+                               "status": "env_failure", "error": "boom"}))
+    assert md.main(["serve", str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bench integration shape
+# ---------------------------------------------------------------------------
+
+def test_bench_extra_shape_validates(frozen, armed, tmp_path):
+    tc = _load_tool("trace_check")
+    b = DynamicBatcher(frozen, max_delay_ms=10, queue_limit=64).start()
+    _drive(b, 16)
+    b.stop()
+    h = prof.counters().get("serving/serving.latency_ms") or {}
+    doc = {"metric": "serving_test", "value": 1.0,
+           "extra": {"serving": {
+               "requests": 16, "responses": 16, "batches": 4,
+               "batch_fill": 4.0, "p50_ms": 1.0, "p95_ms": 2.0,
+               "p99_ms": 3.0, "qps": 10.0, "latency_ms": h},
+               "servescope": servescope.bench_extra()}}
+    p = tmp_path / "BENCH_ss.json"
+    p.write_text(json.dumps(doc))
+    assert tc.check_bench_json(str(p)) == [], \
+        tc.check_bench_json(str(p))[:3]

@@ -68,6 +68,14 @@ if timeout 1200 bash tools/devicescope_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) devicescope smoke FAILED (continuing; measured device timeline suspect)" >> "$LOG"
 fi
+# servescope smoke (CPU-only 64-client load sweep): tail-latency
+# attribution sums within 15%, bucket verdicts present, knee found,
+# perf_regress flags an injected p99 degradation
+if timeout 1200 bash tools/servescope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) servescope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) servescope smoke FAILED (continuing; serving attribution suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -31,12 +31,20 @@ resharding findings with the offending operand shapes.
 roofline verdicts, measured collective lanes, idle-gap taxonomy, and
 the analytic-vs-measured reconciliation.
 
+`serve`: the tail-latency attribution report from a BENCH json
+(`extra.servescope` / `extra.serve_load`) — the ramp sweep with its
+saturation knee, per-bucket p99 cohort attribution (queue_wait /
+coalesce_delay / pad_overhead / device_exec / respond) with roofline +
+resharding verdicts, and the one-line advice ("p99 is 83% queue_wait
+at bucket 128 - raise max_batch, not the kernel").
+
 Usage:
     python tools/mxdiag.py DUMP.json [--events N]
     python tools/mxdiag.py metrics.jsonl
     python tools/mxdiag.py perf BENCH.json
     python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py device BENCH.json
+    python tools/mxdiag.py serve BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
@@ -531,6 +539,126 @@ def _device_main(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# serve: tail-latency attribution report from a BENCH json
+# (extra.servescope / extra.serve_load / extra.serving)
+# ---------------------------------------------------------------------------
+
+def _print_attr_group(grp: dict, indent: str = "    ") -> None:
+    """One attribution group (overall or a bucket): the p99 cohort's
+    component split with share bars, plus the independent component
+    p99s underneath."""
+    att = (grp.get("attribution") or {}).get("p99")
+    e2e = grp.get("e2e_ms") or {}
+    if not att:
+        print(f"{indent}(no attribution — too few traced requests)")
+        return
+    print(f"{indent}e2e p50/p95/p99: {e2e.get('p50')}/{e2e.get('p95')}/"
+          f"{e2e.get('p99')} ms  ({grp.get('count')} traced)")
+    total = att.get("sum_ms") or 0.0
+    print(f"{indent}p99 cohort ({att.get('cohort')} request(s) at "
+          f"{att.get('e2e_ms')} ms):")
+    for key, v in (att.get("components") or {}).items():
+        share = v / total if total else 0.0
+        bar = "#" * int(round(share * 30))
+        tag = "  << TAIL" if key == att.get("top_component") else ""
+        print(f"{indent}  {key.replace('_ms', ''):<15} {v:>9.3f} ms  "
+              f"{share:>6.1%}  {bar}{tag}")
+
+
+def print_serve(doc: dict) -> int:
+    """The "why is my p99 what it is" report: the serve_load sweep
+    table with its saturation knee, and servescope's per-bucket
+    tail-latency attribution with roofline + resharding verdicts —
+    ending in the one-line advice ("p99 is 83% queue_wait at bucket
+    128 - raise max_batch, not the kernel")."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    sl = extra.get("serve_load")
+    if isinstance(sl, dict) and sl.get("levels"):
+        print(f"\n  ramp sweep ({len(sl['levels'])} levels, knee: "
+              f"{sl.get('knee_reason')}):")
+        for i, lv in enumerate(sl["levels"]):
+            knee = "  << KNEE" if i == sl.get("knee_index") else ""
+            print(f"    {lv.get('concurrency'):>5} clients  "
+                  f"{lv.get('qps'):>9.1f} qps  p50/p95/p99 "
+                  f"{lv.get('p50_ms')}/{lv.get('p95_ms')}/"
+                  f"{lv.get('p99_ms')} ms  errors "
+                  f"{lv.get('errors', 0)}{knee}")
+    sv = extra.get("serving")
+    if isinstance(sv, dict):
+        print(f"\n  serving totals: {sv.get('responses')}/"
+              f"{sv.get('requests')} responded over "
+              f"{sv.get('batches')} batches (fill "
+              f"{sv.get('batch_fill')}x); rejects: queue_full "
+              f"{sv.get('rejected_queue_full', 0)}, deadline "
+              f"{sv.get('rejected_deadline', 0)} (+"
+              f"{sv.get('rejected_deadline_post_batch', 0)} post-batch), "
+              f"invalid {sv.get('rejected_invalid', 0)}")
+    ss = extra.get("servescope")
+    if not isinstance(ss, dict):
+        print("\n  no extra.servescope section (servescope was off — "
+              "rerun without BENCH_SERVESCOPE=0)")
+        return 1
+    src = ss.get("device_exec_source")
+    tag = ""
+    if src == "measured(profile)":
+        w = ss.get("device_window") or {}
+        tag = (f"  [device_exec measured: devicescope window over "
+               f"{w.get('dispatches')} dispatches"
+               + (", DRIFT vs host wall" if w.get("drift_warning")
+                  else "") + "]")
+    elif src == "host_wall":
+        tag = "  [device_exec: host wall around the executable]"
+    print(f"\n  tail-latency attribution (sampled 1/"
+          f"{ss.get('sample_every', 1)}, {ss.get('requests')} traced)"
+          f"{tag}")
+    print("\n  overall:")
+    _print_attr_group(ss.get("overall") or {})
+    for key, grp in sorted((ss.get("per_bucket") or {}).items(),
+                           key=lambda kv: int(kv[0])
+                           if kv[0].isdigit() else 0):
+        verdict = grp.get("verdict")
+        reshard = grp.get("resharding_collectives")
+        flags = []
+        if verdict:
+            flags.append(verdict)
+        if reshard:
+            flags.append(f"!! {reshard} RESHARDING collective(s)")
+        elif reshard == 0:
+            flags.append("resharding-clean")
+        fill = grp.get("fill")
+        fill_s = f", fill {fill:.0%}" if isinstance(fill, float) else ""
+        print(f"\n  bucket {key} ({', '.join(flags) or 'no verdicts'}"
+              f"{fill_s}):")
+        _print_attr_group(grp)
+    advice = ss.get("advice")
+    if advice:
+        print(f"\n  ADVICE: {advice}")
+    return 0
+
+
+def _serve_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py serve",
+        description="tail-latency attribution report from a BENCH json "
+                    "(extra.servescope / extra.serve_load)")
+    ap.add_argument("path", help="BENCH json (bench.py / serve_load.py "
+                                 "output or the driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 1
+    return print_serve(doc)
+
+
+# ---------------------------------------------------------------------------
 # merge: cross-rank timeline from per-rank flight dumps / event logs
 # ---------------------------------------------------------------------------
 
@@ -668,6 +796,8 @@ def main(argv=None) -> int:
         return _comms_main(argv[1:])
     if argv and argv[0] == "device":
         return _device_main(argv[1:])
+    if argv and argv[0] == "serve":
+        return _serve_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

@@ -13,8 +13,12 @@ baseline every later run "beats". This tool:
   an ``error`` field, a null ``parsed`` wrapper, or a non-positive
   value. They describe the environment, not the code;
 * **compares the metrics that matter** — headline throughput
-  (``value``), ``extra.mfu`` (ROADMAP item 1's regression metric),
-  serving ``p99_ms``, the per-step collective payload
+  (``value`` — for ``tools/serve_load.py`` sweeps that IS the QPS at
+  the saturation knee), ``extra.mfu`` (ROADMAP item 1's regression
+  metric), serving ``p99_ms`` (at the knee for serve_load artifacts,
+  with the knee's position reported as context — on a discrete ramp it
+  moves in whole levels, so a shift alone is a note, not a verdict),
+  the per-step collective payload
   (``extra.commscope.step.bytes`` — a LAYOUT regression: a new
   accidental reshard inflates in-program collective bytes even when
   the CPU-bench wall time barely moves), and the MEASURED device busy
@@ -126,6 +130,15 @@ def load_artifact(path):
     rec["busy_fraction"] = (float(bf)
                             if isinstance(bf, (int, float))
                             and not isinstance(bf, bool) else None)
+    # serve_load sweep: the saturation knee (tools/serve_load.py). The
+    # real gates are value (= QPS at the knee) and p99_ms (= p99 at the
+    # knee, already in extra.serving); the knee's position itself is
+    # reported as context — on a discrete ramp it can only move in
+    # whole levels, so wobble is a note, never an indictment on its own
+    sl = extra.get("serve_load") or {}
+    kc = sl.get("knee_concurrency") if isinstance(sl, dict) else None
+    rec["knee_concurrency"] = (int(kc) if isinstance(kc, int)
+                               and not isinstance(kc, bool) else None)
     return rec, None
 
 
@@ -222,6 +235,24 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         notes.append(f"note: only the {side} carries a devicescope "
                      f"busy fraction — busy gate skipped (needs a "
                      f"window on both sides)")
+    bkc, ckc = baseline.get("knee_concurrency"), \
+        candidate.get("knee_concurrency")
+    if bkc is not None and ckc is not None:
+        if ckc < bkc:
+            notes.append(f"note: saturation knee moved down "
+                         f"({bkc} -> {ckc} clients) — the server "
+                         f"saturates earlier; the QPS/p99-at-knee gates "
+                         f"above carry the verdict")
+        elif ckc > bkc:
+            notes.append(f"note: saturation knee moved up "
+                         f"({bkc} -> {ckc} clients)")
+        else:
+            notes.append(f"ok saturation knee: {bkc} clients (unchanged)")
+    elif (bkc is None) != (ckc is None):
+        side = "candidate" if bkc is None else "baseline"
+        notes.append(f"note: only the {side} carries a serve_load knee "
+                     f"— knee context skipped (needs a sweep on both "
+                     f"sides)")
     cr = candidate.get("resharding")
     if cr:
         br = baseline.get("resharding")

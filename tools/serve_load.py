@@ -1,0 +1,450 @@
+#!/usr/bin/env python
+"""Closed-loop serving load harness: ramp concurrency, find the knee.
+
+The serving-path counterpart of ``bench.py``'s training sweep (ROADMAP
+item 1's closing gate): freeze a model, start :class:`ModelServer`, and
+drive K concurrent **closed-loop** clients (each fires its next request
+the moment the previous response lands — the load model under which
+"QPS at a p99 target" is well-defined) through a ramped concurrency
+sweep. For every level the harness records client-observed QPS and
+p50/p95/p99, then finds the **saturation knee** — the last level where
+throughput still scales before p99 inflects — and emits one
+trace_check-valid BENCH json:
+
+* ``metric`` = ``serve_load_<model>_qps_at_knee``, ``value`` = the QPS
+  at the knee (gated by ``tools/perf_regress.py``'s value gate);
+* ``extra.serving`` — the standard serving section (schema enforced by
+  ``check_bench_json``), with p50/p95/p99 and qps measured AT the knee
+  level and the request/batch accounting + latency histogram from the
+  server's cumulative registry snapshot;
+* ``extra.serve_load`` — the full per-level sweep table plus the knee
+  verdict (``knee_concurrency`` / ``qps_at_knee`` / ``p99_at_knee_ms``,
+  gated by perf_regress's p99 gate);
+* ``extra.servescope`` — the tail-latency attribution
+  (``queue_wait + coalesce_delay + pad_overhead + device_exec +
+  respond`` per bucket, with roofline + resharding verdicts attached —
+  ``check_servescope_extra`` validates it, ``mxdiag.py serve`` renders
+  it).
+
+A server that dies mid-sweep (every request of a level failing, or a
+dead /healthz) produces a self-describing ``{"status": "env_failure"}``
+artifact — the bench.py convention perf_regress skips — instead of a
+zero that would poison the BENCH trajectory.
+
+Usage:
+    python tools/serve_load.py [--model lenet] [--ramp 4,8,16,32,64]
+        [--level-requests 128] [--max-delay-ms 5] [--out BENCH.json]
+        [--events EVENTS.jsonl] [--sample N] [--devicescope N]
+
+Pure helpers (:func:`find_knee`, :func:`run_level`, :func:`sweep`,
+:func:`write_env_failure`) are importable without a backend —
+``tests/test_servescope.py`` unit-tests knee detection and the
+env-failure path against synthetic levels.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["find_knee", "run_level", "sweep", "build_result",
+           "write_env_failure", "ServerDied", "main",
+           "DEFAULT_RAMP", "KNEE_QPS_GAIN", "KNEE_P99_MULT"]
+
+DEFAULT_RAMP = "4,8,16,32,64"
+# knee rules: saturation begins at the first level whose marginal QPS
+# gain is below KNEE_QPS_GAIN x the concurrency scaling, or whose p99
+# exceeds KNEE_P99_MULT x the base level's p99 (the inflection)
+KNEE_QPS_GAIN = 0.10
+KNEE_P99_MULT = 3.0
+
+
+class ServerDied(RuntimeError):
+    """Every request of a level failed (or /healthz went away): the
+    server is gone, and the sweep has no perf meaning."""
+
+
+# ---------------------------------------------------------------------------
+# knee detection (pure)
+# ---------------------------------------------------------------------------
+
+def find_knee(levels, qps_gain: float = KNEE_QPS_GAIN,
+              p99_mult: float = KNEE_P99_MULT):
+    """The saturation knee of a ramped sweep.
+
+    ``levels``: dicts with ``concurrency``, ``qps``, ``p99_ms``,
+    ordered by ascending concurrency. Returns ``(index, reason)`` of
+    the knee level — the last level BEFORE saturation:
+
+    * level i saturates on **throughput** when its relative QPS gain
+      over level i-1 falls below ``qps_gain`` x the relative
+      concurrency increase (doubling clients for <10% more QPS means
+      the extra clients only queue);
+    * level i saturates on **latency** when ``p99_ms`` exceeds
+      ``p99_mult`` x the base level's p99 (the inflection — latency has
+      replaced throughput as the thing that grows).
+
+    With no saturation observed the knee is the last level (reason
+    says so: the ramp didn't reach the knee)."""
+    if not levels:
+        raise ValueError("find_knee needs at least one level")
+    base_p99 = levels[0].get("p99_ms") or 0.0
+    for i in range(1, len(levels)):
+        prev, cur = levels[i - 1], levels[i]
+        scale = (cur["concurrency"] / prev["concurrency"]) - 1.0
+        gain = ((cur["qps"] - prev["qps"]) / prev["qps"]
+                if prev["qps"] > 0 else 0.0)
+        if scale > 0 and gain < qps_gain * scale:
+            return i - 1, (f"throughput saturated at concurrency "
+                           f"{cur['concurrency']} (+{gain:.1%} QPS for "
+                           f"+{scale:.0%} clients)")
+        if base_p99 > 0 and (cur.get("p99_ms") or 0.0) \
+                > p99_mult * base_p99:
+            return i - 1, (f"p99 inflected at concurrency "
+                           f"{cur['concurrency']} "
+                           f"({cur['p99_ms']:.1f} ms > {p99_mult:g}x "
+                           f"base {base_p99:.1f} ms)")
+    return len(levels) - 1, "no saturation observed (ramp too short?)"
+
+
+# ---------------------------------------------------------------------------
+# closed-loop level runner
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals, q):
+    import math
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
+
+def run_level(send_fn, concurrency: int, total_requests: int) -> dict:
+    """Drive ``total_requests`` through ``concurrency`` closed-loop
+    client threads. ``send_fn(i)`` issues request i and blocks until
+    its response (raising on failure). Returns the level dict
+    {concurrency, requests, ok, errors, wall_s, qps, p50/p95/p99_ms};
+    raises :class:`ServerDied` when NOTHING succeeded."""
+    counter = [0]
+    lock = threading.Lock()
+    lats, errs = [], []
+
+    def client():
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= total_requests:
+                    return
+                counter[0] += 1
+            t0 = time.perf_counter()
+            try:
+                send_fn(i)
+            except Exception as e:  # noqa: BLE001 — a failed request is
+                with lock:          # data, not a harness crash
+                    errs.append(f"{type(e).__name__}: {e}")
+                continue
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lats.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(max(1, int(concurrency)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if not lats:
+        raise ServerDied(
+            f"level concurrency={concurrency}: all {total_requests} "
+            f"requests failed; first error: {errs[0] if errs else '?'}")
+    lats.sort()
+    return {
+        "concurrency": int(concurrency),
+        "requests": int(total_requests),
+        "ok": len(lats),
+        "errors": len(errs),
+        "first_error": errs[0][:200] if errs else None,
+        "wall_s": round(wall, 4),
+        "qps": round(len(lats) / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(_percentile(lats, 0.50), 3),
+        "p95_ms": round(_percentile(lats, 0.95), 3),
+        "p99_ms": round(_percentile(lats, 0.99), 3),
+        "mean_ms": round(sum(lats) / len(lats), 3),
+    }
+
+
+def sweep(send_fn, ramp, level_requests: int, log=print,
+          before_level=None) -> list:
+    """Run every ramp level through :func:`run_level` (closed loop,
+    ascending concurrency). ``before_level(index, concurrency)``, when
+    given, runs ahead of each level (main() arms the devicescope
+    window over the most loaded one). Propagates :class:`ServerDied`."""
+    levels = []
+    for li, c in enumerate(ramp):
+        if before_level is not None:
+            before_level(li, c)
+        lv = run_level(send_fn, c, level_requests)
+        levels.append(lv)
+        log(f"serve_load: concurrency {c:>4}  qps {lv['qps']:>9.1f}  "
+            f"p50/p95/p99 {lv['p50_ms']:.1f}/{lv['p95_ms']:.1f}/"
+            f"{lv['p99_ms']:.1f} ms  errors {lv['errors']}")
+    return levels
+
+
+# ---------------------------------------------------------------------------
+# artifacts
+# ---------------------------------------------------------------------------
+
+def build_result(model_name: str, levels, knee_idx: int, reason: str,
+                 server_stats: dict, servescope_extra=None,
+                 devicescope_extra=None, meta=None) -> dict:
+    """Assemble the BENCH json: value = QPS at the knee, the standard
+    ``extra.serving`` section (percentiles AT the knee, accounting from
+    the server's cumulative snapshot), the sweep table, and the
+    attribution."""
+    knee = levels[knee_idx]
+    hist = server_stats.get("serving.latency_ms")
+    serving = {
+        "model": model_name,
+        "clients": knee["concurrency"],
+        "requests": int(server_stats.get("serving.requests", 0)),
+        "responses": int(server_stats.get("serving.responses", 0)),
+        "batches": int(server_stats.get("serving.batches", 0)),
+        "batch_fill": round(float(server_stats.get("batch_fill", 0.0)), 3),
+        "rejected_queue_full":
+            int(server_stats.get("serving.rejected_queue_full", 0)),
+        "rejected_deadline":
+            int(server_stats.get("serving.rejected_deadline", 0)),
+        "rejected_deadline_post_batch":
+            int(server_stats.get("serving.rejected_deadline_post_batch",
+                                 0)),
+        "rejected_invalid":
+            int(server_stats.get("serving.rejected_invalid", 0)),
+        "qps": knee["qps"],
+        "p50_ms": knee["p50_ms"],
+        "p95_ms": knee["p95_ms"],
+        "p99_ms": knee["p99_ms"],
+        "latency_ms": hist if isinstance(hist, dict) else None,
+    }
+    extra = {
+        "model": f"serve_load_{model_name}",
+        "batch": None,
+        "dtype": "float32",
+        "serving": serving,
+        "serve_load": {
+            "levels": levels,
+            "knee_index": knee_idx,
+            "knee_reason": reason,
+            "knee_concurrency": knee["concurrency"],
+            "qps_at_knee": knee["qps"],
+            "p99_at_knee_ms": knee["p99_ms"],
+        },
+    }
+    if servescope_extra is not None:
+        extra["servescope"] = servescope_extra
+    if devicescope_extra is not None:
+        extra["devicescope"] = devicescope_extra
+    if meta:
+        extra.update(meta)
+    return {
+        "metric": f"serve_load_{model_name}_qps_at_knee",
+        "value": knee["qps"],
+        "unit": "requests/sec",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+
+
+def write_env_failure(path: str, metric: str, error: str) -> dict:
+    """The self-describing environment-failure artifact (bench.py's
+    preflight convention): perf_regress skips it, the trajectory stays
+    unpoisoned, and the error travels with the file."""
+    doc = {"status": "env_failure", "metric": metric, "value": 0.0,
+           "unit": "requests/sec", "error": str(error)[:500],
+           "ts": time.time()}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# main (backend-touching; imports deferred so helpers stay unit-testable)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="closed-loop serving load harness (ramped "
+                    "concurrency, saturation knee, BENCH json)")
+    ap.add_argument("--model", default=os.environ.get(
+        "BENCH_SERVING_MODEL", "lenet"))
+    ap.add_argument("--ramp", default=DEFAULT_RAMP,
+                    help=f"comma-separated concurrency ladder "
+                         f"(default {DEFAULT_RAMP})")
+    ap.add_argument("--level-requests", type=int, default=128,
+                    help="closed-loop requests per ramp level")
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--timeout-ms", type=float, default=60000.0,
+                    help="per-request deadline handed to the server")
+    ap.add_argument("--sample", default=None,
+                    help="servescope sampling (rate in (0,1] or an "
+                         "every-Nth stride; default: trace everything)")
+    ap.add_argument("--devicescope", type=int, default=0,
+                    help="capture a devicescope window over N dispatches "
+                         "of the final ramp level (0 = off)")
+    ap.add_argument("--out", default="/tmp/mxtpu_serve_load.json")
+    ap.add_argument("--events", default=None,
+                    help="write the mxtpu.events/1 request/batch stream "
+                         "here (default: alongside --out)")
+    args = ap.parse_args(argv)
+
+    ramp = sorted({int(t) for t in args.ramp.split(",") if t.strip()})
+    if not ramp:
+        print("serve_load: empty --ramp", file=sys.stderr)
+        return 2
+    metric = f"serve_load_{args.model}_qps_at_knee"
+    events_path = args.events or (
+        os.path.splitext(args.out)[0] + "_events.jsonl")
+
+    import numpy as np
+
+    # runnable from anywhere: the repo root is this file's parent dir
+    _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _root not in sys.path:
+        sys.path.insert(0, _root)
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import (commscope, devicescope, perfscope,
+                                     servescope, serving)
+    from incubator_mxnet_tpu.healthmon import events as hm_events
+    from incubator_mxnet_tpu.models import get_model
+
+    shapes = {"lenet": (1, 28, 28), "resnet50_v1": (224, 224, 3)}
+    if args.model not in shapes:
+        print(f"serve_load: no serving shape for {args.model!r} "
+              f"(choose from {sorted(shapes)})", file=sys.stderr)
+        return 2
+    shape = shapes[args.model]
+
+    # arm the observability stack: perfscope+commscope so every bucket
+    # carries its roofline + resharding verdict, servescope for the
+    # attribution, and the event log for the correlation stream
+    perfscope.enable()
+    commscope.enable()
+    servescope.enable(sample=args.sample)
+    run_id = f"serveload-{os.getpid()}-{int(time.time())}"
+    hm_events.open_log(events_path, run_id=run_id, rank=0)
+
+    kwargs = {"layout": "NHWC"} if args.model.startswith("resnet") else {}
+    net = get_model(args.model,
+                    classes=10 if args.model == "lenet" else 1000,
+                    **kwargs)
+    net.initialize(init=mx.init.Xavier())
+    print(f"serve_load: freezing {args.model} (AOT compile + warmup)")
+    frozen = net.freeze(input_shape=shape)
+    srv = serving.ModelServer(
+        frozen, max_delay_ms=args.max_delay_ms,
+        queue_limit=max(256, ramp[-1] * 4),
+        default_timeout_ms=args.timeout_ms)
+    host, port = srv.start()
+    print(f"serve_load: {args.model} at {srv.address} "
+          f"buckets={frozen.buckets} ramp={ramp} "
+          f"x{args.level_requests} req/level")
+
+    import http.client
+    rng = np.random.RandomState(0)
+    samples = rng.rand(64, *shape).astype(np.float32)
+    bodies = [json.dumps({"data": s.tolist(),
+                          "timeout_ms": args.timeout_ms}).encode()
+              for s in samples]
+
+    # keep-alive connection per client thread (the wrk/hey load-gen
+    # convention): a closed-loop client measures the SERVING path, not
+    # per-request TCP connect — without reuse, a concurrent burst
+    # overflows accept backlogs and the "p99" becomes kernel SYN
+    # retransmit timeouts (measured: exact 1s/3s modes)
+    tls = threading.local()
+
+    def send(i):
+        conn = getattr(tls, "conn", None)
+        if conn is None:
+            conn = tls.conn = http.client.HTTPConnection(
+                host, port, timeout=120)
+        try:
+            conn.request("POST", "/predict", body=bodies[i % len(bodies)],
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            data = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"HTTP {r.status}: {data[:120]!r}")
+        except Exception:
+            try:
+                conn.close()
+            finally:
+                tls.conn = None
+            raise
+
+    win = None
+
+    def _arm_window(li, c):
+        # measured device window over the most loaded level: the
+        # attribution's device_exec upgrades to measured(profile)
+        # when it completes
+        nonlocal win
+        if args.devicescope > 0 and li == len(ramp) - 1:
+            win = devicescope.capture(steps=args.devicescope).start()
+
+    try:
+        levels = sweep(send, ramp, args.level_requests,
+                       before_level=_arm_window)
+    except ServerDied as e:
+        print(f"serve_load: SERVER DIED — writing env_failure artifact: "
+              f"{e}", file=sys.stderr)
+        write_env_failure(args.out, metric, str(e))
+        hm_events.close_log()
+        return 0
+    finally:
+        if win is not None:
+            win.stop()
+
+    knee_idx, reason = find_knee(levels)
+    stats = srv.stats()            # ONE cumulative registry snapshot
+    servescope_extra = servescope.bench_extra()
+    ds_extra = devicescope.bench_extra() if win is not None else None
+    srv.stop()
+    hm_events.close_log()
+
+    doc = build_result(args.model, levels, knee_idx, reason, stats,
+                       servescope_extra=servescope_extra,
+                       devicescope_extra=ds_extra,
+                       meta={"run_id": run_id, "events_file": events_path,
+                             "buckets": list(frozen.buckets),
+                             "max_delay_ms": args.max_delay_ms,
+                             "level_requests": args.level_requests})
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    knee = levels[knee_idx]
+    print(f"serve_load: knee at concurrency {knee['concurrency']} "
+          f"({reason})")
+    print(f"serve_load: {doc['metric']} = {doc['value']} requests/sec, "
+          f"p99 {knee['p99_ms']:.1f} ms")
+    att = (servescope_extra or {}).get("advice")
+    if att:
+        print(f"serve_load: attribution: {att}")
+    print(f"serve_load: wrote {args.out} (events: {events_path})")
+
+    # self-check: the artifact must validate before anything gates on it
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_check
+    errors = trace_check.check_file(args.out) \
+        + trace_check.check_file(events_path)
+    if errors:
+        for e in errors:
+            print(f"serve_load: ARTIFACT INVALID: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,10 +22,11 @@
   JSONL, including `mxdiag merge` output) — per-record schema with the
   run_id/rank/step correlation ids, non-decreasing timestamps;
 * **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
-  `perfscope/*`, `commscope/*`, `devicescope/*` or `sharding/*` metric
-  appearing in a flight dump or metrics series must belong to the known
-  family table with the declared kind (an unknown or re-kinded metric
-  means a producer drifted from the documented schema).
+  `perfscope/*`, `commscope/*`, `devicescope/*`, `servescope/*` or
+  `sharding/*` metric appearing in a flight dump or metrics series must
+  belong to the known family table with the declared kind (an unknown
+  or re-kinded metric means a producer drifted from the documented
+  schema).
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -47,6 +48,7 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_bench_json", "check_events_jsonl",
            "check_healthmon_kinds", "check_perfscope_extra",
            "check_commscope_extra", "check_devicescope_extra",
+           "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
@@ -180,6 +182,39 @@ DEVICESCOPE_FAMILIES = {
 # idle-gap taxonomy buckets an `extra.devicescope` gaps block classifies
 DEVICESCOPE_GAP_TAXONOMY = ("input_starved_ms", "dispatch_serialized_ms",
                             "host_gap_ms")
+
+# The servescope.* (request-lifecycle tracing / tail-latency
+# attribution) metric families (docs/servescope.md): sampling header,
+# span accounting, and the per-component latency histograms.
+SERVESCOPE_FAMILIES = {
+    "servescope/servescope.requests_traced": "counter",
+    "servescope/servescope.rejections_traced": "counter",
+    "servescope/servescope.sampled_out": "counter",
+    "servescope/servescope.device_drift_warnings": "counter",
+    "servescope/servescope.sample_every": "gauge",
+    "servescope/servescope.e2e_ms": "histogram",
+    "servescope/servescope.queue_wait_ms": "histogram",
+    "servescope/servescope.coalesce_delay_ms": "histogram",
+    "servescope/servescope.pad_overhead_ms": "histogram",
+    "servescope/servescope.device_exec_ms": "histogram",
+    "servescope/servescope.respond_ms": "histogram",
+}
+
+# the closed request-latency component taxonomy an `extra.servescope`
+# attribution decomposes into (servescope/spans.py COMPONENTS)
+SERVESCOPE_COMPONENTS = ("queue_wait_ms", "coalesce_delay_ms",
+                         "pad_overhead_ms", "device_exec_ms",
+                         "respond_ms")
+
+# provenance values the attribution's device_exec component may declare
+SERVESCOPE_DEVICE_SOURCES = ("host_wall", "measured(profile)")
+
+# structural tolerance on |cohort sum - e2e quantile| / quantile: the
+# cohort-mean sum equals the cohort's mean e2e exactly, so this only
+# bounds cohort tightness. The CPU smoke enforces the acceptance bound
+# of 15%; the validator allows a little more slack (same split as
+# PERFSCOPE_SUM_TOLERANCE).
+SERVESCOPE_SUM_TOLERANCE = 0.25
 
 # decomposition components that must sum (with "other" absorbing the
 # residual) to the measured step time
@@ -326,8 +361,8 @@ def check_flight(path: str) -> list:
 
 def check_healthmon_kinds(kinds: dict) -> list:
     """Every healthmon/*, io/*, trainloop/*, perfscope/*, commscope/*,
-    devicescope/* and sharding/* metric must belong to its family table
-    with the declared kind."""
+    devicescope/*, servescope/* and sharding/* metric must belong to
+    its family table with the declared kind."""
     errors = []
     tables = (("healthmon/", HEALTHMON_FAMILIES, "HEALTHMON_FAMILIES"),
               ("io/", IO_TRAINLOOP_FAMILIES, "IO_TRAINLOOP_FAMILIES"),
@@ -337,6 +372,7 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("commscope/", COMMSCOPE_FAMILIES, "COMMSCOPE_FAMILIES"),
               ("devicescope/", DEVICESCOPE_FAMILIES,
                "DEVICESCOPE_FAMILIES"),
+              ("servescope/", SERVESCOPE_FAMILIES, "SERVESCOPE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -904,6 +940,205 @@ def check_devicescope_extra(ds) -> list:
     return errors
 
 
+# ---------------------------------------------------------------------------
+# servescope bench section (extra.servescope)
+# ---------------------------------------------------------------------------
+
+def _check_servescope_group(grp, where: str) -> list:
+    """One attribution group (overall or one bucket): count, ordered
+    e2e percentiles, per-component distributions, and quantile-cohort
+    attributions whose components are non-negative, whose sum_ms equals
+    the component sum, and whose sum stays within tolerance of the e2e
+    quantile it attributes."""
+    errors = []
+    if not isinstance(grp, dict):
+        return [f"{where}: must be an object, got {type(grp).__name__}"]
+    n = grp.get("count")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        errors.append(f"{where}: count must be an int >= 0, got {n!r}")
+        return errors
+    if n == 0:
+        return errors
+    e2e = grp.get("e2e_ms")
+    if not isinstance(e2e, dict):
+        errors.append(f"{where}: needs an 'e2e_ms' distribution object")
+    else:
+        pcts = [e2e.get(k) for k in ("p50", "p95", "p99")]
+        if not all(_is_num(p) for p in pcts):
+            errors.append(f"{where}: e2e_ms needs numeric p50/p95/p99, "
+                          f"got {pcts!r}")
+        elif not (pcts[0] <= pcts[1] <= pcts[2]):
+            errors.append(f"{where}: e2e percentiles must be ordered, "
+                          f"got {pcts!r}")
+    dist = grp.get("component_dist")
+    if not isinstance(dist, dict):
+        errors.append(f"{where}: needs a 'component_dist' object")
+    else:
+        for key in SERVESCOPE_COMPONENTS:
+            if key not in dist:
+                errors.append(f"{where}: component_dist missing {key!r}")
+        for key in dist:
+            if key not in SERVESCOPE_COMPONENTS:
+                errors.append(f"{where}: component_dist key {key!r} not "
+                              f"in {SERVESCOPE_COMPONENTS}")
+    att = grp.get("attribution")
+    if not isinstance(att, dict):
+        errors.append(f"{where}: needs an 'attribution' object")
+        return errors
+    for q, a in att.items():
+        aw = f"{where}.attribution[{q!r}]"
+        if not isinstance(a, dict):
+            errors.append(f"{aw}: not an object")
+            continue
+        qe = a.get("e2e_ms")
+        if not _is_num(qe) or qe < 0:
+            errors.append(f"{aw}: e2e_ms must be numeric >= 0, got {qe!r}")
+            continue
+        comps = a.get("components")
+        if not isinstance(comps, dict):
+            errors.append(f"{aw}: needs a 'components' object")
+            continue
+        total = 0.0
+        ok = True
+        for key in SERVESCOPE_COMPONENTS:
+            v = comps.get(key)
+            if not _is_num(v) or v < 0:
+                errors.append(f"{aw}: components[{key!r}] must be "
+                              f"numeric >= 0, got {v!r}")
+                ok = False
+            else:
+                total += v
+        for key in comps:
+            if key not in SERVESCOPE_COMPONENTS:
+                errors.append(f"{aw}: component {key!r} not in "
+                              f"{SERVESCOPE_COMPONENTS}")
+        s = a.get("sum_ms")
+        if not _is_num(s):
+            errors.append(f"{aw}: needs numeric 'sum_ms', got {s!r}")
+        elif ok and abs(total - s) > max(0.05, 0.01 * max(total, s)):
+            # sum_ms IS the component sum (the spans' accounting
+            # identity) — disagreement means a torn producer
+            errors.append(f"{aw}: components sum to {total:.4g} but "
+                          f"sum_ms={s:.4g}")
+        if ok and _is_num(s) and qe > 0:
+            off = abs(s - qe) / qe
+            if off > SERVESCOPE_SUM_TOLERANCE:
+                errors.append(
+                    f"{aw}: attribution sums to {s:.4g} ms but the "
+                    f"e2e quantile is {qe:.4g} ms ({off:.1%} apart, "
+                    f"tolerance {SERVESCOPE_SUM_TOLERANCE:.0%})")
+        top = a.get("top_component")
+        if top is not None and top not in SERVESCOPE_COMPONENTS:
+            errors.append(f"{aw}: top_component {top!r} not in "
+                          f"{SERVESCOPE_COMPONENTS}")
+    return errors
+
+
+def check_servescope_extra(ss) -> list:
+    """Validate an `extra.servescope` BENCH section: the sampling
+    header, the closed component taxonomy, the overall + per-bucket
+    attribution groups (cohort sums within tolerance of their e2e
+    quantiles), bucket verdicts from the roofline taxonomy, and the
+    device_exec provenance."""
+    if ss is None:
+        return []
+    if not isinstance(ss, dict):
+        return [f"must be an object, got {type(ss).__name__}"]
+    errors = []
+    se = ss.get("sample_every")
+    if se is not None and (not isinstance(se, int)
+                           or isinstance(se, bool) or se < 1):
+        errors.append(f"sample_every must be an int >= 1, got {se!r}")
+    comps = ss.get("components")
+    if comps is not None and tuple(comps) != SERVESCOPE_COMPONENTS:
+        errors.append(f"components {comps!r} != the closed taxonomy "
+                      f"{SERVESCOPE_COMPONENTS}")
+    src = ss.get("device_exec_source")
+    if src is not None and src not in SERVESCOPE_DEVICE_SOURCES:
+        errors.append(f"device_exec_source {src!r} not in "
+                      f"{SERVESCOPE_DEVICE_SOURCES}")
+    overall = ss.get("overall")
+    if overall is None:
+        errors.append("needs an 'overall' attribution group")
+    else:
+        errors += _check_servescope_group(overall, "overall")
+    pb = ss.get("per_bucket")
+    if pb is not None:
+        if not isinstance(pb, dict):
+            errors.append("per_bucket must be an object")
+        else:
+            for key, grp in pb.items():
+                errors += _check_servescope_group(grp,
+                                                  f"per_bucket[{key!r}]")
+                if not isinstance(grp, dict):
+                    continue
+                v = grp.get("verdict")
+                if v is not None and v not in ROOFLINE_VERDICTS:
+                    errors.append(f"per_bucket[{key!r}]: verdict {v!r} "
+                                  f"not in {ROOFLINE_VERDICTS}")
+                r = grp.get("resharding_collectives")
+                if r is not None and (not isinstance(r, int)
+                                      or isinstance(r, bool) or r < 0):
+                    errors.append(f"per_bucket[{key!r}]: "
+                                  f"resharding_collectives must be an "
+                                  f"int >= 0 or null, got {r!r}")
+    return errors
+
+
+def check_serve_load_extra(sl) -> list:
+    """Validate an `extra.serve_load` BENCH section (tools/serve_load.py
+    sweeps): an ordered ramp of per-level records with positive
+    concurrency/qps and ordered percentiles, and a knee whose index and
+    headline numbers agree with the level it points at."""
+    if sl is None:
+        return []
+    if not isinstance(sl, dict):
+        return [f"must be an object, got {type(sl).__name__}"]
+    errors = []
+    levels = sl.get("levels")
+    if not isinstance(levels, list) or not levels:
+        return errors + ["needs a non-empty 'levels' list"]
+    prev_c = 0
+    for i, lv in enumerate(levels):
+        where = f"levels[{i}]"
+        if not isinstance(lv, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        c = lv.get("concurrency")
+        if not isinstance(c, int) or isinstance(c, bool) or c < 1:
+            errors.append(f"{where}: concurrency must be an int >= 1, "
+                          f"got {c!r}")
+        elif c <= prev_c:
+            errors.append(f"{where}: ramp must be strictly ascending "
+                          f"({c} after {prev_c})")
+        else:
+            prev_c = c
+        q = lv.get("qps")
+        if not _is_num(q) or q <= 0:
+            errors.append(f"{where}: qps must be positive, got {q!r}")
+        pcts = [lv.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+        if not all(_is_num(p) for p in pcts):
+            errors.append(f"{where}: needs numeric p50/p95/p99_ms, "
+                          f"got {pcts!r}")
+        elif not (pcts[0] <= pcts[1] <= pcts[2]):
+            errors.append(f"{where}: percentiles must be ordered, "
+                          f"got {pcts!r}")
+    ki = sl.get("knee_index")
+    if not isinstance(ki, int) or isinstance(ki, bool) \
+            or not 0 <= ki < len(levels):
+        errors.append(f"knee_index {ki!r} outside the levels list")
+        return errors
+    knee = levels[ki] if isinstance(levels[ki], dict) else {}
+    for key, lkey in (("knee_concurrency", "concurrency"),
+                      ("qps_at_knee", "qps"),
+                      ("p99_at_knee_ms", "p99_ms")):
+        v, lv = sl.get(key), knee.get(lkey)
+        if _is_num(v) and _is_num(lv) and v != lv:
+            errors.append(f"{key}={v!r} disagrees with "
+                          f"levels[{ki}].{lkey}={lv!r}")
+    return errors
+
+
 def check_sharding_extra(sh) -> list:
     """Validate an `extra.sharding` BENCH section (bench.py BENCH_MESH
     runs): a positive mesh shape, a mode from the closed taxonomy, and
@@ -993,6 +1228,12 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.sharding: {e}"
                for e in check_sharding_extra(
                    (doc.get("extra") or {}).get("sharding"))]
+    errors += [f"extra.servescope: {e}"
+               for e in check_servescope_extra(
+                   (doc.get("extra") or {}).get("servescope"))]
+    errors += [f"extra.serve_load: {e}"
+               for e in check_serve_load_extra(
+                   (doc.get("extra") or {}).get("serve_load"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
@@ -1003,7 +1244,7 @@ def check_bench_json(path: str) -> list:
                 errors.append(f"extra.serving needs numeric {key!r}, "
                               f"got {serving.get(key)!r}")
         for key in ("rejected_queue_full", "rejected_deadline",
-                    "rejected_invalid"):
+                    "rejected_deadline_post_batch", "rejected_invalid"):
             if key in serving and not _is_num(serving[key]):
                 errors.append(f"extra.serving[{key!r}] must be numeric")
         hist = serving.get("latency_ms")
