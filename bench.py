@@ -222,6 +222,41 @@ def _healthmon_mark_step():
         hm._HM.step_end()
 
 
+# the run's CheckpointManager when BENCH_RESILIENCE=1 (closed and
+# reported as extra.resilience by _finish_profile)
+_RES_MGR = None
+
+
+def _bench_resilience_start(step):
+    """BENCH_RESILIENCE=1: arm async checkpointing (mxtpu.resilience)
+    over the steady phase — cadence BENCH_RESILIENCE_EVERY (default 20)
+    into BENCH_RESILIENCE_DIR (default a fresh temp dir) — so the BENCH
+    json carries extra.resilience: checkpoint cadence, save-cost
+    p50/p95, and any recovery accounting. The measured loop pays only
+    the boundary device→host copies; serialization stays on the
+    manager's worker thread (docs/resilience.md's cost model)."""
+    global _RES_MGR
+    if os.environ.get("BENCH_RESILIENCE", "0") != "1":
+        return None
+    import tempfile
+    from incubator_mxnet_tpu.resilience import CheckpointManager
+    d = os.environ.get("BENCH_RESILIENCE_DIR") or \
+        tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+    every = int(os.environ.get("BENCH_RESILIENCE_EVERY", "20"))
+    keep = int(os.environ.get("BENCH_RESILIENCE_KEEP", "3"))
+    _log(f"resilience armed: async checkpoints every {every} steps "
+         f"(keep {keep}) -> {d}")
+    _RES_MGR = CheckpointManager(d, step, every=every, keep=keep)
+    return _RES_MGR
+
+
+def _resilience_mark_step():
+    """One completed bench step/chunk boundary (no-op when resilience
+    is off — one predicate, the disabled-cost contract)."""
+    if _RES_MGR is not None:
+        _RES_MGR.maybe_save()
+
+
 def _bench_perfscope_start():
     """Arm roofline-aware cost capture (mxtpu.perfscope) for the run:
     every compile site (fused step, loop chunk, jit cache, serving
@@ -488,6 +523,14 @@ def _finish_profile(result, trace_path, **phase_s):
             if os.path.exists(p):
                 errors += checker(p)
                 result["extra"]["diag_" + name.split(".")[1]] = p
+    global _RES_MGR
+    if _RES_MGR is not None:
+        # drain the worker so the save histograms cover every enqueued
+        # checkpoint, then report cadence + cost + recovery accounting
+        from incubator_mxnet_tpu import resilience as _rs
+        _RES_MGR.close()
+        result["extra"]["resilience"] = _rs.bench_extra(_RES_MGR)
+        _RES_MGR = None
     from incubator_mxnet_tpu import healthmon as hm
     if hm.enabled():
         mon = hm.current()
@@ -1210,6 +1253,8 @@ def main():
                 f"mesh axis (BENCH_MESH={os.environ['BENCH_MESH']}); "
                 f"pick a divisible global batch")
 
+    _bench_resilience_start(step)
+
     # compile + warmup. NOTE: through the axon relay block_until_ready() does
     # not synchronize; a host value fetch is the only true barrier. Steps
     # chain through updated params, so fetching the final loss times them all.
@@ -1260,6 +1305,7 @@ def main():
                     xb, yb = next(pf)
                     losses = loop.run_chunk(xb, yb)
                     _healthmon_mark_step()   # one mark per dispatched chunk
+                    _resilience_mark_step()
                 loss_val = float(losses[loop_k - 1])    # host fetch = barrier
             dt = time.time() - t0
         if ds_win is not None:
@@ -1300,6 +1346,7 @@ def main():
                                 sync=lambda: float(losses[k - 1]),
                                 workload="train")
                 _healthmon_mark_step()     # one mark per dispatched chunk
+                _resilience_mark_step()
             loss_val = float(losses[k - 1])         # host fetch = barrier
         dt = time.time() - t0
         if ds_win is not None:
@@ -1325,6 +1372,7 @@ def main():
                                 sync=lambda: float(loss),
                                 workload="train")
                 _healthmon_mark_step()
+                _resilience_mark_step()
             loss_val = float(loss)
         dt = time.time() - t0
         if ds_win is not None:

@@ -59,6 +59,7 @@ from . import commscope
 from . import devicescope
 from . import servescope
 from . import serving
+from . import resilience
 from . import trainloop
 from .trainloop import TrainLoop
 from . import test_utils

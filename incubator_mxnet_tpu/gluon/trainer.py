@@ -195,7 +195,7 @@ class Trainer:
                  compression_params=None, update_on_kvstore=None,
                  overlap_comm=False, comm_bucket_bytes=0,
                  comm_credit_bytes=4 << 20, fused_update=None,
-                 loop_chunk=None, sharding=None):
+                 loop_chunk=None, sharding=None, resilience=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -267,6 +267,16 @@ class Trainer:
             raise ValueError(f"unknown sharding mode {sharding!r}; "
                              f"expected one of {_sharding_mod.MODES}")
         self.sharding = sharding
+        # resilience=<checkpoint dir> marks this trainer for SUPERVISED
+        # recovery (mxtpu.resilience, docs/resilience.md): TrainLoop.fit
+        # constructed from this Trainer checkpoints asynchronously into
+        # the directory, resumes from its manifest on restart, and rolls
+        # back on NaN instead of dying. Env default: MXTPU_RESILIENCE_DIR.
+        # The eager step()/update() path ignores it.
+        if resilience is None:
+            resilience = os.environ.get("MXTPU_RESILIENCE_DIR",
+                                        "").strip() or None
+        self.resilience = resilience
         self._kv_params_init = False
         self._sched = None
         if overlap_comm:

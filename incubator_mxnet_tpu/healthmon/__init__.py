@@ -61,7 +61,7 @@ from .skew import CollectiveTimeline
 from .watchdog import NaNSentinel, StepTimeRegression, StallWatchdog
 
 __all__ = ["HealthMonitor", "enable", "disable", "enabled", "current",
-           "observe_loss", "mark_step", "enable_from_env",
+           "observe_loss", "mark_step", "enable_from_env", "status",
            "EVENTS_SCHEMA", "events", "skew", "watchdog"]
 
 # module global: None = healthmon off (THE fast-path predicate; trainer/
@@ -212,6 +212,24 @@ class HealthMonitor:
         self.events.emit("alert", "healthmon." + name,
                          step=self.step if step is None else step,
                          args=args)
+        # verdict → action: a registered resilience supervisor acts on
+        # this alert (stall → supervised restart; docs/resilience.md).
+        # One predicate when no supervisor is armed — and the recovery
+        # policy's own failure must never mask the alert that fired it.
+        from .. import resilience as _resilience
+        if _resilience._RS is not None:
+            try:
+                _resilience.on_health_alert(
+                    name, args, step=self.step if step is None else step)
+            except SystemExit:
+                raise
+            except Exception as e:   # noqa: BLE001
+                _counter("healthmon.recovery_hook_errors",
+                         "healthmon").increment()
+                self.events.emit(
+                    "alert", "healthmon.recovery_hook_error",
+                    step=self.step if step is None else step,
+                    args={"error": f"{type(e).__name__}: {e}"[:300]})
 
     def _on_stall(self, age_s: float):
         """StallWatchdog callback: alert, then flush the flight ring with
@@ -395,6 +413,25 @@ def mark_step(kv=None, batch_size=None, loss=None):
     hm = _HM
     if hm is not None:
         hm.step_end(kv=kv, batch_size=batch_size, loss=loss)
+
+
+def status() -> dict:
+    """Operator-facing health summary: watchdog/sentinel counts plus —
+    because detection without action is an obituary — the resilience
+    block (who acts on the verdicts: last checkpoint step, recovery
+    totals, rollback-in-progress). Deep ``/healthz`` embeds this."""
+    from ..profiler.counters import counters as _snap
+    from .. import resilience as _resilience
+    c = _snap()
+    return {
+        "enabled": _HM is not None,
+        "steps": _HM.step if _HM is not None else None,
+        "stall_alerts": c.get("healthmon/healthmon.stall_alerts", 0),
+        "nan_alerts": c.get("healthmon/healthmon.nan_alerts", 0),
+        "step_time_regressions": c.get(
+            "healthmon/healthmon.step_time_regressions", 0),
+        "resilience": _resilience.status(),
+    }
 
 
 def enable_from_env():

@@ -82,19 +82,29 @@ class DevicePrefetcher:
     cycle     : on source exhaustion, reset() DataIter sources (or
                 re-iter iterables) and keep feeding — for step-driven
                 (rather than epoch-driven) loops.
+    skip      : discard the first N source batches before prefetching —
+                the data-cursor resume path (mxtpu.resilience): a
+                restarted run skips the batches its checkpoint manifest
+                records as consumed instead of replaying them. Skipped
+                batches never touch the device; counted as
+                ``io.batches_skipped``.
     """
 
     def __init__(self, source, depth=2, chunk=None, sharding=None,
-                 cycle=False):
+                 cycle=False, skip=0):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if chunk is not None and chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if skip < 0:
+            raise ValueError(f"skip must be >= 0, got {skip}")
         self._source = source
         self._depth = int(depth)
         self._chunk = int(chunk) if chunk else None
         self._sharding = sharding
         self._cycle = bool(cycle)
+        self._skip = int(skip)
+        self._epoch_len = None   # learned at the first source wrap
         self._buf = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._exhausted = False
@@ -114,11 +124,15 @@ class DevicePrefetcher:
         src = self._source
         while True:
             it = iter(src) if not hasattr(src, "next") else src
+            n = 0
             try:
                 for b in it:
+                    n += 1
                     yield b
             except StopIteration:
                 pass
+            if n and self._epoch_len is None:
+                self._epoch_len = n
             if not self._cycle:
                 return
             if hasattr(src, "reset"):
@@ -157,9 +171,33 @@ class DevicePrefetcher:
         try:
             pending = []
             n = self._chunk or 1
+            to_skip = self._skip
+            if to_skip:
+                c_skip = _prof.counter("io.batches_skipped", "io")
             for b in self._iter_source():
                 if self._stop.is_set():
                     return
+                if to_skip > 0:
+                    # cursor resume: already-consumed batches are
+                    # dropped host-side, before any conversion/transfer.
+                    # An ABSOLUTE cursor through a cycling source only
+                    # matters modulo the epoch: once the first wrap
+                    # teaches us the epoch length, whole epochs of the
+                    # remaining skip fold away instead of being read and
+                    # discarded — resume cost stays bounded by ~one
+                    # epoch of host reads however long the run was
+                    if self._cycle and self._epoch_len:
+                        to_skip %= self._epoch_len
+                        if to_skip == 0:
+                            pass   # fell exactly on a boundary: train b
+                        else:
+                            to_skip -= 1
+                            c_skip.increment()
+                            continue
+                    else:
+                        to_skip -= 1
+                        c_skip.increment()
+                        continue
                 pending.append(_split_batch(b))
                 if len(pending) < n:
                     continue

@@ -32,7 +32,9 @@ from .pipeline import pipeline_apply, spmd_pipeline
 from .moe import moe_gate, moe_ffn, MoEFFN
 from .tensor_parallel import (column_parallel, row_parallel,
                               annotate_bert_tp, annotate_ffn_tp)
-from .checkpoint import (save_train_step, restore_train_step, latest_step)
+from .checkpoint import (save_train_step, restore_train_step, latest_step,
+                         list_steps, verify_checkpoint, read_manifest,
+                         CorruptCheckpointError)
 
 __all__ = ["make_mesh", "data_parallel_spec", "FusedTrainStep",
            "sharding", "fsdp", "set_mesh", "get_mesh", "clear_mesh",

@@ -11,6 +11,25 @@ reassembles to the SAME shardings with no gather onto one host. A
 single-chip run uses the identical API/files (CPU coverage:
 tests/test_sharded_checkpoint.py's subprocess FSDP round trip).
 
+Durability contract (mxtpu.resilience rides this layer —
+docs/resilience.md):
+
+* **atomic visibility** — every save writes into a dot-prefixed temp
+  directory and renames it to ``step_<n>`` only after the payload AND
+  its manifest are on disk, so a crash mid-save can never leave a
+  directory that :func:`latest_step` would pick up. A torn write is
+  never a valid checkpoint.
+* **integrity manifest** — ``manifest.json`` (schema
+  ``mxtpu.ckpt-manifest/1``) records every payload file's size and
+  sha256 plus the step/cursor metadata. :func:`verify_checkpoint`
+  re-digests the directory; :func:`restore_train_step` verifies before
+  loading and, when the newest checkpoint is corrupt (bit-rot, a
+  truncated shard, an operator's stray ``rm``), FALLS BACK to the
+  previous good one — counted (``resilience.corrupt_checkpoints``) and
+  evented, never raised-and-dead and never silently loading a partial
+  tree. Pre-manifest checkpoints ("legacy") restore unverified for
+  backward compatibility.
+
 Usage::
 
     step = FusedTrainStep(net, loss, opt, mesh=mesh,
@@ -20,24 +39,39 @@ Usage::
     save_train_step(ckpt_dir, step)             # -> step_<num_update>/
 
     # resume in a fresh process: rebuild identically, compile once, then
-    step2(x, y)                                 # junk update, overwritten:
+    step2.ensure_built(x, y)                    # compile, no junk update
     restore_train_step(ckpt_dir, step2)         # params/states/num_update
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
+import shutil
+import time
 
-__all__ = ["save_train_step", "restore_train_step", "latest_step"]
+__all__ = ["save_train_step", "restore_train_step", "latest_step",
+           "list_steps", "verify_checkpoint", "read_manifest",
+           "CorruptCheckpointError", "MANIFEST_NAME", "MANIFEST_SCHEMA"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SCHEMA = "mxtpu.ckpt-manifest/1"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """An explicitly requested checkpoint failed integrity verification
+    (the latest-good path never raises this while an older good
+    checkpoint exists — it falls back)."""
 
 
 def _tree_of(step):
     if step.params is None:
         raise ValueError(
             "FusedTrainStep is not built yet — run one step (the compile "
-            "you need anyway) before save/restore")
+            "you need anyway) or ensure_built() before save/restore")
     # positional keys: gluon auto-names differ between process runs
     # (dense0 vs dense7), so identity is STRUCTURAL — the parameter order
     # of an identically built net (exactly gluon's structural
@@ -57,36 +91,219 @@ def _tree_of(step):
     return tree
 
 
-def save_train_step(directory, step, step_num=None):
-    """Write params + optimizer states + update counter under
-    ``directory/step_<n>``. Sharded arrays save shard-parallel; returns
-    the checkpoint path."""
+def _host_tree(step):
+    """The boundary copy: `_tree_of` snapshotted so the worker can
+    serialize it while training continues. This is the ONLY part of an
+    async save the training thread pays for — after it returns, the
+    live device buffers may be donated away by the next step. Every
+    leaf must therefore be an OWNED copy: on the CPU backend,
+    ``device_get``/``np.asarray`` of a host-resident buffer is
+    zero-copy, and the donated-in-place next step would mutate the
+    "snapshot" under the serializer (a checkpoint stamped step N holding
+    step N+k values — or NaN ones). Single-shard arrays copy to host
+    numpy; a SHARDED array snapshots as an on-device ``jnp.copy``
+    (sharding preserved) so orbax still saves it shard-parallel with no
+    gather onto one host — the standard async-checkpoint tradeoff of
+    one transient device-side copy per sharded leaf."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _own(x):
+        if np.isscalar(x):
+            return x
+        if isinstance(x, np.ndarray):
+            return np.array(x)
+        try:
+            sharded = (not x.is_fully_addressable) or len(x.devices()) > 1
+        except Exception:   # noqa: BLE001 — not a jax.Array
+            sharded = False
+        return jnp.copy(x) if sharded else np.array(x)
+
+    return jax.tree_util.tree_map(_own, _tree_of(step))
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+def _sha256_file(path, bufsize=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(bufsize)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _payload_files(path):
+    """Every regular file under the checkpoint dir except the manifest
+    itself, as sorted relative paths."""
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            rel = os.path.relpath(os.path.join(root, f), path)
+            if rel != MANIFEST_NAME:
+                out.append(rel)
+    return sorted(out)
+
+
+def _write_manifest(path, step_num, meta=None):
+    files = {}
+    for rel in _payload_files(path):
+        p = os.path.join(path, rel)
+        files[rel] = {"bytes": os.path.getsize(p),
+                      "sha256": _sha256_file(p)}
+    doc = {"schema": MANIFEST_SCHEMA, "step": int(step_num),
+           "saved_unix": time.time(), "files": files}
+    if meta:
+        doc["meta"] = dict(meta)
+    # manifest itself is written atomically (tmp + replace): readers of
+    # a COMPLETED checkpoint dir must never see a torn manifest either
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    return doc
+
+
+def read_manifest(path):
+    """The checkpoint's manifest dict, or None (legacy/pre-manifest
+    checkpoint or unreadable manifest — never raises)."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def verify_checkpoint(path):
+    """Integrity-check one checkpoint directory against its manifest.
+
+    Returns ``(status, errors)``: status is ``"ok"`` (every digest
+    matches), ``"legacy"`` (no manifest — pre-PR-12 checkpoint, accepted
+    unverified), or ``"corrupt"`` (manifest present but a payload file
+    is missing, resized, or fails its sha256 — i.e. a torn or bit-rotted
+    write). Never raises."""
+    if not os.path.isdir(path):
+        return "corrupt", [f"{path}: not a directory"]
+    man = read_manifest(path)
+    if man is None:
+        if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            return "corrupt", [f"{path}: unreadable manifest"]
+        return "legacy", []
+    files = man.get("files")
+    if not isinstance(files, dict):
+        return "corrupt", [f"{path}: manifest has no files table"]
+    errors = []
+    for rel, want in files.items():
+        p = os.path.join(path, rel)
+        if not os.path.isfile(p):
+            errors.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(p)
+        if size != want.get("bytes"):
+            errors.append(f"{rel}: size {size} != manifest "
+                          f"{want.get('bytes')}")
+            continue
+        digest = _sha256_file(p)
+        if digest != want.get("sha256"):
+            errors.append(f"{rel}: sha256 mismatch")
+    # files that appeared after the manifest are tolerated (orbax
+    # per-process temp leftovers); files that vanished are not
+    return ("corrupt", errors) if errors else ("ok", [])
+
+
+def _record_corrupt(path, errors):
+    """Corrupt-checkpoint fan-out: counter + flight breadcrumb +
+    structured event — the fallback must be observable, never silent."""
+    from ..profiler.counters import counter as _counter
+    _counter("resilience.corrupt_checkpoints", "resilience").increment()
+    args = {"path": path, "errors": [str(e)[:200] for e in errors[:4]]}
+    try:
+        from ..diagnostics import flight as _flight
+        if _flight._REC is not None:
+            _flight.record("alert", "resilience.corrupt_checkpoint", args)
+    except Exception:   # noqa: BLE001 — telemetry must not block recovery
+        pass
+    try:
+        from ..healthmon import events as _events
+        _events.emit("alert", "resilience.corrupt_checkpoint", args=args)
+    except Exception:   # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# save / restore
+# ---------------------------------------------------------------------------
+
+def _step_path(directory, n):
+    return os.path.join(os.path.abspath(directory), f"step_{n:08d}")
+
+
+def save_tree(directory, step_num, tree, meta=None):
+    """Write an already-materialized state tree (live jax arrays or the
+    host copy from :func:`_host_tree`) under ``directory/step_<n>``,
+    atomically: payload + manifest land in a dot-prefixed temp dir that
+    is renamed into place only when complete. Returns the checkpoint
+    path. This is the serialization half the async CheckpointManager
+    runs in its worker thread (resilience/checkpoint.py)."""
     import orbax.checkpoint as ocp
+    n = int(step_num)
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    final = _step_path(directory, n)
+    tmp = os.path.join(directory,
+                       f".tmp_step_{n:08d}.{os.getpid()}.{time.time_ns()}")
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(tmp, tree, force=True)
+        _write_manifest(tmp, n, meta=meta)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)     # atomic: same filesystem by construction
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def save_train_step(directory, step, step_num=None, cursor=None):
+    """Write params + optimizer states + update counter under
+    ``directory/step_<n>`` (atomic, manifested — see module docstring).
+    Sharded arrays save shard-parallel; returns the checkpoint path.
+    ``cursor`` (data batches consumed so far) rides in the manifest so a
+    resumed run can skip past them instead of replaying."""
     n = step._num_update if step_num is None else int(step_num)
-    path = os.path.join(os.path.abspath(directory), f"step_{n:08d}")
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, _tree_of(step), force=True)
-    return path
+    meta = {"num_update": int(n)}
+    if cursor is not None:
+        meta["cursor"] = int(cursor)
+    return save_tree(directory, n, _tree_of(step), meta=meta)
+
+
+def list_steps(directory):
+    """Completed checkpoint step numbers in `directory`, ascending (temp
+    dirs from in-flight or crashed saves are invisible by naming)."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(int(m.group(1)) for f in os.listdir(directory)
+                  if (m := _STEP_RE.match(f)))
 
 
 def latest_step(directory):
     """Highest step number checkpointed in `directory`, or None."""
-    if not os.path.isdir(directory):
-        return None
-    steps = [int(m.group(1)) for f in os.listdir(directory)
-             if (m := _STEP_RE.match(f))]
-    return max(steps) if steps else None
+    steps = list_steps(directory)
+    return steps[-1] if steps else None
 
 
-def restore_train_step(directory, step, step_num=None):
-    """Restore into a BUILT FusedTrainStep in place, preserving the live
-    arrays' shardings (ZeRO-1/tp layouts restore as laid out). Returns
-    the restored update counter."""
+def _restore_payload(path, step):
+    """Restore one verified checkpoint dir into a BUILT step in place."""
     import orbax.checkpoint as ocp
-    n = latest_step(directory) if step_num is None else int(step_num)
-    if n is None:
-        raise FileNotFoundError(f"no step_* checkpoints in {directory!r}")
-    path = os.path.join(os.path.abspath(directory), f"step_{n:08d}")
     from ..ndarray import random as ndrandom
     ndrandom._ensure_global_key()  # live tree must carry an rng slot
     live = _tree_of(step)
@@ -111,3 +328,53 @@ def restore_train_step(directory, step, step_num=None):
     step._num_update = int(restored["num_update"])
     step.optimizer.num_update = step._num_update
     return step._num_update
+
+
+def restore_train_step(directory, step, step_num=None):
+    """Restore into a BUILT FusedTrainStep in place, preserving the live
+    arrays' shardings (FSDP/ZeRO-1/tp layouts restore as laid out).
+
+    With ``step_num=None`` (restart-from-last-good): candidates are
+    tried newest-first; one that fails manifest verification — or whose
+    unverifiable legacy payload fails to load — is counted + evented and
+    SKIPPED in favor of the previous good checkpoint. An explicitly
+    requested ``step_num`` that is corrupt raises
+    :class:`CorruptCheckpointError` instead (the caller asked for that
+    exact state; silently substituting another would be worse than
+    failing). Returns the restored update counter."""
+    explicit = step_num is not None
+    if explicit:
+        candidates = [int(step_num)]
+    else:
+        candidates = list(reversed(list_steps(directory)))
+    if not candidates:
+        raise FileNotFoundError(f"no step_* checkpoints in {directory!r}")
+    tried = []
+    for n in candidates:
+        path = _step_path(directory, n)
+        status, errors = verify_checkpoint(path)
+        if status == "corrupt":
+            _record_corrupt(path, errors)
+            if explicit:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path} failed verification: "
+                    f"{'; '.join(errors[:3])}")
+            tried.append(n)
+            continue
+        if status == "ok":
+            # verified payload: a restore error here is a bug (schema
+            # drift, wrong net), not disk corruption — propagate
+            return _restore_payload(path, step)
+        try:
+            return _restore_payload(path, step)
+        except Exception as e:     # noqa: BLE001 — legacy (unverifiable)
+            # checkpoint failed to load: indistinguishable from a torn
+            # pre-manifest write, so treat as corrupt and fall back
+            if explicit:
+                raise
+            _record_corrupt(path, [f"legacy restore failed: "
+                                   f"{type(e).__name__}: {e}"])
+            tried.append(n)
+    raise CorruptCheckpointError(
+        f"every checkpoint in {directory!r} failed verification "
+        f"(tried steps {tried})")

@@ -369,6 +369,21 @@ class FusedTrainStep:
             vals[i] = self.optimizer.learning_rate
         return jnp.asarray(vals)
 
+    def ensure_built(self, x, y):
+        """Resolve parameters and compile from a shape probe WITHOUT
+        consuming an optimizer update. The restore path needs a BUILT
+        step (params resolved, states allocated); the old recipe — run
+        one junk update and let restore overwrite it — advanced
+        num_update and burned an RNG split, which a resumed stochastic
+        net would notice. Idempotent; returns self."""
+        if not isinstance(x, NDArray):
+            x = NDArray(x)
+        if not isinstance(y, NDArray):
+            y = NDArray(y)
+        if self._jitted is None:
+            self._resolve(x, y)
+        return self
+
     # -- execution --------------------------------------------------------
     def __call__(self, x, y):
         if not isinstance(x, NDArray):

@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import healthmon as _healthmon
 from .. import profiler as _prof
+from .. import resilience as _resilience
 from .. import servescope as _ss
 from .batcher import DynamicBatcher
 from .errors import InvalidInputError, ServingError
@@ -239,6 +240,11 @@ class ModelServer:
                 "healthmon/healthmon.stall_alerts", 0),
             "nan_alerts": snap.get("healthmon/healthmon.nan_alerts", 0),
         }
+        # resilience (who ACTS on those verdicts): checkpoint freshness,
+        # recovery totals, rollback-in-progress — report-only context
+        # like the healthmon block (a co-hosted training run mid-rollback
+        # is operator context, not an LB drop reason)
+        checks["healthmon"]["resilience"] = _resilience.status()
         # commscope's last resharding verdict per compiled bucket: an
         # accidental all-gather on the serve path is a per-request p99
         # catastrophe (docs/commscope.md). Report-only, like healthmon —

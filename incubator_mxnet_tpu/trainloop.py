@@ -121,6 +121,10 @@ class TrainLoop:
         # reads (per-step share = dispatch_ms delta / steps)
         self._c_dispatch = _prof.counter("trainloop.dispatch_ms",
                                          "trainloop")
+        # Trainer(..., resilience=dir) marks the setup for supervised
+        # recovery the same way loop_chunk marks it for whole-loop
+        # execution; fit() picks it up unless overridden per call
+        self._resilience_default = getattr(optimizer, "resilience", None)
         _prof.set_gauge("trainloop.k", self.chunk, "trainloop")
 
     # -- properties -------------------------------------------------------
@@ -179,7 +183,8 @@ class TrainLoop:
                      workload="train")
         return losses
 
-    def fit(self, data, steps=None, epochs=None, cycle=None):
+    def fit(self, data, steps=None, epochs=None, cycle=None,
+            skip_batches=0, resilience=None):
         """Drive the executor from a data source.
 
         data   : DataIter / iterable of DataBatch or (x, y) pairs.
@@ -189,9 +194,63 @@ class TrainLoop:
         epochs : alternatively, full passes over the source (chunk
                  remainders at each epoch tail are dropped — static
                  shapes can't take short chunks).
+        skip_batches : discard the first N source batches before
+                 training (the data-cursor resume path — a restarted
+                 run must not replay consumed batches).
+        resilience : arm mxtpu.resilience for this run — a
+                 ``resilience.Supervisor``, or a checkpoint-directory
+                 string (a default Supervisor is built on it); also
+                 picked up from ``Trainer(..., resilience=dir)`` /
+                 ``MXTPU_RESILIENCE_DIR`` (pass ``False`` to override
+                 that default off for one call). The run then
+                 checkpoints every N steps asynchronously, resumes from
+                 the manifest when the directory already holds
+                 checkpoints (restart-from-last-good), and rolls back +
+                 retries on a NaN loss instead of training on garbage
+                 (docs/resilience.md). Steps-driven only: an EXPLICIT
+                 resilience= on an epochs-driven call raises; the
+                 ambient Trainer/env default instead degrades that call
+                 to an unsupervised fit with a warning, so exporting
+                 MXTPU_RESILIENCE_DIR can never crash epoch-driven
+                 scripts that predate it.
 
         Returns the per-step losses as a numpy array — fetched ONCE at
-        the end; the loop itself never blocks on device values."""
+        the end (per CHUNK under resilience: the NaN check needs the
+        scalars); the loop itself never blocks on device values."""
+        from_default = False
+        if resilience is None:
+            resilience = self._resilience_default
+            from_default = resilience is not None
+        elif resilience is False:
+            resilience = None
+        if resilience is not None:
+            unsupervisable = (steps is None or epochs is not None
+                              or skip_batches)
+            if unsupervisable and from_default:
+                import warnings
+                warnings.warn(
+                    "resilience armed by Trainer/MXTPU_RESILIENCE_DIR "
+                    "but this fit() is epochs-driven or passes "
+                    "skip_batches — supervision needs steps= only; "
+                    "running UNSUPERVISED (no checkpoints, no recovery) "
+                    "for this call", stacklevel=2)
+            else:
+                from .resilience import Supervisor
+                sup = (resilience if isinstance(resilience, Supervisor)
+                       else Supervisor(str(resilience)))
+                if steps is None or epochs is not None:
+                    raise ValueError(
+                        "resilient fit is steps-driven: pass steps= only "
+                        "(epoch accounting does not survive a mid-epoch "
+                        "restart)")
+                if skip_batches:
+                    raise ValueError(
+                        "skip_batches is incompatible with resilience=: "
+                        "the resume cursor from the checkpoint manifest "
+                        "owns batch skipping, and a second offset would "
+                        "silently double- or under-train the data")
+                return sup.drive(self, data, steps=steps,
+                                 cycle=True if cycle is None else cycle)
         if (steps is None) == (epochs is None):
             raise ValueError("pass exactly one of steps= or epochs=")
         histories = []
@@ -202,7 +261,8 @@ class TrainLoop:
                     f"steps={steps} is less than one chunk of "
                     f"{self.chunk}; lower loop_chunk or raise steps")
             cycle = True if cycle is None else cycle
-            with self._prefetcher(data, cycle=cycle) as pf:
+            with self._prefetcher(data, cycle=cycle,
+                                  skip=skip_batches) as pf:
                 for i in range(n_chunks):
                     try:
                         xs, ys = next(pf)
@@ -224,7 +284,9 @@ class TrainLoop:
                 if hasattr(data, "reset"):
                     data.reset()
                 n_before = len(histories)
-                with self._prefetcher(data, cycle=False) as pf:
+                with self._prefetcher(data, cycle=False,
+                                      skip=skip_batches if e == 0
+                                      else 0) as pf:
                     for xs, ys in pf:
                         self._check_labeled(ys)
                         histories.append(self.run_chunk(xs, ys))
@@ -249,9 +311,10 @@ class TrainLoop:
                 "DataBatch with labels); got a label-less batch — for "
                 "self-supervised inputs yield (x, x)")
 
-    def _prefetcher(self, data, cycle):
+    def _prefetcher(self, data, cycle, skip=0):
         # the stacked-batch sharding only exists after the first build;
         # hand the prefetcher a late-bound getter instead of a value
         return DevicePrefetcher(
             data, depth=self.prefetch_depth, chunk=self.chunk,
-            sharding=lambda: self.step._stacked_sharding, cycle=cycle)
+            sharding=lambda: self.step._stacked_sharding, cycle=cycle,
+            skip=skip)

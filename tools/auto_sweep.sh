@@ -75,6 +75,15 @@ if timeout 1200 bash tools/servescope_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) servescope smoke FAILED (continuing; serving attribution suspect)" >> "$LOG"
 fi
+# resilience smoke (CPU-only chaos harness + resilient bench): NaN
+# rollback, torn-checkpoint fallback, stall restart, and elastic
+# rank kill/re-join must all SELF-HEAL with the recovery on every
+# telemetry surface before any long run is trusted to survive one
+if timeout 1800 bash tools/resilience_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) resilience smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) resilience smoke FAILED (continuing; self-healing suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

@@ -762,6 +762,145 @@ def print_merged(merged, tail=0) -> None:
               f"{r['kind']:<10} {r['name']}{step}{args}")
 
 
+# event names that ARE faults (detection) vs recovery ACTIONS — the
+# join mxdiag recover renders: healthmon detects, resilience acts
+# (docs/observability.md's "who acts on which verdict" column)
+_RECOVER_FAULTS = ("healthmon.nan_loss", "healthmon.nan_grad_norm",
+                   "healthmon.stall", "healthmon.step_time_regression",
+                   "resilience.corrupt_checkpoint",
+                   "resilience.save_error", "resilience.escalation")
+_RECOVER_ACTIONS = ("resilience.rollback", "resilience.resume",
+                    "resilience.restart_requested",
+                    "resilience.rank_departed", "resilience.rank_joined")
+
+
+def print_recover(merged) -> int:
+    """Render the recovery timeline from a merged (or single-rank)
+    mxtpu.events/1 stream: fault detected → rollback/restart → steps
+    replayed → converged, healthmon alerts joined to resilience actions
+    by run_id/step."""
+    if not merged:
+        print("recover: no records")
+        return 1
+    t0 = merged[0]["ts"]
+    faults = [r for r in merged if r["name"] in _RECOVER_FAULTS]
+    actions = [r for r in merged if r["name"] in _RECOVER_ACTIONS]
+    saves = [r for r in merged
+             if r["name"] == "resilience.checkpoint_saved"]
+    steps = [r["step"] for r in merged
+             if r["kind"] == "trainer" and r.get("step") is not None]
+    run_ids = sorted({r.get("run_id") for r in merged if r.get("run_id")})
+    print(f"recovery timeline: run_id={run_ids or ['?']}  "
+          f"{len(faults)} fault(s), {len(actions)} recovery action(s), "
+          f"{len(saves)} checkpoint(s)")
+    if not faults and not actions:
+        print("  clean run: no faults detected, no recoveries "
+              "(checkpoints below are pure insurance)")
+    rows = sorted(faults + actions + saves, key=lambda r: r["ts"])
+    for r in rows:
+        a = r.get("args") or {}
+        if r["name"] in _RECOVER_FAULTS:
+            tag = "FAULT "
+            detail = json.dumps(a) if a else ""
+        elif r["name"] == "resilience.checkpoint_saved":
+            tag = "ckpt  "
+            detail = (f"step {r.get('step')} "
+                      f"({a.get('save_ms', '?')} ms async)")
+        else:
+            tag = "ACTION"
+            if r["name"] == "resilience.rollback":
+                detail = (f"step {a.get('from_step')} -> "
+                          f"{a.get('to_step')} "
+                          f"({a.get('steps_lost')} step(s) replayed, "
+                          f"attempt {a.get('attempt')}, "
+                          f"reason={a.get('reason')})")
+            elif r["name"] == "resilience.resume":
+                detail = (f"restored step {a.get('restored_step')}, "
+                          f"cursor {a.get('cursor')} (restart-from-"
+                          f"last-good)")
+            elif r["name"] == "resilience.rank_departed":
+                detail = (f"departed={a.get('departed')} -> members "
+                          f"{a.get('members')} (re-formed at smaller "
+                          f"world)")
+            elif r["name"] == "resilience.rank_joined":
+                detail = (f"joined={a.get('joined') or [a.get('rank')]} "
+                          f"-> members {a.get('members')}")
+            else:
+                detail = json.dumps(a) if a else ""
+        step = f" step={r['step']}" if r.get("step") is not None else ""
+        print(f"  {r['ts'] - t0:>9.3f}s  [rank {r['rank']}] {tag} "
+              f"{r['name']}{step}  {detail}")
+    # fault -> first following action join, restricted to action kinds
+    # that plausibly ANSWER that fault class (an unrelated later
+    # rank_joined must not mark an un-acted-on NaN as handled)
+    fault_answers = {
+        "healthmon.nan_loss": ("resilience.rollback", "resilience.resume"),
+        "healthmon.nan_grad_norm": ("resilience.rollback",
+                                    "resilience.resume"),
+        "healthmon.stall": ("resilience.restart_requested",
+                            "resilience.resume"),
+        "resilience.corrupt_checkpoint": ("resilience.resume",
+                                          "resilience.rollback"),
+        # retries exhausted: only a later process-level resume counts
+        "resilience.escalation": ("resilience.resume",),
+    }
+    unhandled = []
+    for fz in faults:
+        answers = fault_answers.get(fz["name"])
+        nxt = next((az for az in actions if az["ts"] >= fz["ts"]
+                    and (answers is None or az["name"] in answers)), None)
+        # regressions are advisory, and a failed ASYNC save is tolerated
+        # by design (degraded durability, training continues) — neither
+        # demands a recovery action after it
+        if nxt is None and fz["name"] not in (
+                "healthmon.step_time_regression",
+                "resilience.save_error"):
+            unhandled.append(fz)
+    last_action_ts = max((az["ts"] for az in actions), default=None)
+    tail_steps = [s for r in merged
+                  if r["kind"] == "trainer" and r.get("step") is not None
+                  and (last_action_ts is None or r["ts"] > last_action_ts)
+                  for s in [r["step"]]]
+    lost = sum(int((r.get("args") or {}).get("steps_lost") or 0)
+               for r in actions if r["name"] == "resilience.rollback")
+    print(f"summary: rollbacks="
+          f"{sum(r['name'] == 'resilience.rollback' for r in actions)} "
+          f"resumes={sum(r['name'] == 'resilience.resume' for r in actions)} "
+          f"departures="
+          f"{sum(r['name'] == 'resilience.rank_departed' for r in actions)} "
+          f"joins="
+          f"{sum(r['name'] == 'resilience.rank_joined' for r in actions)} "
+          f"steps_replayed={lost}")
+    if steps:
+        post = (f", {len(tail_steps)} step(s) after the last recovery"
+                if last_action_ts is not None else "")
+        print(f"  progress: trained to step {max(steps)}{post} — "
+              f"the run OUTLIVED its faults" if actions else
+              f"  progress: trained to step {max(steps)}")
+    if unhandled:
+        print(f"  << UNHANDLED: {len(unhandled)} fault(s) with no "
+              f"recovery action after them: "
+              f"{[r['name'] for r in unhandled][:4]}")
+        return 1
+    return 0
+
+
+def _recover_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py recover",
+        description="render the fault -> recovery timeline from "
+                    "mxtpu.events/1 logs (per-rank or merged)")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl files (and/or flight dumps)")
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_timelines(args.paths)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"recover: {e}", file=sys.stderr)
+        return 1
+    return print_recover(merged)
+
+
 def _merge_main(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="mxdiag.py merge",
@@ -798,6 +937,8 @@ def main(argv=None) -> int:
         return _device_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "recover":
+        return _recover_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

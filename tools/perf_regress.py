@@ -139,6 +139,18 @@ def load_artifact(path):
     kc = sl.get("knee_concurrency") if isinstance(sl, dict) else None
     rec["knee_concurrency"] = (int(kc) if isinstance(kc, int)
                                and not isinstance(kc, bool) else None)
+    # resilience accounting (extra.resilience): a RECOVERED run's BENCH
+    # is USABLE — the measured throughput is real — but the recovery
+    # cost (steps lost to rollbacks) must be reported, never hidden;
+    # compare() notes it alongside the perf verdicts
+    rx = extra.get("resilience") or {}
+    rv = rx.get("recoveries_total") if isinstance(rx, dict) else None
+    rec["recoveries"] = (int(rv) if isinstance(rv, (int, float))
+                         and not isinstance(rv, bool) else None)
+    sl_tot = rx.get("steps_lost_total") if isinstance(rx, dict) else None
+    rec["steps_lost"] = (int(sl_tot)
+                         if isinstance(sl_tot, (int, float))
+                         and not isinstance(sl_tot, bool) else None)
     return rec, None
 
 
@@ -270,6 +282,15 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         else:
             notes.append(f"note: candidate carries {cr} resharding "
                          f"collective(s) (not new vs baseline)")
+    for side, rec in (("candidate", candidate), ("baseline", baseline)):
+        recov = rec.get("recoveries")
+        if recov:
+            lost = rec.get("steps_lost")
+            notes.append(
+                f"note: {side} RECOVERED {recov} time(s)"
+                + (f", {lost} step(s) lost to rollbacks" if lost else "")
+                + " — run usable (throughput is real), recovery cost "
+                  "tracked here so it is never hidden")
     return regressions, notes
 
 

@@ -49,7 +49,8 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_healthmon_kinds", "check_perfscope_extra",
            "check_commscope_extra", "check_devicescope_extra",
            "check_servescope_extra", "check_serve_load_extra",
-           "check_sharding_extra", "check_file"]
+           "check_sharding_extra", "check_resilience_extra",
+           "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -66,6 +67,7 @@ HEALTHMON_FAMILIES = {
     "healthmon/healthmon.step_time_regressions": "counter",
     "healthmon/healthmon.straggler_flags": "counter",
     "healthmon/healthmon.exchange_errors": "counter",
+    "healthmon/healthmon.recovery_hook_errors": "counter",
     "healthmon/healthmon.collective_skew_ms": "gauge",
     "healthmon/healthmon.slowest_rank": "gauge",
     "healthmon/healthmon.step_ms_ewma": "gauge",
@@ -78,6 +80,7 @@ HEALTHMON_FAMILIES = {
 # table (docs/trainloop.md documents each metric).
 IO_TRAINLOOP_FAMILIES = {
     "io/io.batches_prefetched": "counter",
+    "io/io.batches_skipped": "counter",
     "io/io.wait_ms": "counter",
     "io/io.put_ms": "counter",
     "io/io.depth": "gauge",
@@ -198,6 +201,32 @@ SERVESCOPE_FAMILIES = {
     "servescope/servescope.pad_overhead_ms": "histogram",
     "servescope/servescope.device_exec_ms": "histogram",
     "servescope/servescope.respond_ms": "histogram",
+}
+
+# The resilience.* (elastic self-healing training) metric families
+# (docs/resilience.md): checkpoint lifecycle counters, recovery
+# accounting, and the save-cost histograms the BENCH extra.resilience
+# percentiles read. Same schema-stability contract as every other
+# family table.
+RESILIENCE_FAMILIES = {
+    "resilience/resilience.checkpoints_saved": "counter",
+    "resilience/resilience.checkpoints_pruned": "counter",
+    "resilience/resilience.saves_skipped": "counter",
+    "resilience/resilience.save_errors": "counter",
+    "resilience/resilience.corrupt_checkpoints": "counter",
+    "resilience/resilience.recoveries_total": "counter",
+    "resilience/resilience.rollbacks": "counter",
+    "resilience/resilience.resumes": "counter",
+    "resilience/resilience.steps_lost_total": "counter",
+    "resilience/resilience.retries_exhausted": "counter",
+    "resilience/resilience.restarts_requested": "counter",
+    "resilience/resilience.rank_departures": "counter",
+    "resilience/resilience.rank_joins": "counter",
+    "resilience/resilience.last_checkpoint_step": "gauge",
+    "resilience/resilience.rollback_in_progress": "gauge",
+    "resilience/resilience.steps_lost_last": "gauge",
+    "resilience/resilience.copy_ms": "histogram",
+    "resilience/resilience.save_ms": "histogram",
 }
 
 # the closed request-latency component taxonomy an `extra.servescope`
@@ -373,6 +402,8 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("devicescope/", DEVICESCOPE_FAMILIES,
                "DEVICESCOPE_FAMILIES"),
               ("servescope/", SERVESCOPE_FAMILIES, "SERVESCOPE_FAMILIES"),
+              ("resilience/", RESILIENCE_FAMILIES,
+               "RESILIENCE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -1188,6 +1219,61 @@ def check_sharding_extra(sh) -> list:
 # bench result JSON (BENCH_*.json with serving stats)
 # ---------------------------------------------------------------------------
 
+def check_resilience_extra(rx) -> list:
+    """Validate a BENCH `extra.resilience` block (resilience.bench_extra):
+    recovery accounting must be numeric and non-negative, the save/copy
+    cost blocks must carry ordered percentiles, and a recovery count
+    implies a rollback/resume trail (a recovered run is USABLE but its
+    cost must be visible — perf_regress notes it, never hides it)."""
+    if rx is None:
+        return []
+    if not isinstance(rx, dict):
+        return ["must be an object"]
+    errors = []
+    for key in ("checkpoints_saved", "recoveries_total", "rollbacks",
+                "steps_lost_last", "steps_lost_total"):
+        v = rx.get(key)
+        if not _is_num(v):
+            errors.append(f"needs numeric {key!r}, got {v!r}")
+        elif v < 0:
+            errors.append(f"{key}={v} negative")
+    lcs = rx.get("last_checkpoint_step")
+    if lcs is not None and not _is_num(lcs):
+        errors.append(f"last_checkpoint_step must be numeric or null, "
+                      f"got {lcs!r}")
+    for blk in ("save", "copy"):
+        b = rx.get(blk)
+        if b is None:
+            continue
+        if not isinstance(b, dict):
+            errors.append(f"{blk} block must be an object or null")
+            continue
+        if not _is_num(b.get("count")) or b["count"] < 0:
+            errors.append(f"{blk}.count must be numeric >= 0, "
+                          f"got {b.get('count')!r}")
+        p50, p95 = b.get("p50_ms"), b.get("p95_ms")
+        for k, v in (("p50_ms", p50), ("p95_ms", p95)):
+            if v is not None and not _is_num(v):
+                errors.append(f"{blk}.{k} must be numeric or null")
+        if _is_num(p50) and _is_num(p95) and p50 > p95:
+            errors.append(f"{blk} percentiles out of order "
+                          f"(p50={p50} > p95={p95})")
+    if _is_num(rx.get("every")) and rx["every"] < 0:
+        errors.append(f"every={rx['every']} negative")
+    if _is_num(rx.get("keep")) and rx["keep"] < 1:
+        errors.append(f"keep={rx['keep']} < 1")
+    if _is_num(rx.get("recoveries_total")) and rx["recoveries_total"] > 0:
+        trail = sum(rx.get(k, 0) or 0
+                    for k in ("rollbacks", "resumes", "rank_departures")
+                    if _is_num(rx.get(k)))
+        if trail == 0:
+            errors.append(
+                f"recoveries_total={rx['recoveries_total']} with no "
+                f"rollback/resume/departure trail — a recovery must say "
+                f"what it was")
+    return errors
+
+
 def check_bench_json(path: str) -> list:
     """Validate a bench.py result line/file. Core keys always; when the
     run was the serving benchmark, its `extra.serving` section must carry
@@ -1234,6 +1320,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.serve_load: {e}"
                for e in check_serve_load_extra(
                    (doc.get("extra") or {}).get("serve_load"))]
+    errors += [f"extra.resilience: {e}"
+               for e in check_resilience_extra(
+                   (doc.get("extra") or {}).get("resilience"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
