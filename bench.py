@@ -41,6 +41,10 @@ RESNET50_FLOPS_PER_SAMPLE = 3 * 4.09e9   # fwd+bwd, 224x224 (both benches)
 # updated once the model is resolved; all error paths report through this
 _CURRENT_METRIC = "resnet50_imagenet_images_per_sec_per_chip"
 
+# process start, for fitting the autotune search inside the hard
+# watchdog (armed against the same clock in main())
+_BENCH_T0 = time.time()
+
 
 class _PhaseTimeout(Exception):
     pass
@@ -332,49 +336,94 @@ def _bench_mesh():
     """BENCH_MESH=dp4|dp2mp2|fsdp4|…: register a process-global device
     mesh (mxtpu.sharding) so the steady phase runs through the SHARDED
     executor — one jit whose in/out shardings carry the resolved
-    per-param NamedShardings, XLA inserting the collectives. Token
-    grammar: concatenated <axis><size> pairs (`dp2mp2` = 2×2); the
-    `fsdp` pseudo-axis names the data axis AND selects zero-style
-    param/state sharding. A layout with an `mp` axis runs mode='auto'
-    (Dense kernels / Embedding tables onto mp via the default rule
-    table). Returns the sharding mode, or None when BENCH_MESH is
-    unset. On CPU pair with XLA_FLAGS=--xla_force_host_platform_
-    device_count=N (tools/shard_smoke.sh does)."""
-    spec = os.environ.get("BENCH_MESH", "").strip()
-    if not spec:
-        return None
-    import re as _re
+    per-param NamedShardings, XLA inserting the collectives. The token
+    grammar (concatenated <axis><size> pairs, the `fsdp` pseudo-axis,
+    the model-axis → mode='auto' rule) lives in autotune.knobs.
+    parse_mesh — ONE home, shared with the trial runner — and the spec
+    itself resolves through the knob table (BENCH_MESH > MXTPU_MESH >
+    cached tuning winner). Returns the sharding mode, or None when no
+    mesh is configured. On CPU pair with
+    XLA_FLAGS=--xla_force_host_platform_device_count=N
+    (tools/shard_smoke.sh does)."""
+    from incubator_mxnet_tpu.autotune import knobs as _knobs
     from incubator_mxnet_tpu.parallel import make_mesh
     from incubator_mxnet_tpu.parallel import sharding as _shmod
-    toks = _re.findall(r"([a-z]+)(\d+)", spec)
-    if not toks or "".join(f"{n}{s}" for n, s in toks) != spec:
-        raise ValueError(f"BENCH_MESH={spec!r}: expected concatenated "
-                         f"axis-size tokens (dp4, dp2mp2, fsdp4)")
-    mode, axes = "dp", {}
-    for name, size in toks:
-        if name == "fsdp":
-            mode, name = "fsdp", "dp"
-        if name in axes:
-            # dp2dp2 / fsdp2dp2 would silently keep only the last size —
-            # half the requested devices idle with no error
-            raise ValueError(f"BENCH_MESH={spec!r}: axis {name!r} given "
-                             f"more than once")
-        axes[name] = int(size)
-    if any(a in axes for a in _shmod.MODEL_AXES):
-        if mode == "fsdp":
-            # fsdp leaves the bench net unannotated, so an mp axis would
-            # just compute redundantly on every mp rank — reject rather
-            # than silently waste half the requested devices
-            raise ValueError(
-                f"BENCH_MESH={spec!r}: fsdp with a model axis is not "
-                f"supported by the bench driver (the bench net carries "
-                f"no model-axis annotations); use dp2mp2-style layouts")
-        mode = "auto"
+    spec = _knobs.resolve("mesh")[0]
+    if not spec:
+        return None
+    mode, axes = _knobs.parse_mesh(spec)
     mesh = make_mesh(axes)
     _shmod.set_mesh(mesh)
     _log(f"sharding: mesh {dict(mesh.shape)} mode={mode} over "
          f"{mesh.size} of {len(jax.devices())} devices")
     return mode
+
+
+def _bench_autotune(model, batch, dtype):
+    """MXTPU_AUTOTUNE=1: resolve the tuning cache for this
+    (model, mesh, device-kind) key — hit: the stored winner's knobs
+    install as the below-env defaults with ZERO trials; miss: a bounded
+    search runs first (each trial a short bench.py SUBPROCESS —
+    docs/autotune.md's cost model), the winner installs and persists.
+    Explicit BENCH_*/MXTPU_* overrides still beat the winner (the knob
+    precedence), so the tuner can never reinterpret a human A/B run.
+    Returns the `extra.autotune` payload; the disabled shape
+    ({"enabled": false}) when unarmed, so every training BENCH json
+    carries a validatable section either way."""
+    from incubator_mxnet_tpu import autotune as at
+    if not at.enabled():
+        return at.bench_extra(None)
+    data_mode = os.environ.get("BENCH_DATA", "synthetic")
+    if data_mode not in ("", "synthetic"):
+        # the trial runner pins BENCH_* per trial (BENCH_DATA included),
+        # so every search trial would measure the SYNTHETIC input path
+        # while this run is the JPEG-decode path — input starvation is
+        # exactly what data mode changes — and the cache key carries no
+        # data-mode leg, so the wrong winner would then poison the
+        # synthetic key too. Run untuned rather than tune the wrong
+        # workload; the record says why.
+        _log(f"autotune: BENCH_DATA={data_mode} runs the record input "
+             f"path but search trials measure the synthetic path — "
+             f"running UNTUNED (data-path trials not supported yet)")
+        return {"enabled": True, "cache_hit": False, "trials": 0,
+                "trials_failed": 0, "trials_pruned": 0,
+                "winner": None, "score": None,
+                "error": f"BENCH_DATA={data_mode}: data-path trials "
+                         f"not supported"}
+    mesh = at.knobs.resolve("mesh")[0]
+    # a cache-miss search must FIT inside the bench's hard watchdog:
+    # budget x per-trial timeout can exceed the horizon (6 x 900 s >
+    # the default 3300 s), and the watchdog os._exit()s mid-search with
+    # nothing cached. Clamp the per-trial timeout so the worst-case
+    # search leaves ~600 s for the measured run itself; an explicit
+    # MXTPU_AUTOTUNE_TRIAL_TIMEOUT is clamped too (and says so) — a
+    # finished cheap search beats a killed thorough one.
+    budget = int(os.environ.get("MXTPU_AUTOTUNE_BUDGET", "6"))
+    want_timeout = int(os.environ.get("MXTPU_AUTOTUNE_TRIAL_TIMEOUT",
+                                      "900"))
+    hard = int(os.environ.get("BENCH_HARD_TIMEOUT", "3300"))
+    elapsed = time.time() - _BENCH_T0
+    fit_timeout = max(60, int((hard - elapsed - 600) / max(1, budget)))
+    trial_timeout = min(want_timeout, fit_timeout)
+    if trial_timeout < want_timeout * 0.9:
+        _log(f"autotune: per-trial timeout clamped {want_timeout}s -> "
+             f"{trial_timeout}s so {budget} trials fit inside the "
+             f"BENCH_HARD_TIMEOUT={hard}s watchdog (raise it, or lower "
+             f"MXTPU_AUTOTUNE_BUDGET, for longer trials)")
+    _log(f"autotune armed: model={model} batch={batch} dtype={dtype} "
+         f"mesh={mesh}")
+    try:
+        result = at.ensure_tuned(model=model, batch=batch, dtype=dtype,
+                                 mesh=mesh, budget=budget,
+                                 trial_timeout=trial_timeout, log=_log)
+    except Exception as e:  # noqa: BLE001 — tuning is advisory: a
+        _log(f"autotune failed ({type(e).__name__}: {e}); "  # broken
+             "running untuned")                # tuner must not cost the
+        return {"enabled": True, "cache_hit": False,   # measured run
+                "trials": 0, "trials_failed": 0, "trials_pruned": 0,
+                "winner": None, "score": None,
+                "error": f"{type(e).__name__}: {e}"[:200]}
+    return at.bench_extra(result)
 
 
 def _perfscope_budget(steps_per_dispatch=1):
@@ -717,6 +766,12 @@ _BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
                  "lenet": _build_lenet, "ssd": _build_ssd,
                  "transformer_lm": _build_transformer_lm}
 
+# per-model default global batch — the ONE home (tools/perf_sweep.py
+# imports it for cache-key fingerprints: a row without an explicit
+# BENCH_BATCH ran at THIS batch, and the tuning-cache key must say so)
+DEFAULT_BATCH = {"resnet50": 128, "bert": 32, "lenet": 512, "ssd": 16,
+                 "transformer_lm": 16, "serving": 1}
+
 
 def _mfu(samples_per_s, flops_per_sample, dtype):
     """Model FLOPs utilization: achieved model FLOP/s over the device's
@@ -1004,8 +1059,10 @@ def _record_data_bench(mode, batch, steps, dtype):
     L = gluon.loss.SoftmaxCrossEntropyLoss()
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
                               wd=1e-4, multi_precision=(dtype == "bfloat16"))
-    step = FusedTrainStep(net, L, opt,
-                          remat=os.environ.get("BENCH_REMAT") == "1")
+    from incubator_mxnet_tpu.autotune import knobs as _knobs
+    _kc = _knobs.KnobConfig.from_env()
+    step = FusedTrainStep(net, L, opt, remat=_kc.remat,
+                          remat_policy=_kc.remat_policy)
 
     threads = int(os.environ.get("BENCH_DECODE_THREADS", "4"))
     def make_iter():
@@ -1123,13 +1180,12 @@ def main():
         raise ValueError(f"unknown BENCH_MODEL {model!r}; choose from "
                          f"{sorted(_BENCH_MODELS) + ['serving']}")
     try:
-        default_batch = {"resnet50": "128", "bert": "32", "lenet": "512",
-                         "ssd": "16", "transformer_lm": "16",
-                         "serving": "1"}[model]
+        default_batch = DEFAULT_BATCH[model]
     except KeyError:
         raise ValueError(f"BENCH_MODEL {model!r} has no default batch; "
                          f"set BENCH_BATCH explicitly")
-    batch = int(os.environ.get("BENCH_BATCH", default_batch))
+    from incubator_mxnet_tpu.autotune import knobs as _knobs
+    batch = int(_knobs.resolve("batch")[0] or default_batch)
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
@@ -1189,6 +1245,13 @@ def main():
         _log("commscope armed (collective inventory + resharding detector)")
     if _bench_devicescope_start() is not None:
         _log("devicescope armed (windowed device-timeline capture)")
+    # MXTPU_AUTOTUNE=1: resolve the tuning cache / run the bounded
+    # search BEFORE the mesh registers and the knobs resolve below —
+    # the winner installs as the below-env default layer, so everything
+    # from loop_chunk to the mesh spec starts tuned on a cache hit
+    autotune_extra = None
+    if model != "serving":
+        autotune_extra = _bench_autotune(model, batch, dtype)
     # BENCH_MESH: register the global mesh BEFORE model build so param
     # init and the executor resolve against it
     shard_mode = _bench_mesh()
@@ -1213,6 +1276,10 @@ def main():
                 f"BENCH_DATA={data_mode} supports BENCH_MODEL=resnet50 "
                 f"only (the JPEG input path), got {model!r}")
         result = _record_data_bench(data_mode, batch, steps, dtype)
+        if autotune_extra is not None:
+            autotune_extra["resolved"] = \
+                _knobs.KnobConfig.from_env().to_dict()
+            result.setdefault("extra", {})["autotune"] = autotune_extra
         watchdog.cancel()
         print(json.dumps(result))
         return
@@ -1225,23 +1292,30 @@ def main():
                                                                    dtype)
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
                               multi_precision=(dtype == "bfloat16"))
-    # BENCH_LOOP_CHUNK / MXTPU_LOOP_CHUNK > 1: steady phase runs through
-    # the whole-loop executor (mxtpu.trainloop) — N micro-steps per
-    # dispatch, device-side double-buffered prefetch, per-micro-step lr;
-    # the io.* / trainloop.* counter families land in extra.counters.
-    loop_k = int(os.environ.get("BENCH_LOOP_CHUNK",
-                                os.environ.get("MXTPU_LOOP_CHUNK", "0"))
-                 or "0")
+    # knob resolution through the ONE table (autotune.knobs): call-site
+    # > BENCH_* > MXTPU_* > cached tuning winner > default. loop_chunk
+    # > 1 runs the steady phase through the whole-loop executor
+    # (mxtpu.trainloop) — N micro-steps per dispatch, device-side
+    # double-buffered prefetch, per-micro-step lr; the io.*/trainloop.*
+    # counter families land in extra.counters.
+    knob_cfg = _knobs.KnobConfig.from_env()
+    if autotune_extra is not None:
+        # what the run ACTUALLY resolved to (env overrides beat the
+        # tuner) — the config perf_regress compares across artifacts
+        autotune_extra["resolved"] = knob_cfg.to_dict()
+    loop_k = knob_cfg.loop_chunk
     loop = None
     if loop_k > 1:
         from incubator_mxnet_tpu.trainloop import TrainLoop
         loop = TrainLoop(net, L, opt, chunk=loop_k,
-                         remat=os.environ.get("BENCH_REMAT") == "1",
+                         remat=knob_cfg.remat,
+                         remat_policy=knob_cfg.remat_policy,
                          sharding=shard_mode)
         step = loop.step
     else:
         step = FusedTrainStep(net, L, opt,
-                              remat=os.environ.get("BENCH_REMAT") == "1",
+                              remat=knob_cfg.remat,
+                              remat_policy=knob_cfg.remat_policy,
                               sharding=shard_mode)
     if shard_mode is not None:
         from incubator_mxnet_tpu.parallel import sharding as _shmod
@@ -1414,6 +1488,11 @@ def main():
         # per-param spec counts, fsdp on/off, per-device bytes
         from incubator_mxnet_tpu.parallel import sharding as _shmod
         result["extra"]["sharding"] = _shmod.summary()
+    if autotune_extra is not None:
+        # the tuning outcome (cache hit/miss, trials, winner, pruning
+        # reasons, score provenance) — validated by trace_check's
+        # check_autotune_extra in every training BENCH json
+        result["extra"]["autotune"] = autotune_extra
     _perfscope_settle(result, budget, steps, dt, probe_fn,
                       steps_per_call=k,
                       flops_per_step=flops_per_sample * batch, dtype=dtype)

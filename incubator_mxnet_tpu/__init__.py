@@ -60,6 +60,7 @@ from . import devicescope
 from . import servescope
 from . import serving
 from . import resilience
+from . import autotune
 from . import trainloop
 from .trainloop import TrainLoop
 from . import test_utils

@@ -1,0 +1,92 @@
+"""mxtpu.autotune — the measurement-driven knob autotuner.
+
+Six observability layers (docs/observability.md) measure where a run's
+time goes; this subsystem is the layer that **spends** those
+measurements: it searches the knob space the repo already exposes
+(``loop_chunk`` x ``remat_policy`` x prefetch depth x pallas selection
+x mesh layout x batch geometry, :mod:`.knobs`), scores each trial on
+the MEASURED devicescope busy fraction + step wall + MFU
+(:mod:`.trial` — every trial is a bench.py subprocess: jax's
+one-trace-per-process profiler limit makes in-process back-to-back
+windows impossible, and a fresh process quarantines compile-cache
+state), prunes the space with the idle-gap taxonomy and the
+``mfu_if_removed`` counterfactuals instead of grid-sweeping it
+(:mod:`.space`), and persists winners per (model fingerprint, mesh,
+device kind) with full provenance (:mod:`.cache`) so every later run
+starts tuned.
+
+Arming (``MXTPU_AUTOTUNE=1``; bench.py calls :func:`ensure_tuned`):
+cache hit -> the winner's knobs install as the BELOW-ENV default layer
+(:func:`.knobs.set_cached_defaults`) with ZERO trials; cache miss -> a
+bounded search runs first (``MXTPU_AUTOTUNE_BUDGET`` trials of
+``MXTPU_AUTOTUNE_STEPS`` steps each), then the winner installs and
+persists. An explicit BENCH_*/MXTPU_* env override always beats the
+tuner — the documented knob precedence is call-site > BENCH_* >
+MXTPU_* > cached winner > default.
+
+Telemetry: the ``autotune.*`` counter family (trace_check
+AUTOTUNE_FAMILIES), ``extra.autotune`` in every training BENCH json
+(``check_autotune_extra``), and the ``mxdiag.py tune`` renderer.
+"""
+from __future__ import annotations
+
+import os
+
+from . import cache as cache_mod
+from . import knobs
+from . import space
+from . import trial
+from . import tuner
+from .cache import TuningCache, current_device_kind, fingerprint
+from .knobs import KnobConfig
+from .trial import TrialResult, run_trial
+from .tuner import SearchResult, search
+
+__all__ = ["KnobConfig", "TuningCache", "SearchResult", "TrialResult",
+           "search", "run_trial", "ensure_tuned", "bench_extra",
+           "enabled", "fingerprint", "current_device_kind", "knobs",
+           "space", "trial", "tuner", "cache_mod"]
+
+
+def enabled() -> bool:
+    """True when MXTPU_AUTOTUNE=1 (the bench/Trainer arming switch)."""
+    return os.environ.get("MXTPU_AUTOTUNE", "0") == "1"
+
+
+def ensure_tuned(model="lenet", batch=None, dtype=None, mesh=None,
+                 budget=None, steps=None, trial_timeout=None,
+                 extra_env=None, cache_dir=None, log=None
+                 ) -> SearchResult:
+    """Resolve the tuning cache for this (model, mesh, device-kind) key
+    — hit: zero trials; miss: bounded search — and install the winner
+    as the below-env knob defaults for THIS process. Returns the
+    SearchResult (``bench_extra`` turns it into the BENCH payload).
+
+    Env knobs: ``MXTPU_AUTOTUNE_BUDGET`` (default 6 trials),
+    ``MXTPU_AUTOTUNE_STEPS`` (default 12 steady steps per trial),
+    ``MXTPU_AUTOTUNE_TRIAL_TIMEOUT`` (default 900 s),
+    ``MXTPU_AUTOTUNE_CACHE`` (cache dir)."""
+    budget = int(budget if budget is not None
+                 else os.environ.get("MXTPU_AUTOTUNE_BUDGET", "6"))
+    steps = int(steps if steps is not None
+                else os.environ.get("MXTPU_AUTOTUNE_STEPS", "12"))
+    trial_timeout = int(
+        trial_timeout if trial_timeout is not None
+        else os.environ.get("MXTPU_AUTOTUNE_TRIAL_TIMEOUT", "900"))
+    result = tuner.search(model=model, batch=batch, dtype=dtype,
+                          steps=steps, budget=budget, mesh=mesh,
+                          cache_dir=cache_dir,
+                          trial_timeout=trial_timeout,
+                          extra_env=extra_env, log=log)
+    if result.winner is not None:
+        knobs.set_cached_defaults(result.winner.to_dict())
+    return result
+
+
+def bench_extra(result: SearchResult | None = None) -> dict:
+    """The ``extra.autotune`` payload: the search/cache outcome, or the
+    disabled shape ``{"enabled": false}`` — every training BENCH json
+    carries one or the other, so the schema is uniform."""
+    if result is None:
+        return {"enabled": False}
+    return result.to_extra()

@@ -1,0 +1,418 @@
+"""mxtpu.autotune.knobs — ONE typed home for the performance knobs.
+
+Before this module every tunable rode its own env spelling, resolved at
+whatever call site happened to read it first: bench.py read
+``BENCH_LOOP_CHUNK or MXTPU_LOOP_CHUNK``, TrainLoop read only
+``MXTPU_LOOP_CHUNK``, the Trainer read it again with its own default,
+``BENCH_MESH`` grammar lived inline in bench.py, and the pallas master
+switch had three spellings (``MXTPU_PALLAS`` / ``MXTPU_NO_PALLAS`` /
+``MXTPU_FORCE_PALLAS``) whose interaction was defined only by the order
+of ``if`` statements in ``ops/pallas``. :class:`KnobConfig` replaces
+that: one dataclass over the knob space the repo already exposes, with
+ONE documented resolution order every consumer (bench.py, TrainLoop,
+Trainer, the autotune trial runner) goes through:
+
+    call-site argument  >  BENCH_*  >  MXTPU_*  >  cached winner  >  default
+
+* **call-site argument** — an explicit Python argument always wins
+  (``TrainLoop(chunk=8)``, ``Trainer(loop_chunk=4)``).
+* **BENCH_*** — the bench driver's per-run override spelling.
+* **MXTPU_*** — the ambient process-level spelling.
+* **cached winner** — when ``mxtpu.autotune`` applied a tuning-cache
+  winner (``MXTPU_AUTOTUNE=1``), its knob values fill in BELOW the env:
+  an explicit env override always beats the tuner, so a human A/B run
+  can never be silently reinterpreted.
+* **default** — the knob's documented default.
+
+When BOTH env spellings of one knob are set and DISAGREE, the higher-
+precedence one wins and a conflict warning fires (once per knob per
+process, counted as ``autotune.env_conflicts``) — the old behaviour was
+whichever call site read first, i.e. silent.
+
+The knob space (docs/autotune.md renders the full table):
+
+=================  =====================================================
+knob               meaning
+=================  =====================================================
+``loop_chunk``     micro-steps compiled into one XLA program (0/1 =
+                   stepwise FusedTrainStep, >1 = whole-loop TrainLoop)
+``remat``          rematerialize the forward during backward
+``remat_policy``   what remat saves: ``dots`` / ``nothing`` /
+                   ``everything`` (parallel/trainer_step.py)
+``prefetch_depth`` io.DevicePrefetcher device-side buffer depth
+``pallas``         kernel-selection master switch: ``auto`` (TPU +
+                   self-test gate) / ``on`` / ``force`` / ``off``
+``mesh``           BENCH_MESH token grammar (``dp4``, ``dp2mp2``,
+                   ``fsdp4``) — the sharding mode rides the tokens
+``batch``          global batch size (bucket geometry on the serving
+                   side)
+=================  =====================================================
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["KnobConfig", "KNOB_FIELDS", "PALLAS_MODES", "REMAT_POLICIES",
+           "resolve", "parse_mesh", "set_cached_defaults",
+           "cached_defaults", "clear_cached_defaults", "reset_warned"]
+
+KNOB_FIELDS = ("loop_chunk", "remat", "remat_policy", "prefetch_depth",
+               "pallas", "mesh", "batch")
+
+# the pallas master-switch states the three historical spellings resolve
+# into (ops/pallas.enabled() order: off beats force beats on beats auto)
+PALLAS_MODES = ("auto", "on", "force", "off")
+
+REMAT_POLICIES = (None, "dots", "nothing", "everything")
+
+_DEFAULTS = {"loop_chunk": 0, "remat": False, "remat_policy": None,
+             "prefetch_depth": 2, "pallas": "auto", "mesh": None,
+             "batch": None}
+
+# (BENCH spelling, MXTPU spelling) per knob; pallas resolves through its
+# own three-spelling table below
+_ENV = {"loop_chunk": ("BENCH_LOOP_CHUNK", "MXTPU_LOOP_CHUNK"),
+        "remat": ("BENCH_REMAT", "MXTPU_REMAT"),
+        "remat_policy": ("BENCH_REMAT_POLICY", "MXTPU_REMAT_POLICY"),
+        "prefetch_depth": ("BENCH_PREFETCH_DEPTH",
+                           "MXTPU_PREFETCH_DEPTH"),
+        "mesh": ("BENCH_MESH", "MXTPU_MESH"),
+        "batch": ("BENCH_BATCH", None)}
+
+# cached tuning-cache winner applied by mxtpu.autotune (BELOW the env in
+# precedence); module-level, set once per process by ensure_tuned()
+_CACHED: dict = {}
+
+# conflict warnings fire once per knob per process
+_WARNED: set = set()
+
+
+def set_cached_defaults(values: dict) -> None:
+    """Install a tuning-cache winner as the below-env default layer
+    (what ``MXTPU_AUTOTUNE=1`` + a cache hit or a finished search does).
+    Unknown keys are ignored — a cache written by a future schema must
+    not crash an older reader."""
+    _CACHED.clear()
+    for k, v in (values or {}).items():
+        if k in KNOB_FIELDS:
+            _CACHED[k] = v
+
+
+def cached_defaults() -> dict:
+    return dict(_CACHED)
+
+
+def clear_cached_defaults() -> None:
+    _CACHED.clear()
+
+
+def reset_warned() -> None:
+    """Test hook: re-arm the once-per-process conflict warnings."""
+    _WARNED.clear()
+
+
+def _parse(field: str, raw: str):
+    """Parse one env string into the knob's type. Raises ValueError on
+    garbage — a mistyped knob must fail loudly, not silently default."""
+    raw = raw.strip()
+    if field in ("loop_chunk", "prefetch_depth", "batch"):
+        v = int(raw)
+        # loop_chunk 0 = stepwise is legal; a zero buffer depth or
+        # batch is not — reject HERE, naming the field, so every
+        # consumer (KnobConfig and the single-field resolve() path
+        # TrainLoop uses) sees the same verdict for the same env value
+        floor = 0 if field == "loop_chunk" else 1
+        if v < floor:
+            raise ValueError(f"{field} must be >= {floor}, got {v}")
+        return v
+    if field == "remat":
+        low = raw.lower()
+        if low in ("1", "true", "on", "yes"):
+            return True
+        if low in ("0", "false", "off", "no", ""):
+            return False
+        raise ValueError(f"remat flag {raw!r} is not a boolean spelling")
+    if field == "remat_policy":
+        if raw in ("", "none", "None"):
+            return None
+        if raw not in REMAT_POLICIES:
+            raise ValueError(f"unknown remat_policy {raw!r}; expected one "
+                             f"of {[p for p in REMAT_POLICIES if p]}")
+        return raw
+    if field == "mesh":
+        if not raw:
+            return None
+        parse_mesh(raw)          # grammar check; value stays the token str
+        return raw
+    raise ValueError(f"unknown knob field {field!r}")
+
+
+def _warn_once(key: str, msg: str) -> None:
+    """One warning per key per process (the conflict/loser channels)."""
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    warnings.warn(msg + " (docs/autotune.md)", stacklevel=4)
+
+
+def _conflict(field: str, win_name: str, win_val, lose_name: str,
+              lose_val) -> None:
+    """Both spellings set and disagreeing: warn once per knob, count."""
+    if field in _WARNED:
+        return
+    _WARNED.add(field)
+    try:
+        from ..profiler import counter as _counter
+        _counter("autotune.env_conflicts", "autotune").increment()
+    except Exception:  # noqa: BLE001 — telemetry must never break resolve
+        pass
+    warnings.warn(
+        f"knob {field!r}: {win_name}={win_val!r} and "
+        f"{lose_name}={lose_val!r} disagree — {win_name} wins "
+        f"(precedence: call-site > BENCH_* > MXTPU_* > cached winner > "
+        f"default; docs/autotune.md)", stacklevel=3)
+
+
+def _resolve_pallas():
+    """The pallas master switch from its three spellings, mirroring
+    ops/pallas.enabled()'s if-order exactly (off > force > on > auto) —
+    this module must DESCRIBE the dispatch layer's behaviour, never
+    contradict it."""
+    master = os.environ.get("MXTPU_PALLAS", "").strip().lower()
+    no = os.environ.get("MXTPU_NO_PALLAS", "").strip().lower() \
+        not in ("", "0", "false")
+    force = os.environ.get("MXTPU_FORCE_PALLAS", "").strip().lower() \
+        not in ("", "0", "false")
+    votes = {}
+    if master in ("0", "false", "off"):
+        votes["MXTPU_PALLAS"] = "off"
+    elif master == "force":
+        votes["MXTPU_PALLAS"] = "force"
+    elif master in ("1", "true", "on"):
+        votes["MXTPU_PALLAS"] = "on"
+    if no:
+        votes["MXTPU_NO_PALLAS"] = "off"
+    if force:
+        votes["MXTPU_FORCE_PALLAS"] = "force"
+    if not votes:
+        return None, None
+    # enabled()'s order: any off-spelling beats force beats on
+    for mode in ("off", "force", "on"):
+        names = [n for n, m in votes.items() if m == mode]
+        if names:
+            losers = [(n, m) for n, m in votes.items() if m != mode]
+            if losers:
+                _conflict("pallas", names[0], mode, losers[0][0],
+                          losers[0][1])
+            return mode, names[0]
+    return None, None
+
+
+def resolve(field: str, call_site=None):
+    """Resolve ONE knob through the documented precedence. Returns
+    ``(value, source)`` where source names the layer that decided:
+    ``"call_site"``, the winning env var name, ``"cached"`` or
+    ``"default"``."""
+    if field not in KNOB_FIELDS:
+        raise ValueError(f"unknown knob field {field!r}; expected one of "
+                         f"{KNOB_FIELDS}")
+    if call_site is not None:
+        return call_site, "call_site"
+    if field == "pallas":
+        mode, src = _resolve_pallas()
+        if mode is not None:
+            return mode, src
+    else:
+        bench_name, mxtpu_name = _ENV[field]
+        bench_raw = os.environ.get(bench_name, "") if bench_name else ""
+        mxtpu_raw = os.environ.get(mxtpu_name, "") if mxtpu_name else ""
+        bench_raw, mxtpu_raw = bench_raw.strip(), mxtpu_raw.strip()
+        if bench_raw:
+            v = _parse(field, bench_raw)
+            if mxtpu_raw:
+                # conflict DETECTION only: the losing spelling must
+                # never be able to crash a resolution its valid winner
+                # already decided (a stale `export MXTPU_X=bogus` in a
+                # shell profile would otherwise break every run) — an
+                # unparseable loser warns and is ignored
+                try:
+                    mv = _parse(field, mxtpu_raw)
+                except ValueError as e:
+                    _warn_once(
+                        field + "/loser",
+                        f"knob {field!r}: ignoring unparseable "
+                        f"{mxtpu_name}={mxtpu_raw!r} ({e}); "
+                        f"{bench_name}={v!r} wins by precedence")
+                else:
+                    if mv != v:
+                        _conflict(field, bench_name, v, mxtpu_name, mv)
+            return v, bench_name
+        if mxtpu_raw:
+            return _parse(field, mxtpu_raw), mxtpu_name
+    if field in _CACHED:
+        return _CACHED[field], "cached"
+    return _DEFAULTS[field], "default"
+
+
+def parse_mesh(spec: str):
+    """Validate/parse the BENCH_MESH token grammar — concatenated
+    ``<axis><size>`` pairs (``dp4``, ``dp2mp2``, ``fsdp4``) — into
+    ``(mode, axes)`` where mode is the sharding mode the tokens imply
+    (``dp`` / ``fsdp`` / ``auto``) and axes maps mesh axis -> size.
+    THE one home of the grammar: bench.py and the trial runner both
+    resolve through it, so they can never drift apart on what a mesh
+    token means. Raises ValueError on bad grammar, duplicate axes, and
+    fsdp-with-model-axis layouts (silently-idle devices)."""
+    import re
+    spec = (spec or "").strip()
+    if not spec:
+        return None, {}
+    toks = re.findall(r"([a-z]+)(\d+)", spec)
+    if not toks or "".join(f"{n}{s}" for n, s in toks) != spec:
+        raise ValueError(f"mesh spec {spec!r}: expected concatenated "
+                         f"axis-size tokens (dp4, dp2mp2, fsdp4)")
+    mode, axes = "dp", {}
+    for name, size in toks:
+        if name == "fsdp":
+            mode, name = "fsdp", "dp"
+        if name in axes:
+            # dp2dp2 / fsdp2dp2 would silently keep only the last size —
+            # half the requested devices idle with no error
+            raise ValueError(f"mesh spec {spec!r}: axis {name!r} given "
+                             f"more than once")
+        axes[name] = int(size)
+    try:
+        from ..parallel.sharding import MODEL_AXES
+    except Exception:  # noqa: BLE001 — grammar still checks standalone
+        MODEL_AXES = ("mp", "tp", "model")
+    if any(a in axes for a in MODEL_AXES):
+        if mode == "fsdp":
+            # fsdp leaves the net unannotated, so an mp axis would just
+            # compute redundantly on every mp rank
+            raise ValueError(
+                f"mesh spec {spec!r}: fsdp with a model axis is not "
+                f"supported (the fsdp path carries no model-axis "
+                f"annotations); use dp2mp2-style layouts")
+        mode = "auto"
+    return mode, axes
+
+
+class KnobConfig:
+    """One resolved point in the knob space. Fields are plain attributes
+    (see the module docstring's table); construct directly for an
+    explicit config, or through :meth:`from_env` for the documented
+    precedence chain. ``sources`` records which layer decided each
+    field."""
+
+    def __init__(self, loop_chunk=0, remat=False, remat_policy=None,
+                 prefetch_depth=2, pallas="auto", mesh=None, batch=None):
+        self.loop_chunk = int(loop_chunk)
+        self.remat = bool(remat)
+        self.remat_policy = remat_policy
+        self.prefetch_depth = int(prefetch_depth)
+        self.pallas = pallas
+        self.mesh = mesh or None
+        # None = unset; 0 is NOT coerced to unset — the env-parse path
+        # rejects BENCH_BATCH=0 with a named error, and a dict/cache
+        # path must reach the same verdict (_validate raises below)
+        self.batch = None if batch is None else int(batch)
+        self.sources = {}
+        self._validate()
+
+    def _validate(self):
+        if self.loop_chunk < 0:
+            raise ValueError(f"loop_chunk must be >= 0, "
+                             f"got {self.loop_chunk}")
+        if self.prefetch_depth < 1:
+            raise ValueError(f"prefetch_depth must be >= 1, "
+                             f"got {self.prefetch_depth}")
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(f"unknown remat_policy "
+                             f"{self.remat_policy!r}; expected one of "
+                             f"{[p for p in REMAT_POLICIES if p]}")
+        if self.pallas not in PALLAS_MODES:
+            raise ValueError(f"unknown pallas mode {self.pallas!r}; "
+                             f"expected one of {PALLAS_MODES}")
+        if self.mesh:
+            parse_mesh(self.mesh)
+        if self.batch is not None and self.batch < 1:
+            raise ValueError(f"batch must be >= 1, got {self.batch}")
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_env(cls, **call_site):
+        """Resolve every knob through call-site kwarg > BENCH_* >
+        MXTPU_* > cached winner > default (the module contract)."""
+        values, sources = {}, {}
+        for field in KNOB_FIELDS:
+            v, src = resolve(field, call_site.get(field))
+            values[field] = v
+            sources[field] = src
+        cfg = cls(**values)
+        cfg.sources = sources
+        return cfg
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        if not isinstance(d, dict):
+            raise ValueError(f"knob dict must be an object, "
+                             f"got {type(d).__name__}")
+        unknown = set(d) - set(KNOB_FIELDS)
+        if unknown:
+            raise ValueError(f"unknown knob fields {sorted(unknown)}")
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f in KNOB_FIELDS}
+
+    # -- trial plumbing ---------------------------------------------------
+    def to_env(self) -> dict:
+        """The canonical env spelling of this config — what the autotune
+        trial runner exports into a bench.py subprocess so the trial is
+        fully pinned (every knob explicit, nothing inherited). Pallas
+        ``auto`` exports nothing (auto IS the unset state; the runner
+        scrubs the parent's pallas spellings)."""
+        env = {"BENCH_LOOP_CHUNK": str(self.loop_chunk),
+               "BENCH_REMAT": "1" if self.remat else "0",
+               "BENCH_PREFETCH_DEPTH": str(self.prefetch_depth)}
+        if self.remat_policy:
+            env["BENCH_REMAT_POLICY"] = self.remat_policy
+        if self.pallas == "off":
+            env["MXTPU_PALLAS"] = "0"
+        elif self.pallas == "force":
+            env["MXTPU_PALLAS"] = "force"
+        elif self.pallas == "on":
+            env["MXTPU_PALLAS"] = "1"
+        if self.mesh:
+            env["BENCH_MESH"] = self.mesh
+        if self.batch:
+            env["BENCH_BATCH"] = str(self.batch)
+        return env
+
+    # -- misc -------------------------------------------------------------
+    def replace(self, **changes) -> "KnobConfig":
+        d = self.to_dict()
+        d.update(changes)
+        return KnobConfig(**d)
+
+    def describe(self) -> str:
+        """Short human form, non-default fields only ("default" when
+        everything is)."""
+        parts = []
+        for f in KNOB_FIELDS:
+            v = getattr(self, f)
+            if v != _DEFAULTS[f]:
+                parts.append(f"{f}={v}")
+        return " ".join(parts) or "default"
+
+    def __eq__(self, other):
+        return isinstance(other, KnobConfig) \
+            and self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash(tuple(sorted(
+            (k, str(v)) for k, v in self.to_dict().items())))
+
+    def __repr__(self):
+        return f"KnobConfig({self.describe()})"

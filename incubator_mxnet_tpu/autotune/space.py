@@ -1,0 +1,184 @@
+"""mxtpu.autotune.space — the knob space and the measurement-driven
+pruning rules.
+
+The search does NOT grid-sweep: a trial costs a subprocess compile, so
+the space is pruned with the measurements the observability stack
+already produces before anything is dispatched. The baseline trial's
+devicescope idle-gap taxonomy names WHERE the idle time goes, and each
+knob family only helps one class of idleness:
+
+==================  ====================================================
+diagnosis           knobs worth moving
+==================  ====================================================
+``input_starved``   ``prefetch_depth`` (feed the chip), ``loop_chunk``
+                    (the executor is what the prefetcher rides) — NOT
+                    ``remat_policy``: a recompute knob cannot feed an
+                    input-starved chip
+``dispatch_bound``  ``loop_chunk`` (amortize the per-step host
+                    dispatch); deeper prefetch buys nothing — the
+                    buffer is not empty, the host is
+``device_bound``    ``pallas`` / ``remat_policy`` (make the device work
+                    cheaper); dispatch/prefetch knobs buy nothing — the
+                    chip is already busy
+``unknown``         no window measured anything — nothing to prune
+                    with, the core knobs all stay explorable
+==================  ====================================================
+
+``mesh`` is only explored when perfscope's ``mfu_if_removed``
+counterfactual says collectives are worth at least
+:data:`COLLECTIVE_GAIN_MIN` of MFU (and the caller supplied mesh
+candidates); ``batch`` only when the caller supplied batch candidates —
+geometry changes the semantics of a step, so the tuner never invents
+one.
+
+Candidates are one-knob-at-a-time variations of the incumbent
+(coordinate moves), ordered so the diagnosis's own knob family is tried
+first — budget exhaustion then cuts the least promising moves, not the
+most.
+"""
+from __future__ import annotations
+
+from .knobs import KnobConfig
+
+__all__ = ["SPACE", "prune_plan", "candidates", "apply_knob",
+           "DOMINANT_MIN_SHARE", "IDLE_MIN_FRACTION",
+           "COLLECTIVE_GAIN_MIN", "DIAGNOSES"]
+
+# candidate values per search knob. "remat_policy" folds the remat
+# on/off flag and its policy into one axis: None = remat off, "dots" =
+# save matmul outputs, "nothing" = recompute everything (max memory
+# savings). "everything" is deliberately absent — it makes remat a
+# no-op (docs/trainloop.md), i.e. a trial that re-measures the
+# baseline.
+SPACE = {
+    "loop_chunk": (0, 4, 8),
+    "prefetch_depth": (2, 4, 8),
+    "remat_policy": (None, "dots", "nothing"),
+    "pallas": ("auto", "off"),
+}
+
+DIAGNOSES = ("input_starved", "dispatch_bound", "device_bound", "unknown")
+
+# a gap bucket must hold at least this share of the total measured idle
+# time to name the diagnosis
+DOMINANT_MIN_SHARE = 0.35
+# measured idle below this fraction of the step = device-bound
+IDLE_MIN_FRACTION = 0.15
+# minimum MFU gain the collective counterfactual must promise before
+# the mesh axis is worth a trial
+COLLECTIVE_GAIN_MIN = 0.05
+
+
+def _num(x):
+    return float(x) if isinstance(x, (int, float)) \
+        and not isinstance(x, bool) else None
+
+
+def prune_plan(measurement, mesh_candidates=(), batch_candidates=()):
+    """Decide which knobs the measured baseline makes worth exploring.
+
+    ``measurement``: the baseline trial's measurement dict
+    (:func:`..trial.measurement_from_artifact`) or None when the
+    baseline trial died / carried no window.
+
+    Returns ``{"diagnosis", "allowed", "pruned"}`` where allowed is an
+    ordered knob list (most promising first) and pruned maps each
+    skipped knob to its human-readable reason — the reasons land in
+    ``extra.autotune.pruned`` and ``mxdiag.py tune``."""
+    m = measurement or {}
+    gaps = m.get("gaps") or {}
+    tax = {k: _num(gaps.get(k)) or 0.0
+           for k in ("input_starved_ms", "dispatch_serialized_ms",
+                     "host_gap_ms")}
+    idle = sum(tax.values())
+    step_ms = _num(m.get("step_ms"))
+    busy = _num(m.get("busy_fraction"))
+
+    diagnosis = "unknown"
+    if busy is not None:
+        idle_frac = (idle / step_ms) if step_ms else (1.0 - busy)
+        if idle_frac < IDLE_MIN_FRACTION or busy >= 1.0 - IDLE_MIN_FRACTION:
+            diagnosis = "device_bound"
+        elif idle > 0:
+            dominant = max(tax, key=tax.get)
+            if tax[dominant] / idle >= DOMINANT_MIN_SHARE:
+                diagnosis = ("input_starved"
+                             if dominant == "input_starved_ms"
+                             else "dispatch_bound")
+
+    allowed, pruned = [], {}
+    if diagnosis == "input_starved":
+        allowed = ["prefetch_depth", "loop_chunk"]
+        pruned["remat_policy"] = ("input-starved: a recompute knob "
+                                  "cannot feed the chip")
+        pruned["pallas"] = ("input-starved: kernel selection is not "
+                            "the bottleneck")
+    elif diagnosis == "dispatch_bound":
+        allowed = ["loop_chunk", "prefetch_depth"]
+        pruned["remat_policy"] = ("dispatch-bound: the chip idles "
+                                  "between programs, not inside them")
+        pruned["pallas"] = ("dispatch-bound: cheaper kernels widen the "
+                            "dispatch gaps, they don't close them")
+    elif diagnosis == "device_bound":
+        allowed = ["pallas", "remat_policy"]
+        pruned["loop_chunk"] = ("device-bound: dispatch amortization "
+                                "buys nothing on a busy chip")
+        pruned["prefetch_depth"] = ("device-bound: the buffer is never "
+                                    "the wait")
+    else:
+        # no measured window: nothing to prune WITH — the core knobs
+        # stay explorable and throughput decides
+        allowed = ["loop_chunk", "prefetch_depth", "remat_policy",
+                   "pallas"]
+
+    # the mesh axis: only when the collective counterfactual promises a
+    # real gain AND the caller supplied layouts to try
+    mfu = _num(m.get("mfu"))
+    cf = (m.get("mfu_if_removed") or {})
+    coll_gain = None
+    if mfu and _num(cf.get("collective")):
+        coll_gain = (_num(cf.get("collective")) - mfu) / mfu
+    if not mesh_candidates:
+        pruned["mesh"] = "no mesh candidates supplied by the caller"
+    elif coll_gain is None or coll_gain < COLLECTIVE_GAIN_MIN:
+        pruned["mesh"] = (
+            f"collective counterfactual promises "
+            f"{coll_gain if coll_gain is not None else 0:.1%} MFU "
+            f"< {COLLECTIVE_GAIN_MIN:.0%}: a resharding trial can't pay")
+    else:
+        allowed.append("mesh")
+    if batch_candidates:
+        allowed.append("batch")
+    else:
+        pruned["batch"] = ("batch geometry is pinned by the caller "
+                           "(the tuner never changes step semantics "
+                           "uninvited)")
+    return {"diagnosis": diagnosis, "allowed": allowed, "pruned": pruned}
+
+
+def apply_knob(config: KnobConfig, knob: str, value) -> KnobConfig:
+    """One coordinate move. ``remat_policy`` folds the remat flag:
+    None = remat off, a policy name = remat on with that policy."""
+    if knob == "remat_policy":
+        return config.replace(remat=value is not None, remat_policy=value)
+    return config.replace(**{knob: value})
+
+
+def candidates(incumbent: KnobConfig, plan: dict, mesh_candidates=(),
+               batch_candidates=()):
+    """One-knob-at-a-time variations of the incumbent over the plan's
+    allowed knobs, most-promising knob family first. Yields
+    ``(knob, value, KnobConfig)``; the incumbent's own value is
+    skipped (it was already measured as the baseline)."""
+    extra = {"mesh": tuple(mesh_candidates),
+             "batch": tuple(batch_candidates)}
+    out = []
+    for knob in plan.get("allowed", ()):
+        values = SPACE.get(knob) or extra.get(knob) or ()
+        current = (incumbent.remat_policy if incumbent.remat else None) \
+            if knob == "remat_policy" else getattr(incumbent, knob)
+        for v in values:
+            if v == current:
+                continue
+            out.append((knob, v, apply_knob(incumbent, knob, v)))
+    return out

@@ -1,0 +1,273 @@
+"""mxtpu.autotune.tuner — the bounded, measurement-pruned trial loop.
+
+(The module is named ``tuner`` rather than ``search`` so importing it
+can never shadow the package-level ``autotune.search()`` function —
+package globals ARE package attributes.)
+
+``search()`` closes the loop ROADMAP item 4 names: it SPENDS the
+observability stack instead of re-reporting it. The flow:
+
+1. **cache first** — a stored winner for (fingerprint, mesh, device
+   kind) returns immediately: ``cache_hit=True, trials=0`` (the
+   every-later-run-starts-tuned contract).
+2. **baseline trial** — the DEFAULT config (stepwise dispatch, depth-2
+   prefetch, pallas auto) runs once so every later comparison has a
+   measured anchor, and so the winner can never be worse than the
+   default: the baseline is a candidate like any other.
+3. **prune** — the baseline's devicescope idle-gap taxonomy and
+   perfscope counterfactuals cut the knob families that cannot help
+   (:mod:`.space`): input-starved prunes the remat axis, device-bound
+   prunes the dispatch axes, a weak collective counterfactual prunes
+   the mesh axis. Pruned candidates are COUNTED, with reasons.
+4. **bounded coordinate trials** — one-knob-at-a-time moves off the
+   baseline, most promising family first, until the trial budget is
+   exhausted. Budget exhaustion returns best-so-far (pinned by test);
+   a dead trial is a counted skip, never a crash.
+5. **persist** — the winner lands in the tuning cache with its full
+   measurement provenance and trial table.
+
+Everything lands in the ``autotune.*`` counter family and the
+``extra.autotune`` BENCH payload (``SearchResult.to_extra``).
+"""
+from __future__ import annotations
+
+from . import space as _space
+from .cache import TuningCache, current_device_kind, fingerprint
+from .knobs import KnobConfig
+from .trial import run_trial
+
+__all__ = ["search", "SearchResult"]
+
+
+def _counter(name):
+    from ..profiler import counter as _c
+    return _c(name, "autotune")
+
+
+def _gauge(name, value):
+    try:
+        from ..profiler import set_gauge as _g
+        _g(name, value, "autotune")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class SearchResult:
+    """The outcome of one ``search()`` call (or one cache hit)."""
+
+    def __init__(self, winner, score, cache_hit, trials, pruned,
+                 diagnosis, default=None, budget=None, exhausted=False,
+                 cache_info=None, error=None, cached_trials=None,
+                 pruned_candidates=0):
+        self.winner = winner              # KnobConfig | None
+        self.score = score or {}          # winner measurement summary
+        self.cache_hit = bool(cache_hit)
+        self.trials = list(trials or [])  # trials run THIS search —
+        #                                   empty on a cache hit (the
+        #                                   hit=0-trials contract); the
+        #                                   stored table rides below
+        self.cached_trials = list(cached_trials or [])
+        self.pruned = dict(pruned or {})
+        # candidate VALUES the pruned knob families would have tried —
+        # the same number the autotune.trials_pruned counter carries
+        # (len(self.pruned) counts FAMILIES and always includes the
+        # informational mesh/batch reasons; the two must not be
+        # conflated in the published record)
+        self.pruned_candidates = int(pruned_candidates)
+        self.diagnosis = diagnosis
+        self.default = default            # baseline measurement summary
+        self.budget = budget
+        self.exhausted = bool(exhausted)
+        self.cache_info = dict(cache_info or {})
+        self.error = error
+
+    @property
+    def trials_attempted(self) -> int:
+        return len(self.trials)
+
+    @property
+    def trials_failed(self) -> int:
+        n = 0
+        for t in self.trials:
+            status = t.get("status") if isinstance(t, dict) else t.status
+            n += status == "failed"
+        return n
+
+    def trial_rows(self):
+        """Rows for rendering: this search's trials, or — on a cache
+        hit — the table the entry was stored with."""
+        rows = self.trials or self.cached_trials
+        return [t if isinstance(t, dict) else t.row() for t in rows]
+
+    def to_extra(self) -> dict:
+        """The ``extra.autotune`` BENCH payload (validated by
+        tools/trace_check.py check_autotune_extra)."""
+        return {
+            "enabled": True,
+            "cache_hit": self.cache_hit,
+            "trials": self.trials_attempted,
+            "trials_failed": self.trials_failed,
+            "trials_pruned": self.pruned_candidates,
+            "budget": self.budget,
+            "budget_exhausted": self.exhausted,
+            "diagnosis": self.diagnosis,
+            "winner": self.winner.to_dict() if self.winner else None,
+            "score": dict(self.score) or None,
+            "default": dict(self.default) if self.default else None,
+            "pruned": dict(self.pruned),
+            "trial_table": self.trial_rows(),
+            "cache": dict(self.cache_info),
+            "error": self.error,
+        }
+
+
+def _measurement_summary(m) -> dict:
+    m = m or {}
+    return {"busy_fraction": m.get("busy_fraction"),
+            "step_ms": m.get("step_ms"), "mfu": m.get("mfu"),
+            "value": m.get("value"),
+            "provenance": m.get("provenance", "host_wall")}
+
+
+def search(model="lenet", batch=None, dtype=None, steps=12, budget=6,
+           mesh=None, device_kind=None, runner=None, cache=None,
+           cache_dir=None, use_cache=True, trial_timeout=900,
+           extra_env=None, mesh_candidates=(), batch_candidates=(),
+           log=None) -> SearchResult:
+    """Tune the knob space for one (model, mesh, device-kind) key.
+
+    ``budget``: max trials EXECUTED (baseline included). ``runner``:
+    injectable ``f(config, knob, value) -> TrialResult`` — tests drive
+    the search against deterministic fake measurements; the default is
+    the subprocess bench runner (:func:`..trial.run_trial`). Never
+    raises on trial failure; returns best-so-far whatever happens."""
+    log = log or (lambda msg: None)
+    cache = cache or TuningCache(cache_dir)
+    fp = fingerprint(tag=model, batch=batch, dtype=dtype)
+    dk = device_kind or current_device_kind()
+    mesh = str(mesh).strip() if mesh else None
+    cache_info = {"fingerprint": fp, "mesh": mesh, "device_kind": dk,
+                  "path": cache.path_for(fp, mesh, dk),
+                  "rejects": 0}
+    _counter("autotune.searches").increment()
+
+    if use_cache:
+        rejects0 = cache.rejects
+        entry = cache.lookup(fp, mesh, dk)
+        cache_info["rejects"] = cache.rejects - rejects0
+        if entry is not None:
+            _counter("autotune.cache_hits").increment()
+            log(f"autotune: cache HIT ({cache_info['path']}) -> "
+                f"{entry['winner']} with 0 trials")
+            winner = KnobConfig.from_dict(entry["winner"])
+            sc = entry.get("score") or {}
+            if isinstance(sc.get("busy_fraction"), (int, float)):
+                _gauge("autotune.best_busy_fraction",
+                       sc["busy_fraction"])
+            return SearchResult(
+                winner=winner, score=sc, cache_hit=True, trials=[],
+                cached_trials=entry.get("trials") or [], pruned={},
+                diagnosis=entry.get("diagnosis"),
+                default=entry.get("default"), budget=budget,
+                cache_info=dict(cache_info, hit=True))
+        _counter("autotune.cache_misses").increment()
+    cache_info["hit"] = False
+
+    runner = runner or (
+        lambda cfg, knob=None, value=None: run_trial(
+            cfg, model=model, batch=batch, dtype=dtype, steps=steps,
+            timeout=trial_timeout, extra_env=extra_env,
+            knob=knob, value=value))
+
+    budget = max(1, int(budget))
+    trials, best = [], None
+
+    def execute(cfg, knob=None, value=None):
+        nonlocal best
+        _counter("autotune.trials").increment()
+        try:
+            r = runner(cfg, knob=knob, value=value)
+        except Exception as e:  # noqa: BLE001 — a dead trial is data
+            from .trial import TrialResult
+            r = TrialResult(cfg, "failed", knob=knob, value=value,
+                            error=f"runner raised "
+                                  f"{type(e).__name__}: {e}"[:200])
+        trials.append(r)
+        if r.ok:
+            if best is None or r.score > best.score:
+                best = r
+            m = r.measurement or {}
+            log(f"autotune trial [{r.config.describe()}]: "
+                f"busy={m.get('busy_fraction')} "
+                f"value={m.get('value')} ({m.get('provenance')})")
+        else:
+            _counter("autotune.trials_failed").increment()
+            log(f"autotune trial [{cfg.describe()}] FAILED: {r.error}")
+        return r
+
+    # 1. baseline: the default config anchors every comparison and
+    # guarantees winner >= default under the score order
+    default_cfg = KnobConfig(mesh=mesh, batch=batch)
+    base = execute(default_cfg)
+
+    # 2. prune the space with the baseline's measurement (a dead
+    # baseline prunes nothing: there is nothing to prune WITH)
+    plan = _space.prune_plan(base.measurement if base.ok else None,
+                             mesh_candidates=mesh_candidates,
+                             batch_candidates=batch_candidates)
+    cands = _space.candidates(default_cfg, plan,
+                              mesh_candidates=mesh_candidates,
+                              batch_candidates=batch_candidates)
+    # pruned-candidate accounting: every value the cut knob families
+    # would have tried is a trial NOT spent (the counter the smoke and
+    # mxdiag report)
+    n_pruned_cands = sum(
+        max(0, len(_space.SPACE.get(k) or ()) - 1)
+        for k in plan["pruned"] if k in _space.SPACE)
+    if n_pruned_cands > 0:
+        _counter("autotune.trials_pruned").increment(n_pruned_cands)
+    log(f"autotune: diagnosis={plan['diagnosis']} "
+        f"allowed={plan['allowed']} "
+        f"pruned={sorted(plan['pruned'])} "
+        f"({len(cands)} candidates, budget {budget})")
+
+    # 3. bounded coordinate moves, best-so-far under budget
+    exhausted = False
+    for knob, value, cfg in cands:
+        if len(trials) >= budget:
+            exhausted = True
+            log(f"autotune: budget {budget} exhausted with "
+                f"{len(cands) - (len(trials) - 1)} candidates untried "
+                f"-> best-so-far")
+            break
+        execute(cfg, knob=knob, value=value)
+
+    if best is None:
+        log("autotune: every trial failed; nothing to cache")
+        return SearchResult(
+            winner=None, score=None, cache_hit=False, trials=trials,
+            pruned=plan["pruned"], diagnosis=plan["diagnosis"],
+            budget=budget, exhausted=exhausted, cache_info=cache_info,
+            error="every trial failed",
+            pruned_candidates=n_pruned_cands)
+
+    bm = _measurement_summary(best.measurement)
+    dm = _measurement_summary(base.measurement) if base.ok else None
+    if isinstance(bm.get("busy_fraction"), (int, float)):
+        _gauge("autotune.best_busy_fraction", bm["busy_fraction"])
+    _gauge("autotune.trials_last_search", len(trials))
+
+    # 4. persist the winner with provenance
+    if use_cache:
+        cache.store(fp, mesh, dk, best.config, score=bm, default=dm,
+                    trials=[t.row() for t in trials],
+                    diagnosis=plan["diagnosis"],
+                    provenance=bm.get("provenance"))
+    log(f"autotune: winner [{best.config.describe()}] "
+        f"busy={bm.get('busy_fraction')} value={bm.get('value')} "
+        f"({len(trials)} trials, {len(plan['pruned'])} knob(s) pruned)")
+    return SearchResult(
+        winner=best.config, score=bm, cache_hit=False, trials=trials,
+        pruned=plan["pruned"], diagnosis=plan["diagnosis"], default=dm,
+        budget=budget, exhausted=exhausted, cache_info=cache_info,
+        pruned_candidates=n_pruned_cands)

@@ -244,12 +244,14 @@ class Trainer:
         # trainloop executor (mxtpu.trainloop.TrainLoop) compiles N
         # micro-steps (fwd+bwd+collective+update+lr schedule) into one
         # donated XLA program and reads this chunk size when constructed
-        # from the Trainer. Env default: MXTPU_LOOP_CHUNK=<n>. The eager
-        # step()/update() path ignores it (that path is per-step by
+        # from the Trainer. The env layers resolve through the ONE knob
+        # table (autotune.knobs: BENCH_LOOP_CHUNK > MXTPU_LOOP_CHUNK >
+        # cached tuning winner); an explicit loop_chunk= argument wins.
+        # The eager step()/update() path ignores it (per-step by
         # construction).
         if loop_chunk is None:
-            env = os.environ.get("MXTPU_LOOP_CHUNK", "").strip()
-            loop_chunk = int(env) if env else None
+            from ..autotune import knobs as _knobs
+            loop_chunk = _knobs.resolve("loop_chunk")[0]
         self.loop_chunk = int(loop_chunk) if loop_chunk else None
         # sharding='dp'|'fsdp'|'auto' marks this trainer for MESH-NATIVE
         # execution (mxtpu.sharding, docs/sharding.md): TrainLoop /

@@ -73,6 +73,11 @@ class DevicePrefetcher:
     source    : DataIter / iterable / iterator yielding DataBatch or
                 (x, y) pairs (NDArray or numpy).
     depth     : device-side buffer depth (2 = classic double buffering).
+                A tunable knob: TrainLoop resolves it through the
+                autotune knob table (BENCH_PREFETCH_DEPTH >
+                MXTPU_PREFETCH_DEPTH > cached tuning winner > 2;
+                docs/autotune.md), and the tuner explores it when the
+                measured gap taxonomy says the chip is input-starved.
     chunk     : group k consecutive batches and stack them on a new
                 leading axis — the shape the whole-loop executor's
                 run_k/run_chunk consumes. None = per-batch.

@@ -51,13 +51,13 @@ tuning and the Pallas selection table.
 """
 from __future__ import annotations
 
-import os
 import time
 
 import numpy as np
 
 from . import devicescope as _devicescope
 from . import profiler as _prof
+from .autotune import knobs as _knobs
 from .io.prefetch import DevicePrefetcher
 from .parallel.trainer_step import FusedTrainStep
 
@@ -66,15 +66,22 @@ __all__ = ["TrainLoop", "resolve_chunk"]
 
 def resolve_chunk(explicit=None, optimizer=None, default=4):
     """Chunk-size resolution: explicit argument > Trainer.loop_chunk >
-    MXTPU_LOOP_CHUNK env > default."""
+    env/cached-winner layers > default. The env layers
+    (BENCH_LOOP_CHUNK > MXTPU_LOOP_CHUNK > autotune cached winner) are
+    the ONE knob table's (autotune.knobs) — every consumer resolves the
+    same spellings in the same order, so bench.py and a hand-built
+    TrainLoop can never disagree on what the env means. The default
+    stays 4 here: constructing a TrainLoop IS choosing whole-loop
+    execution, so an unconfigured chunk of 0 would be self-
+    contradictory."""
     if explicit:
         return int(explicit)
     lc = getattr(optimizer, "loop_chunk", None)
     if lc:
         return int(lc)
-    env = os.environ.get("MXTPU_LOOP_CHUNK", "").strip()
-    if env:
-        return int(env)
+    v, src = _knobs.resolve("loop_chunk")
+    if v and src != "default":
+        return int(v)
     return int(default)
 
 
@@ -102,11 +109,22 @@ class TrainLoop:
 
     def __init__(self, net, loss_fn, optimizer, chunk=None, mesh=None,
                  data_axis=None, donate=True, remat=False, remat_policy=None,
-                 prefetch_depth=2, schedule_in_program=True, sharding=None):
+                 prefetch_depth=None, schedule_in_program=True,
+                 sharding=None):
         self.chunk = resolve_chunk(explicit=chunk, optimizer=optimizer)
         if self.chunk < 1:
             raise ValueError(f"loop chunk must be >= 1, got {self.chunk}")
-        self.prefetch_depth = int(prefetch_depth)
+        # buffer depth through the one knob table: explicit arg >
+        # BENCH_PREFETCH_DEPTH > MXTPU_PREFETCH_DEPTH > cached winner >
+        # 2 (classic double buffering). An explicit 0 is rejected HERE
+        # (not deferred to the first _prefetcher build) so the error
+        # names the constructor argument, same verdict as the env parse
+        self.prefetch_depth = int(
+            prefetch_depth if prefetch_depth is not None
+            else _knobs.resolve("prefetch_depth")[0])
+        if self.prefetch_depth < 1:
+            raise ValueError(f"prefetch_depth must be >= 1, "
+                             f"got {self.prefetch_depth}")
         # sharding mode and mesh resolve exactly like FusedTrainStep's:
         # explicit arg > Trainer.sharding > MXTPU_SHARDING; explicit
         # mesh > process-global sharding.set_mesh (docs/sharding.md)

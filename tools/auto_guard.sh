@@ -85,6 +85,13 @@ if timeout 1800 bash tools/resilience_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) resilience smoke FAILED (continuing; self-healing suspect)" >> "$LOG"
 fi
+# autotune smoke (CPU-only): bounded knob search with measured(profile)
+# provenance, winner busy >= stepwise default, cache hit = 0 trials
+if timeout 1800 bash tools/autotune_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) autotune smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) autotune smoke FAILED (continuing; knob tuner suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

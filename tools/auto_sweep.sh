@@ -84,6 +84,13 @@ if timeout 1800 bash tools/resilience_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) resilience smoke FAILED (continuing; self-healing suspect)" >> "$LOG"
 fi
+# autotune smoke (CPU-only): the knob tuner's search/cache/provenance
+# contracts must hold before the sweep's rows feed the tuning cache
+if timeout 1800 bash tools/autotune_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) autotune smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) autotune smoke FAILED (continuing; knob tuner suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

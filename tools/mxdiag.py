@@ -38,6 +38,12 @@ coalesce_delay / pad_overhead / device_exec / respond) with roofline +
 resharding verdicts, and the one-line advice ("p99 is 83% queue_wait
 at bucket 128 - raise max_batch, not the kernel").
 
+`tune`: the autotune report from a BENCH json (`extra.autotune`) —
+cache hit/miss verdict, the trial table with measured busy fraction /
+step wall / MFU / score provenance per config, the pruning reasons
+(which knob families the measured gap taxonomy cut), and the
+winner-vs-default delta.
+
 Usage:
     python tools/mxdiag.py DUMP.json [--events N]
     python tools/mxdiag.py metrics.jsonl
@@ -45,6 +51,7 @@ Usage:
     python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py device BENCH.json
     python tools/mxdiag.py serve BENCH.json
+    python tools/mxdiag.py tune BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
@@ -335,6 +342,123 @@ def _perf_main(argv) -> int:
         print(f"perf: {e}", file=sys.stderr)
         return 1
     return print_perf(doc)
+
+
+# ---------------------------------------------------------------------------
+# tune: the autotune report from a BENCH json (extra.autotune)
+# ---------------------------------------------------------------------------
+
+def _fmt_busy(bf) -> str:
+    return f"{bf:.1%}" if isinstance(bf, (int, float)) else "-"
+
+
+def _fmt_ms(v) -> str:
+    return f"{v:.2f}" if isinstance(v, (int, float)) else "-"
+
+
+def print_tune(doc: dict) -> int:
+    """The "what did the tuner decide and why" report: cache verdict,
+    the trial table (config, measured busy, step wall, MFU, score
+    provenance), the pruning reasons (which knob families the measured
+    gap taxonomy cut, and why), and the winner-vs-default delta."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')}, batch "
+          f"{extra.get('batch')}, {extra.get('dtype')})")
+    at = extra.get("autotune")
+    if not isinstance(at, dict):
+        print("  no extra.autotune section (pre-autotune artifact)")
+        return 1
+    if not at.get("enabled"):
+        print("  autotune DISABLED for this run (MXTPU_AUTOTUNE unset)")
+        resolved = at.get("resolved")
+        if isinstance(resolved, dict):
+            print(f"  resolved knobs: "
+                  + " ".join(f"{k}={v}" for k, v in resolved.items()))
+        return 0
+    if at.get("error"):
+        print(f"  autotune ERRORED: {at['error']} (run was untuned)")
+        return 1
+    cache = at.get("cache") or {}
+    verdict = "HIT (0 trials — started tuned)" if at.get("cache_hit") \
+        else (f"MISS -> searched {at.get('trials')} trial(s)"
+              + (", budget exhausted -> best-so-far"
+                 if at.get("budget_exhausted") else ""))
+    print(f"\n  tuning cache: {verdict}")
+    print(f"    key: fingerprint={cache.get('fingerprint')}  "
+          f"mesh={cache.get('mesh')}  device={cache.get('device_kind')}")
+    if cache.get("rejects"):
+        print(f"    {cache['rejects']} stale/corrupt cache entry(ies) "
+              f"rejected (counted; re-searched)")
+    if at.get("diagnosis"):
+        print(f"  baseline diagnosis: {at['diagnosis']}")
+    table = at.get("trial_table") or []
+    if table:
+        print(f"\n  trials ({len(table)}):")
+        print(f"    {'move':<24} {'status':<7} {'busy':>7} "
+              f"{'step_ms':>9} {'mfu':>8} {'provenance':<18}")
+        win = at.get("winner")
+        for row in table:
+            cfg = row.get("config") or {}
+            move = (f"{row['knob']}={row.get('value')}"
+                    if row.get("knob") else "baseline (default)")
+            mfu = row.get("mfu")
+            tag = "  << WINNER" if win and cfg == win else ""
+            err = f"  ({str(row.get('error'))[:40]})" \
+                if row.get("status") == "failed" else ""
+            print(f"    {move:<24} {row.get('status', '?'):<7} "
+                  f"{_fmt_busy(row.get('busy_fraction')):>7} "
+                  f"{_fmt_ms(row.get('step_ms')):>9} "
+                  f"{mfu if isinstance(mfu, (int, float)) else '-':>8} "
+                  f"{row.get('provenance') or '-':<18}{tag}{err}")
+    pruned = at.get("pruned") or {}
+    if pruned:
+        print(f"\n  pruned knob families ({len(pruned)}):")
+        for k in sorted(pruned):
+            print(f"    {k:<15} {pruned[k]}")
+    win, sc, df = at.get("winner"), at.get("score"), at.get("default")
+    if win:
+        print(f"\n  winner: "
+              + (" ".join(f"{k}={v}" for k, v in win.items()
+                          if v not in (None, False)) or "default"))
+    if isinstance(sc, dict):
+        line = (f"    score: busy {_fmt_busy(sc.get('busy_fraction'))}  "
+                f"step {_fmt_ms(sc.get('step_ms'))} ms  "
+                f"mfu {sc.get('mfu')}  [{sc.get('provenance')}]")
+        if isinstance(df, dict):
+            line += (f"\n    vs default: busy "
+                     f"{_fmt_busy(df.get('busy_fraction'))}  "
+                     f"step {_fmt_ms(df.get('step_ms'))} ms  "
+                     f"mfu {df.get('mfu')}")
+            b0, b1 = df.get("busy_fraction"), sc.get("busy_fraction")
+            if isinstance(b0, (int, float)) and isinstance(b1,
+                                                           (int, float)) \
+                    and b0 > 0:
+                line += f"  (busy delta {(b1 - b0) / b0:+.1%})"
+        print(line)
+    resolved = at.get("resolved")
+    if isinstance(resolved, dict) and win and resolved != win:
+        diff = {k for k in resolved
+                if win.get(k) != resolved.get(k)}
+        if diff:
+            print(f"\n  NOTE: the run OVERRODE the winner on "
+                  f"{sorted(diff)} (env beats the tuner by precedence)")
+    return 0
+
+
+def _tune_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py tune",
+        description="Autotune report from a BENCH json (extra.autotune)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"tune: {e}", file=sys.stderr)
+        return 1
+    return print_tune(doc)
 
 
 # ---------------------------------------------------------------------------
@@ -937,6 +1061,8 @@ def main(argv=None) -> int:
         return _device_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "tune":
+        return _tune_main(argv[1:])
     if argv and argv[0] == "recover":
         return _recover_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])

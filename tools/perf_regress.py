@@ -139,6 +139,21 @@ def load_artifact(path):
     kc = sl.get("knee_concurrency") if isinstance(sl, dict) else None
     rec["knee_concurrency"] = (int(kc) if isinstance(kc, int)
                                and not isinstance(kc, bool) else None)
+    # the knob config the run ACTUALLY resolved to (extra.autotune.
+    # resolved — present on every post-autotune training artifact,
+    # tuned or not; `winner` is the fallback for tuned artifacts that
+    # predate the resolved field). A tuner-chosen config change must
+    # never be silently read as a code regression OR silently mask one,
+    # so compare() attaches the knob diff as a context note — the same
+    # both-sides contract as the commscope gates
+    at = extra.get("autotune") or {}
+    knobs = at.get("resolved") if isinstance(at.get("resolved"), dict) \
+        else (at.get("winner") if isinstance(at.get("winner"), dict)
+              else None)
+    rec["knobs"] = knobs
+    rec["autotune_cache_hit"] = (at.get("cache_hit")
+                                 if isinstance(at.get("cache_hit"), bool)
+                                 else None)
     # resilience accounting (extra.resilience): a RECOVERED run's BENCH
     # is USABLE — the measured throughput is real — but the recovery
     # cost (steps lost to rollbacks) must be reported, never hidden;
@@ -182,6 +197,32 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         notes.append(f"metric mismatch ({baseline['metric']!r} vs "
                      f"{candidate['metric']!r}) — nothing comparable")
         return regressions, notes
+    # knob-config context FIRST, so every verdict below is read with it:
+    # two artifacts measured under different tuner-resolved knob configs
+    # are comparing configs as much as code — the diff is attached as a
+    # note (never a verdict by itself), and its absence on either side
+    # is noted too (both-sides contract, like the commscope gates)
+    bk, ck = baseline.get("knobs"), candidate.get("knobs")
+    if bk is not None and ck is not None:
+        diff = sorted(k for k in set(bk) | set(ck)
+                      if bk.get(k) != ck.get(k))
+        if diff:
+            detail = ", ".join(f"{k}: {bk.get(k)!r} -> {ck.get(k)!r}"
+                               for k in diff)
+            notes.append(
+                f"CONTEXT: knob config differs baseline -> candidate "
+                f"({detail}) — the verdicts below compare DIFFERENT "
+                f"configs: a tuned-config change is not a code "
+                f"regression, and can mask one (re-run both sides with "
+                f"MXTPU_AUTOTUNE=0 and matching BENCH_* knobs to "
+                f"isolate the code)")
+        else:
+            notes.append("ok knob config identical on both sides")
+    elif (bk is None) != (ck is None):
+        side = "candidate" if bk is None else "baseline"
+        notes.append(f"note: only the {side} carries a resolved knob "
+                     f"config — knob context skipped (needs "
+                     f"extra.autotune on both sides)")
     eff = max(threshold, noise_mult * noise)
     if noise:
         notes.append(f"noise band {noise:.1%} -> effective threshold "

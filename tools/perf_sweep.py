@@ -1,5 +1,13 @@
 #!/usr/bin/env python
-"""TPU perf sweep orchestrator (round-3 protocol).
+"""TPU perf sweep orchestrator (round-3 protocol), rebased onto the
+mxtpu.autotune TRIAL RUNNER: every row executes through
+``autotune.trial.run_trial`` — the exact subprocess protocol the tuner's
+search uses (same env scrubbing, same devicescope measurement arming,
+same artifact parsing) — so the manual sweep and the autotuner can
+NEVER disagree on how a config is measured, and the sweep's rows are
+valid trial records the tuning cache ingests at the end
+(``TuningCache.ingest``): a driver run with ``MXTPU_AUTOTUNE=1`` then
+starts from the sweep's best config with zero trials.
 
 Runs bench.py as a SUBPROCESS per configuration — the exact code path the
 driver runs — so every compile lands in the same persistent cache
@@ -23,6 +31,11 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from incubator_mxnet_tpu.autotune import cache as at_cache  # noqa: E402
+from incubator_mxnet_tpu.autotune import trial as at_trial  # noqa: E402
+from incubator_mxnet_tpu.autotune.knobs import KnobConfig  # noqa: E402
 
 
 def log(msg):
@@ -45,47 +58,106 @@ def probe(timeout=60):
         return False
 
 
-def _last_json_line(stdout):
-    for ln in reversed(stdout.splitlines()):
-        ln = ln.strip()
-        if ln.startswith("{"):
-            try:
-                return json.loads(ln)
-            except json.JSONDecodeError:
-                continue
-    return None
+# sweep-row BENCH_* spellings that ARE knob fields: these pin the trial
+# through KnobConfig (the canonical spelling run_trial exports), the
+# rest (BENCH_K, BENCH_S2D, BENCH_MODEL, ...) ride as raw extras
+_KNOB_ENV = {"BENCH_LOOP_CHUNK": ("loop_chunk", int),
+             "BENCH_REMAT": ("remat", lambda v: str(v) == "1"),
+             "BENCH_REMAT_POLICY": ("remat_policy", str),
+             "BENCH_PREFETCH_DEPTH": ("prefetch_depth", int),
+             "BENCH_MESH": ("mesh", str),
+             "BENCH_BATCH": ("batch", int)}
 
 
-def run_bench(env_overrides, timeout):
-    # driver-parity: ALWAYS drop BENCH_* exported in the caller's shell —
-    # a stray BENCH_MODEL/BENCH_DTYPE would silently mislabel every row
-    # (and the no-override warm run must be the driver's exact config)
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith("BENCH_")}
-    env.update({k: str(v) for k, v in env_overrides.items()})
-    desc = " ".join(f"{k}={v}" for k, v in env_overrides.items()) or "default"
-    log(f"bench: {desc}")
-    t0 = time.time()
-    try:
-        r = subprocess.run([sys.executable, "bench.py"], timeout=timeout,
-                           capture_output=True, text=True, cwd=ROOT, env=env)
-    except subprocess.TimeoutExpired:
-        log(f"bench TIMED OUT after {timeout}s: {desc}")
+def _split_knobs(env_overrides):
+    """One sweep row -> (KnobConfig | None, raw extras). None when the
+    row sets no knob fields (the driver-parity warm run must export NO
+    knob env at all — bench resolves its own defaults)."""
+    knobs, extras = {}, {}
+    for k, v in env_overrides.items():
+        if k in _KNOB_ENV:
+            field, conv = _KNOB_ENV[k]
+            knobs[field] = conv(v)
+        else:
+            extras[k] = v
+    return (KnobConfig(**knobs) if knobs else None), extras
+
+
+def run_bench(env_overrides, timeout, measure=True):
+    """One sweep row through the autotune trial runner (the ONE way a
+    config is measured — docs/autotune.md). run_trial scrubs the
+    caller's BENCH_* (driver parity: a stray BENCH_MODEL would silently
+    mislabel every row), pins the row's knobs via their canonical
+    spellings, and — with measure=True — arms the devicescope window so
+    every row carries measured busy provenance, exactly like a tuner
+    trial. measure=False is the driver-parity warm run (no overrides,
+    no measurement arming — the driver's EXACT config).
+
+    Returns the TrialResult (status "failed" => unhealthy run, treated
+    like the old None: abort the stage)."""
+    cfg, extras = _split_knobs({k: str(v)
+                                for k, v in env_overrides.items()})
+    desc = " ".join(f"{k}={v}" for k, v in env_overrides.items()) \
+        or "default"
+    log(f"bench: {desc}" + ("" if measure else " (driver parity)"))
+    # driver parity (measure=False) keeps ambient MXTPU_* knobs: an
+    # operator-exported MXTPU_LOOP_CHUNK is part of what the driver
+    # actually runs; search-style rows scrub — their config pins all
+    r = at_trial.run_trial(cfg, timeout=timeout, measure=measure,
+                           extra_env=extras, steps=None,
+                           scrub_ambient=measure,
+                           bench_path=os.path.join(ROOT, "bench.py"))
+    r.desc = desc
+    r.extras = extras       # the non-knob row spellings (BENCH_K, ...)
+    if not r.ok:
+        log(f"bench FAILED ({desc}): {r.error}")
         return None
-    wall = time.time() - t0
-    out = _last_json_line(r.stdout)
-    if out is None:
-        log(f"bench produced no JSON (rc={r.returncode}); stderr tail: "
-            f"{r.stderr[-300:]}")
-        return None
-    out["_wall_s"] = round(wall, 1)
-    out["_config"] = desc
-    if out.get("error"):
-        log(f"bench error: {out['error'][:200]}")
-        return None
-    log(f"  -> {out['value']} {out['unit']} "
-        f"(mfu={out.get('extra', {}).get('mfu')}, wall={wall:.0f}s)")
-    return out
+    m = r.measurement
+    r.artifact["_wall_s"] = r.wall_s
+    r.artifact["_config"] = desc
+    log(f"  -> {r.artifact['value']} {r.artifact['unit']} "
+        f"(mfu={m.get('mfu')}, busy={m.get('busy_fraction')}, "
+        f"wall={r.wall_s:.0f}s)")
+    return r
+
+
+def _ingest_into_cache(trial_records):
+    """Group the sweep's OK knob-pinned rows by tuning-cache key and
+    store each group's best as that key's winner (TuningCache.ingest).
+    Device kind comes from each artifact's perfscope peaks table — the
+    orchestrator itself never touches the backend (wedge protocol)."""
+    groups = {}
+    for tr in trial_records:
+        if not tr.ok or tr.config is None:
+            continue
+        extras = getattr(tr, "extras", {}) or {}
+        model = extras.get("BENCH_MODEL", "resnet50")
+        dtype = extras.get("BENCH_DTYPE", "bfloat16")
+        # bench.py's table is the one home for per-model default batch
+        # (a row without BENCH_BATCH ran at that batch, and the cache
+        # key must record the real number the driver will key on)
+        import bench as bench_mod
+        batch = tr.config.batch or bench_mod.DEFAULT_BATCH.get(model)
+        peaks = ((tr.artifact.get("extra") or {}).get("perfscope")
+                 or {}).get("peaks") or {}
+        dk = peaks.get("device_kind") or "unknown"
+        key = (at_cache.fingerprint(tag=model, batch=batch, dtype=dtype),
+               tr.config.mesh, dk)
+        groups.setdefault(key, []).append(tr)
+    if not groups:
+        log("cache ingest: no knob-pinned rows to ingest")
+        return
+    cache = at_cache.TuningCache()
+    # str key: mesh is None for unsharded rows and a token for sharded
+    # ones — a plain tuple sort would TypeError comparing None to str
+    for (fp, mesh, dk), records in sorted(groups.items(),
+                                          key=lambda kv: str(kv[0])):
+        entry = cache.ingest(records, fp, mesh, dk)
+        if entry is not None:
+            log(f"cache ingest: {fp} mesh={mesh} device={dk} -> "
+                f"winner {entry['winner']} "
+                f"(score {entry['score'].get('busy_fraction')} busy, "
+                f"{len(records)} rows)")
 
 
 PALLAS_TAG = os.environ.get("PALLAS_TAG", "r04")
@@ -104,7 +176,7 @@ def run_pallas_validation(timeout=1800):
         log("pallas validation TIMED OUT — treating tunnel as unhealthy")
         return "timeout"
     log(f"pallas validation rc={r.returncode}")
-    out = _last_json_line(r.stdout)
+    out = at_trial.last_json_line(r.stdout)
     if out is None:
         log(f"no JSON from pallas validation (crash); stderr: "
             f"{r.stderr[-300:]}")
@@ -146,13 +218,15 @@ def main():
             log("pallas kernels FAILED parity on chip — sweep continues "
                 "(bench uses the XLA path), but fix before enabling pallas")
 
-    results = []
+    results = []          # artifact dicts (the PERF.md table rows)
+    trial_records = []    # TrialResults (what the tuning cache ingests)
 
-    def record(cfg, timeout=3600):
-        res = run_bench(cfg, timeout)
+    def record(cfg, timeout=3600, measure=True):
+        res = run_bench(cfg, timeout, measure=measure)
         if res is not None:
-            results.append(res)
-        return res
+            results.append(res.artifact)
+            trial_records.append(res)
+        return res.artifact if res is not None else None
 
     def cache_size():
         d = os.path.join(ROOT, ".jax_cache")
@@ -177,8 +251,9 @@ def main():
         f"total={t_before >> 20} MB biggest={b_before >> 20} MB")
     # always run even if a big entry already exists: the warm run doubles
     # as the driver-default (K=8) data row, and on a warm cache it's a
-    # cheap cache hit, not a fresh compile
-    warm = record({}, timeout=3600)
+    # cheap cache hit, not a fresh compile. measure=False: this row is
+    # the driver's EXACT config — no knob env, no measurement arming
+    warm = record({}, timeout=3600, measure=False)
     t_after, b_after = cache_size()
     log(f"cache after warm: total={t_after >> 20} MB "
         f"biggest={b_after >> 20} MB "
@@ -272,13 +347,15 @@ def main():
         "dispatched as ONE XLA program (`FusedTrainStep.run_k`); wall",
         "includes per-run process startup.",
         "",
-        "| config | value | unit | MFU | wall (s) |",
-        "|---|---|---|---|---|",
+        "| config | value | unit | MFU | busy | wall (s) |",
+        "|---|---|---|---|---|---|",
     ]
     for r in results:
         e = r.get("extra", {})
+        bf = (e.get("devicescope") or {}).get("busy_fraction")
+        busy = f"{bf:.1%}" if isinstance(bf, (int, float)) else "-"
         lines.append(f"| {r['_config']} | {r['value']} | {r['unit']} | "
-                     f"{e.get('mfu', '?')} | {r['_wall_s']} |")
+                     f"{e.get('mfu', '?')} | {busy} | {r['_wall_s']} |")
     lines += [
         "",
         f"**Best ResNet-50: {best['_config']} → {best['value']} img/s "
@@ -312,6 +389,14 @@ def main():
     with open(os.path.join(ROOT, "PERF.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     log(f"PERF.md written; best = {best['_config']} @ {best['value']}")
+
+    # sweep rows ARE trial records (the rebase's point): ingest each
+    # (model, batch, dtype, mesh, device-kind) group's best into the
+    # tuning cache, so a driver run with MXTPU_AUTOTUNE=1 starts from
+    # the sweep's winner with ZERO trials. Only measured rows with an
+    # explicit knob config participate (the driver-parity warm run
+    # pins no knobs — there is nothing to cache).
+    _ingest_into_cache(trial_records)
     print(json.dumps({"best": best}, indent=2))
 
 

@@ -22,7 +22,8 @@
   JSONL, including `mxdiag merge` output) — per-record schema with the
   run_id/rank/step correlation ids, non-decreasing timestamps;
 * **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
-  `perfscope/*`, `commscope/*`, `devicescope/*`, `servescope/*` or
+  `perfscope/*`, `commscope/*`, `devicescope/*`, `servescope/*`,
+  `autotune/*` or
   `sharding/*` metric appearing in a flight dump or metrics series must
   belong to the known family table with the declared kind (an unknown
   or re-kinded metric means a producer drifted from the documented
@@ -50,7 +51,7 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_commscope_extra", "check_devicescope_extra",
            "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_resilience_extra",
-           "check_file"]
+           "check_autotune_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -229,6 +230,39 @@ RESILIENCE_FAMILIES = {
     "resilience/resilience.save_ms": "histogram",
 }
 
+# The autotune.* (measurement-driven knob tuner) metric families
+# (docs/autotune.md): search/trial/cache accounting plus the last
+# search's winner gauges. Same schema-stability contract as every
+# other family table.
+AUTOTUNE_FAMILIES = {
+    "autotune/autotune.searches": "counter",
+    "autotune/autotune.trials": "counter",
+    "autotune/autotune.trials_pruned": "counter",
+    "autotune/autotune.trials_failed": "counter",
+    "autotune/autotune.cache_hits": "counter",
+    "autotune/autotune.cache_misses": "counter",
+    "autotune/autotune.cache_rejects": "counter",
+    "autotune/autotune.env_conflicts": "counter",
+    "autotune/autotune.best_busy_fraction": "gauge",
+    "autotune/autotune.trials_last_search": "gauge",
+}
+
+# score provenance an `extra.autotune` record may declare: the trial's
+# busy fraction came from a measured devicescope window, or degraded to
+# host-side wall/throughput scoring (autotune/trial.py SCORE_SOURCES)
+AUTOTUNE_SCORE_SOURCES = ("measured(profile)", "host_wall")
+
+# the knob fields a winner/resolved config may carry
+# (autotune/knobs.py KNOB_FIELDS)
+AUTOTUNE_KNOB_FIELDS = ("loop_chunk", "remat", "remat_policy",
+                        "prefetch_depth", "pallas", "mesh", "batch")
+
+AUTOTUNE_PALLAS_MODES = ("auto", "on", "force", "off")
+AUTOTUNE_REMAT_POLICIES = (None, "dots", "nothing", "everything")
+AUTOTUNE_TRIAL_STATUSES = ("ok", "failed")
+AUTOTUNE_DIAGNOSES = ("input_starved", "dispatch_bound", "device_bound",
+                      "unknown", None)
+
 # the closed request-latency component taxonomy an `extra.servescope`
 # attribution decomposes into (servescope/spans.py COMPONENTS)
 SERVESCOPE_COMPONENTS = ("queue_wait_ms", "coalesce_delay_ms",
@@ -404,6 +438,7 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("servescope/", SERVESCOPE_FAMILIES, "SERVESCOPE_FAMILIES"),
               ("resilience/", RESILIENCE_FAMILIES,
                "RESILIENCE_FAMILIES"),
+              ("autotune/", AUTOTUNE_FAMILIES, "AUTOTUNE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -972,6 +1007,149 @@ def check_devicescope_extra(ds) -> list:
 
 
 # ---------------------------------------------------------------------------
+# autotune bench section (extra.autotune)
+# ---------------------------------------------------------------------------
+
+def _check_knob_dict(d, where: str) -> list:
+    """One knob config object (winner / resolved / a trial row's
+    config): known fields only, each well-typed."""
+    errors = []
+    if not isinstance(d, dict):
+        return [f"{where}: must be an object, got {type(d).__name__}"]
+    unknown = sorted(set(d) - set(AUTOTUNE_KNOB_FIELDS))
+    if unknown:
+        errors.append(f"{where}: unknown knob field(s) {unknown} "
+                      f"(update AUTOTUNE_KNOB_FIELDS if intentional)")
+    for key in ("loop_chunk", "prefetch_depth"):
+        v = d.get(key)
+        if key in d and (not isinstance(v, int) or isinstance(v, bool)
+                         or v < 0):
+            errors.append(f"{where}[{key!r}] must be an int >= 0, "
+                          f"got {v!r}")
+    if "remat" in d and not isinstance(d["remat"], bool):
+        errors.append(f"{where}['remat'] must be a bool, "
+                      f"got {d['remat']!r}")
+    if d.get("remat_policy") not in AUTOTUNE_REMAT_POLICIES:
+        errors.append(f"{where}['remat_policy'] {d.get('remat_policy')!r} "
+                      f"not in {AUTOTUNE_REMAT_POLICIES}")
+    if "pallas" in d and d["pallas"] not in AUTOTUNE_PALLAS_MODES:
+        errors.append(f"{where}['pallas'] {d.get('pallas')!r} not in "
+                      f"{AUTOTUNE_PALLAS_MODES}")
+    b = d.get("batch")
+    if b is not None and (not isinstance(b, int) or isinstance(b, bool)
+                          or b < 1):
+        errors.append(f"{where}['batch'] must be an int >= 1 or null, "
+                      f"got {b!r}")
+    m = d.get("mesh")
+    if m is not None and (not isinstance(m, str) or not m):
+        errors.append(f"{where}['mesh'] must be a non-empty string or "
+                      f"null, got {m!r}")
+    return errors
+
+
+def _check_autotune_score(sc, where: str) -> list:
+    """One measurement summary (score / default): busy fraction in
+    [0, 1] or null, non-negative step wall, provenance from the closed
+    taxonomy."""
+    errors = []
+    if not isinstance(sc, dict):
+        return [f"{where}: must be an object, got {type(sc).__name__}"]
+    bf = sc.get("busy_fraction")
+    if bf is not None and (not _is_num(bf) or not 0.0 <= bf <= 1.0):
+        errors.append(f"{where}.busy_fraction={bf!r} outside [0, 1]")
+    for key in ("step_ms", "mfu", "value"):
+        v = sc.get(key)
+        if v is not None and (not _is_num(v) or v < 0):
+            errors.append(f"{where}.{key} must be numeric >= 0 or "
+                          f"null, got {v!r}")
+    prov = sc.get("provenance")
+    if prov is not None and prov not in AUTOTUNE_SCORE_SOURCES:
+        errors.append(f"{where}.provenance={prov!r} not in "
+                      f"{AUTOTUNE_SCORE_SOURCES}")
+    return errors
+
+
+def check_autotune_extra(at) -> list:
+    """Validate an `extra.autotune` BENCH section: the disabled shape
+    (`enabled: false`, optionally the resolved knob config), or the
+    full tuning record — cache hit/miss with the hit-means-zero-trials
+    invariant, trial accounting, a well-typed winner/resolved config,
+    score + default measurements with closed provenance, pruning
+    reasons, and a trial table whose rows carry valid statuses."""
+    if at is None:
+        return []
+    if not isinstance(at, dict):
+        return [f"must be an object, got {type(at).__name__}"]
+    errors = []
+    enabled = at.get("enabled")
+    if not isinstance(enabled, bool):
+        errors.append(f"needs a boolean 'enabled', got {enabled!r}")
+        return errors
+    if isinstance(at.get("resolved"), dict) or at.get("resolved") is None:
+        if at.get("resolved") is not None:
+            errors += _check_knob_dict(at["resolved"], "resolved")
+    else:
+        errors.append("'resolved' must be a knob object or null")
+    if not enabled:
+        return errors
+    hit = at.get("cache_hit")
+    if not isinstance(hit, bool):
+        errors.append(f"enabled record needs boolean 'cache_hit', "
+                      f"got {hit!r}")
+    for key in ("trials", "trials_pruned", "trials_failed"):
+        v = at.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"'{key}' must be an int >= 0, got {v!r}")
+    if hit is True and at.get("trials") != 0:
+        errors.append(f"cache_hit=true must report trials=0 (the "
+                      f"hit-skips-search contract), got "
+                      f"{at.get('trials')!r}")
+    if at.get("error") is None:
+        if at.get("winner") is None:
+            errors.append("an enabled, error-free record needs a "
+                          "'winner' config")
+        else:
+            errors += _check_knob_dict(at["winner"], "winner")
+        if at.get("score") is not None:
+            errors += _check_autotune_score(at["score"], "score")
+    if at.get("default") is not None:
+        errors += _check_autotune_score(at["default"], "default")
+    diag = at.get("diagnosis")
+    if diag not in AUTOTUNE_DIAGNOSES:
+        errors.append(f"diagnosis={diag!r} not in {AUTOTUNE_DIAGNOSES}")
+    pruned = at.get("pruned")
+    if pruned is not None:
+        if not isinstance(pruned, dict):
+            errors.append("'pruned' must be an object of knob -> reason")
+        else:
+            for k, v in pruned.items():
+                if not isinstance(v, str) or not v:
+                    errors.append(f"pruned[{k!r}] needs a non-empty "
+                                  f"reason string, got {v!r}")
+    table = at.get("trial_table")
+    if table is not None:
+        if not isinstance(table, list):
+            errors.append("'trial_table' must be a list")
+        else:
+            for i, row in enumerate(table):
+                if not isinstance(row, dict):
+                    errors.append(f"trial_table[{i}]: not an object")
+                    continue
+                if row.get("status") not in AUTOTUNE_TRIAL_STATUSES:
+                    errors.append(
+                        f"trial_table[{i}]: status "
+                        f"{row.get('status')!r} not in "
+                        f"{AUTOTUNE_TRIAL_STATUSES}")
+                if row.get("status") == "failed" and not row.get("error"):
+                    errors.append(f"trial_table[{i}]: failed trial "
+                                  f"needs an 'error' reason")
+                if isinstance(row.get("config"), dict):
+                    errors += _check_knob_dict(row["config"],
+                                               f"trial_table[{i}].config")
+    return errors
+
+
+# ---------------------------------------------------------------------------
 # servescope bench section (extra.servescope)
 # ---------------------------------------------------------------------------
 
@@ -1323,6 +1501,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.resilience: {e}"
                for e in check_resilience_extra(
                    (doc.get("extra") or {}).get("resilience"))]
+    errors += [f"extra.autotune: {e}"
+               for e in check_autotune_extra(
+                   (doc.get("extra") or {}).get("autotune"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
