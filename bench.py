@@ -313,6 +313,34 @@ def _bench_devicescope_start():
     return ds.enable()
 
 
+def _bench_strict_start():
+    """MXTPU_STRICT=1 (or BENCH_STRICT=1): arm the mxlint strict-mode
+    jit-program auditor (mxtpu.mxlint.runtime) — every steady-loop
+    dispatch runs under transfer-guard + NDArray-sentinel host-sync
+    detection, perfscope compile captures feed the recompile-storm
+    detector, and `extra.mxlint` carries the verdicts (validated by
+    trace_check's check_mxlint_extra). On CPU the sentinel counts and
+    the run completes; an accelerator jax-guard trip is a counted,
+    LOUD failure (no side-effect-safe re-run of a dispatched step
+    exists) — a smoke/CI mode, not a production default."""
+    from incubator_mxnet_tpu.mxlint import runtime as mxa
+    if mxa.enabled():              # armed at import via MXTPU_STRICT=1
+        return mxa.auditor()
+    if os.environ.get("BENCH_STRICT", "0") == "1":
+        return mxa.enable()
+    return None
+
+
+def _strict_guarded(aud, thunk):
+    """One steady-loop dispatch through the strict guard (or plainly —
+    the loops call this with aud=None when strict is off). The guard
+    SEMANTICS live in one home (StrictAuditor.guarded); this wrapper
+    only spares the off path an attribute lookup per dispatch."""
+    if aud is None:
+        return thunk()
+    return aud.guarded(thunk)
+
+
 def _devicescope_window(total_steps, steps_per_dispatch=1):
     """A started capture window over the first N steady steps when
     devicescope is armed, else None (zero overhead: the loops guard
@@ -1125,13 +1153,20 @@ def _record_data_bench(mode, batch, steps, dtype):
         lambda: float(step(*next_batch())))
 
     _log(f"timing {steps} end-to-end steps @ batch {batch} ({mode})")
+    # strict mode audits THIS steady loop too (extra.mxlint must never
+    # claim a clean audit for dispatches that were not guarded)
+    from incubator_mxnet_tpu.mxlint import runtime as _mxa_mod
+    strict_aud = _mxa_mod.auditor()
+    if strict_aud is not None:
+        strict_aud.mark_warmup_done()
     budget = _perfscope_budget()
     ds_win = _devicescope_window(steps)
     t0 = time.time()
     with prof.record_function("bench.steady", "bench", sync=False):
         for _ in range(steps):
             td = time.perf_counter()
-            loss = step(*next_batch())
+            nb = next_batch()
+            loss = _strict_guarded(strict_aud, lambda: step(*nb))
             disp_s = time.perf_counter() - td
             if budget is not None:
                 budget.add_dispatch(disp_s)
@@ -1160,6 +1195,8 @@ def _record_data_bench(mode, batch, steps, dtype):
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
     }
+    from incubator_mxnet_tpu.mxlint import runtime as _mxa_mod
+    result["extra"]["mxlint"] = _mxa_mod.bench_extra()
     # record-path probe includes next_batch(): the synchronized step is
     # the end-to-end unit here (decode overlap is what the mode measures)
     _perfscope_settle(result, budget, steps, dt,
@@ -1245,6 +1282,10 @@ def main():
         _log("commscope armed (collective inventory + resharding detector)")
     if _bench_devicescope_start() is not None:
         _log("devicescope armed (windowed device-timeline capture)")
+    strict_aud = _bench_strict_start()
+    if strict_aud is not None:
+        _log("mxlint strict mode armed (host-sync + recompile + "
+             "donation auditing)")
     # MXTPU_AUTOTUNE=1: resolve the tuning cache / run the bounded
     # search BEFORE the mesh registers and the knobs resolve below —
     # the winner installs as the below-env default layer, so everything
@@ -1349,6 +1390,10 @@ def main():
         trace_path, compile_s, warmup_s = _profiled_compile_warmup(
             lambda: float(step(x, y)),
             lambda: float(step(x, y)))
+    if strict_aud is not None:
+        # everything compiled so far was warmup; from here a re-capture
+        # of a known program is a steady-state recompile finding
+        strict_aud.mark_warmup_done()
 
     # BENCH_K > 1: dispatch k micro-steps as ONE XLA program (lax.scan in
     # FusedTrainStep.run_k) — amortizes per-step relay/host dispatch
@@ -1377,7 +1422,8 @@ def main():
             with prof.record_function("bench.steady", "bench", sync=False):
                 for _ in range(chunks):
                     xb, yb = next(pf)
-                    losses = loop.run_chunk(xb, yb)
+                    losses = _strict_guarded(
+                        strict_aud, lambda: loop.run_chunk(xb, yb))
                     _healthmon_mark_step()   # one mark per dispatched chunk
                     _resilience_mark_step()
                 loss_val = float(losses[loop_k - 1])    # host fetch = barrier
@@ -1408,7 +1454,8 @@ def main():
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(chunks):
                 td = time.perf_counter()
-                losses = step.run_k(xs, ys)
+                losses = _strict_guarded(strict_aud,
+                                         lambda: step.run_k(xs, ys))
                 disp_s = time.perf_counter() - td
                 if budget is not None:
                     budget.add_dispatch(disp_s)
@@ -1435,7 +1482,7 @@ def main():
         with prof.record_function("bench.steady", "bench", sync=False):
             for _ in range(steps):
                 td = time.perf_counter()
-                loss = step(x, y)
+                loss = _strict_guarded(strict_aud, lambda: step(x, y))
                 disp_s = time.perf_counter() - td
                 if budget is not None:
                     budget.add_dispatch(disp_s)
@@ -1493,6 +1540,10 @@ def main():
         # reasons, score provenance) — validated by trace_check's
         # check_autotune_extra in every training BENCH json
         result["extra"]["autotune"] = autotune_extra
+    # strict-mode verdicts (or the {"strict": false} shape — uniform
+    # schema, like extra.autotune); check_mxlint_extra validates it
+    from incubator_mxnet_tpu.mxlint import runtime as _mxa_mod
+    result["extra"]["mxlint"] = _mxa_mod.bench_extra()
     _perfscope_settle(result, budget, steps, dt, probe_fn,
                       steps_per_call=k,
                       flops_per_step=flops_per_sample * batch, dtype=dtype)

@@ -61,6 +61,7 @@ from . import servescope
 from . import serving
 from . import resilience
 from . import autotune
+from . import mxlint
 from . import trainloop
 from .trainloop import TrainLoop
 from . import test_utils
@@ -97,3 +98,7 @@ devicescope.enable_from_env()
 # attribution on the serving path (sampled via MXTPU_SERVESCOPE_SAMPLE
 # — see docs/servescope.md).
 servescope.enable_from_env()
+# MXTPU_STRICT=1: arm the mxlint strict-mode jit-program auditor
+# (host-sync / recompile-storm / donation-violation detection over the
+# steady loop — see docs/mxlint.md).
+mxlint.runtime.enable_from_env()

@@ -30,7 +30,6 @@ AUTOTUNE_FAMILIES), ``extra.autotune`` in every training BENCH json
 """
 from __future__ import annotations
 
-import os
 
 from . import cache as cache_mod
 from . import knobs
@@ -50,7 +49,7 @@ __all__ = ["KnobConfig", "TuningCache", "SearchResult", "TrialResult",
 
 def enabled() -> bool:
     """True when MXTPU_AUTOTUNE=1 (the bench/Trainer arming switch)."""
-    return os.environ.get("MXTPU_AUTOTUNE", "0") == "1"
+    return knobs.env_flag("MXTPU_AUTOTUNE", False)
 
 
 def ensure_tuned(model="lenet", batch=None, dtype=None, mesh=None,
@@ -66,13 +65,11 @@ def ensure_tuned(model="lenet", batch=None, dtype=None, mesh=None,
     ``MXTPU_AUTOTUNE_STEPS`` (default 12 steady steps per trial),
     ``MXTPU_AUTOTUNE_TRIAL_TIMEOUT`` (default 900 s),
     ``MXTPU_AUTOTUNE_CACHE`` (cache dir)."""
-    budget = int(budget if budget is not None
-                 else os.environ.get("MXTPU_AUTOTUNE_BUDGET", "6"))
-    steps = int(steps if steps is not None
-                else os.environ.get("MXTPU_AUTOTUNE_STEPS", "12"))
-    trial_timeout = int(
-        trial_timeout if trial_timeout is not None
-        else os.environ.get("MXTPU_AUTOTUNE_TRIAL_TIMEOUT", "900"))
+    budget = knobs.env_int("MXTPU_AUTOTUNE_BUDGET", 6,
+                           call_site=budget)
+    steps = knobs.env_int("MXTPU_AUTOTUNE_STEPS", 12, call_site=steps)
+    trial_timeout = knobs.env_int("MXTPU_AUTOTUNE_TRIAL_TIMEOUT", 900,
+                                  call_site=trial_timeout)
     result = tuner.search(model=model, batch=batch, dtype=dtype,
                           steps=steps, budget=budget, mesh=mesh,
                           cache_dir=cache_dir,

@@ -96,8 +96,9 @@ class TuningCache:
     degrade to a miss (the tuner re-searches), never to a crash."""
 
     def __init__(self, root=None):
+        from .knobs import env_str
         self.root = (root
-                     or os.environ.get("MXTPU_AUTOTUNE_CACHE", "").strip()
+                     or env_str("MXTPU_AUTOTUNE_CACHE")
                      or os.path.join(os.path.expanduser("~"), ".cache",
                                      "mxtpu", "autotune"))
         self.rejects = 0          # this instance's rejected-entry count

@@ -55,7 +55,9 @@ import warnings
 
 __all__ = ["KnobConfig", "KNOB_FIELDS", "PALLAS_MODES", "REMAT_POLICIES",
            "resolve", "parse_mesh", "set_cached_defaults",
-           "cached_defaults", "clear_cached_defaults", "reset_warned"]
+           "cached_defaults", "clear_cached_defaults", "reset_warned",
+           "env_raw", "env_str", "env_int", "env_float", "env_flag",
+           "TRUE_SPELLINGS", "FALSE_SPELLINGS"]
 
 KNOB_FIELDS = ("loop_chunk", "remat", "remat_policy", "prefetch_depth",
                "pallas", "mesh", "batch")
@@ -253,6 +255,94 @@ def resolve(field: str, call_site=None):
     if field in _CACHED:
         return _CACHED[field], "cached"
     return _DEFAULTS[field], "default"
+
+
+# ---------------------------------------------------------------------------
+# secondary knobs (everything OUTSIDE the search space)
+# ---------------------------------------------------------------------------
+#
+# The search space above has two env spellings and a cached-winner
+# layer; the rest of the package's knobs (MXTPU_RESILIENCE_EVERY,
+# MXTPU_SERVING_PORT, ...) have ONE spelling and no tuner — but they
+# must still resolve through ONE home, or their parsing drifts exactly
+# the way loop_chunk's did before PR 13 (three local _env_float helpers
+# with three error behaviours existed when mxlint first ran). These
+# accessors are that home: call-site argument > env > default, one
+# truthy-spelling table, one error policy. mxlint's ``raw-env-read``
+# rule holds every other module in the package to them.
+
+# the one boolean spelling table (matches _parse's remat table)
+TRUE_SPELLINGS = ("1", "true", "on", "yes")
+FALSE_SPELLINGS = ("0", "false", "off", "no", "")
+
+
+def env_raw(name: str, call_site=None):
+    """The raw stripped env string, or None when unset/empty (an empty
+    export is "unset", matching every historical call site)."""
+    if call_site is not None:
+        return call_site
+    v = os.environ.get(name, "")
+    v = v.strip()
+    return v or None
+
+
+def env_str(name: str, default=None, call_site=None):
+    v = env_raw(name, call_site)
+    return default if v is None else v
+
+
+def _env_num(name, default, call_site, on_error, cast):
+    if call_site is not None:
+        return cast(call_site)
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError) as e:
+        if on_error == "default":
+            # never-raise consumers (analysis paths, crash paths): a
+            # typo'd knob degrades to the default, once, loudly
+            _warn_once(name + "/parse",
+                       f"knob {name}={raw!r} is not a valid "
+                       f"{cast.__name__}; using default {default!r}")
+            return default
+        raise ValueError(f"knob {name}={raw!r}: {e}") from e
+
+
+def env_int(name: str, default=None, call_site=None,
+            on_error: str = "raise"):
+    """Integer knob. ``on_error="default"`` for never-raise consumers;
+    the default policy fails loudly — a mistyped knob must not
+    silently become the default."""
+    return _env_num(name, default, call_site, on_error, int)
+
+
+def env_float(name: str, default=None, call_site=None,
+              on_error: str = "raise"):
+    return _env_num(name, default, call_site, on_error, float)
+
+
+def env_flag(name: str, default: bool = False, call_site=None) -> bool:
+    """Boolean knob over the ONE spelling table. Never raises: arming
+    flags are read at import/enable time, where a typo must degrade
+    (to the default, with a once-per-process warning), not crash the
+    process."""
+    if call_site is not None:
+        return bool(call_site)
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    low = raw.lower()
+    if low in TRUE_SPELLINGS:
+        return True
+    if low in FALSE_SPELLINGS:
+        return False
+    _warn_once(name + "/flag",
+               f"knob {name}={raw!r} is not a boolean spelling "
+               f"({TRUE_SPELLINGS} / {FALSE_SPELLINGS[:-1]}); using "
+               f"default {default!r}")
+    return default
 
 
 def parse_mesh(spec: str):

@@ -32,7 +32,6 @@ compile spans, and a process-wide program table mirrored into
 """
 from __future__ import annotations
 
-import os
 import threading
 import warnings
 
@@ -97,11 +96,9 @@ _RING_FACTOR = {
 
 
 def _env_float(name):
-    try:
-        v = os.environ.get(name)
-        return float(v) if v else None
-    except (TypeError, ValueError):
-        return None
+    # never-raise contract: a typo'd override keeps the table
+    from ..autotune.knobs import env_float
+    return env_float(name, None, on_error="default")
 
 
 def ici_peaks(device=None) -> dict:

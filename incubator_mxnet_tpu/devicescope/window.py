@@ -48,16 +48,14 @@ _TRACKED = {"io_wait_ms": "io/io.wait_ms",
 
 
 def base_dir() -> str:
-    return os.environ.get("MXTPU_DEVICESCOPE_DIR",
-                          "/tmp/mxtpu_devicescope")
+    from ..autotune.knobs import env_str
+    return env_str("MXTPU_DEVICESCOPE_DIR", "/tmp/mxtpu_devicescope")
 
 
 def _env_keep() -> int:
-    try:
-        return max(1, int(os.environ.get("MXTPU_DEVICESCOPE_KEEP",
-                                         str(DEFAULT_KEEP))))
-    except ValueError:
-        return DEFAULT_KEEP
+    from ..autotune.knobs import env_int
+    return max(1, env_int("MXTPU_DEVICESCOPE_KEEP", DEFAULT_KEEP,
+                          on_error="default"))
 
 
 def rotate_dirs(base: str, keep: int | None = None) -> int:

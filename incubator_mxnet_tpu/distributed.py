@@ -52,16 +52,17 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
         # env applies only as a COMPLETE set — a partial/leaked variable
         # (e.g. a stray MXTPU_NUM_PROCESSES) must not reroute a plain
         # single-host init() into a hard-crashing explicit rendezvous
-        env_vals = [os.environ.get(k, "") for k in
-                    ("MXTPU_COORDINATOR", "MXTPU_NUM_PROCESSES",
-                     "MXTPU_PROCESS_ID")]
+        from .autotune.knobs import env_str
+        env_vals = [env_str("MXTPU_COORDINATOR", ""),
+                    env_str("MXTPU_NUM_PROCESSES", ""),
+                    env_str("MXTPU_PROCESS_ID", "")]
         if all(env_vals):
             coordinator_address = env_vals[0]
             num_processes = int(env_vals[1])
             process_id = int(env_vals[2])
-    if initialization_timeout is None and os.environ.get(
-            "MXTPU_INIT_TIMEOUT"):
-        initialization_timeout = int(os.environ["MXTPU_INIT_TIMEOUT"])
+    if initialization_timeout is None:
+        from .autotune.knobs import env_int
+        initialization_timeout = env_int("MXTPU_INIT_TIMEOUT", None)
     timeout_kw = ({} if initialization_timeout is None
                   else {"initialization_timeout": int(initialization_timeout)})
     if coordinator_address is not None:

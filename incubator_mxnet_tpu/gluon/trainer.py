@@ -238,7 +238,8 @@ class Trainer:
         # each group's updates as ONE jitted call (vs one call per param).
         # Default on; env MXTPU_FUSED_UPDATE=0 disables globally.
         if fused_update is None:
-            fused_update = os.environ.get("MXTPU_FUSED_UPDATE", "1") != "0"
+            from ..autotune.knobs import env_flag
+            fused_update = env_flag("MXTPU_FUSED_UPDATE", True)
         self._fused_update = bool(fused_update)
         # loop_chunk=N marks this trainer for WHOLE-LOOP execution: the
         # trainloop executor (mxtpu.trainloop.TrainLoop) compiles N
@@ -263,7 +264,8 @@ class Trainer:
         # stays). Env default: MXTPU_SHARDING. Needs a process-global
         # mesh (sharding.set_mesh) or an explicit mesh= at the executor.
         if sharding is None:
-            sharding = os.environ.get("MXTPU_SHARDING", "").strip() or None
+            from ..autotune.knobs import env_str
+            sharding = env_str("MXTPU_SHARDING", None)
         from ..parallel import sharding as _sharding_mod
         if sharding is not None and sharding not in _sharding_mod.MODES:
             raise ValueError(f"unknown sharding mode {sharding!r}; "
@@ -276,8 +278,8 @@ class Trainer:
         # back on NaN instead of dying. Env default: MXTPU_RESILIENCE_DIR.
         # The eager step()/update() path ignores it.
         if resilience is None:
-            resilience = os.environ.get("MXTPU_RESILIENCE_DIR",
-                                        "").strip() or None
+            from ..autotune.knobs import env_str
+            resilience = env_str("MXTPU_RESILIENCE_DIR", None)
         self.resilience = resilience
         self._kv_params_init = False
         self._sched = None

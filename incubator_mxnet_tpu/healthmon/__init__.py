@@ -87,7 +87,8 @@ def _default_rank() -> int:
     """This process's rank without touching the backend: the launcher's
     MXTPU_PROCESS_ID wins (valid even before distributed.init), then a
     formed cluster's process_index, else 0."""
-    env = os.environ.get("MXTPU_PROCESS_ID")
+    from ..autotune.knobs import env_str
+    env = env_str("MXTPU_PROCESS_ID")
     if env:
         try:
             return int(env)
@@ -104,7 +105,8 @@ def _resolve_run_id(rank: int) -> str:
     wins; on a formed cluster rank 0 publishes one through the
     coordination KV (one-time traffic — the sustained-RPC segfault the
     async PS wire avoids does not apply); fallback is process-local."""
-    rid = os.environ.get("MXTPU_RUN_ID")
+    from ..autotune.knobs import env_str
+    rid = env_str("MXTPU_RUN_ID")
     if rid:
         return rid
     try:
@@ -123,10 +125,9 @@ def _resolve_run_id(rank: int) -> str:
 
 
 def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return float(default)
+    # watchdog cadence knobs degrade on a typo, never crash enable()
+    from ..autotune.knobs import env_float
+    return float(env_float(name, default, on_error="default"))
 
 
 def _devicescope_window_path():
@@ -151,8 +152,9 @@ class HealthMonitor:
                  straggler_factor=2.0, stall_check_interval_s=None):
         self.rank = int(rank if rank is not None else _default_rank())
         self.run_id = run_id or _resolve_run_id(self.rank)
-        self.hm_dir = hm_dir or os.environ.get(
-            "MXTPU_HM_DIR", os.environ.get("MXTPU_DIAG_DIR", "/tmp"))
+        from ..autotune.knobs import env_str
+        self.hm_dir = hm_dir or env_str(
+            "MXTPU_HM_DIR", env_str("MXTPU_DIAG_DIR", "/tmp"))
         self.exchange_every = int(
             exchange_every if exchange_every is not None
             else _env_float("MXTPU_HM_EXCHANGE_EVERY", 10))
@@ -161,7 +163,7 @@ class HealthMonitor:
             else _env_float("MXTPU_HM_GRAD_NORM_EVERY", 0))
         stall_timeout_s = (stall_timeout_s if stall_timeout_s is not None
                            else _env_float("MXTPU_HM_STALL_S", 300))
-        on_nan = on_nan or os.environ.get("MXTPU_HM_ON_NAN", "alert")
+        on_nan = on_nan or env_str("MXTPU_HM_ON_NAN", "alert")
 
         self.step = 0                 # completed steps
         self._step_t0 = None          # perf_counter at step_begin
@@ -377,9 +379,13 @@ def enable(**kwargs) -> HealthMonitor:
     # the old monitor but leaving _HM pointing at it) would keep
     # enabled() True while the event log is closed and the watchdog
     # stopped, i.e. telemetry silently dead
+    # mxlint: disable=thread-shared-mutation -- GIL-atomic rebind of the
+    # arming global; every reader snapshots _HM once (the `_HM is None`
+    # discipline), and enable() runs before any monitored thread exists
     old, _HM = _HM, None
     if old is not None:
         old.close()
+    # mxlint: disable=thread-shared-mutation -- same GIL-atomic rebind
     _HM = HealthMonitor(**kwargs)
     return _HM
 
@@ -388,6 +394,9 @@ def disable():
     global _HM
     if _HM is not None:
         _HM.close()
+        # mxlint: disable=thread-shared-mutation -- GIL-atomic rebind;
+        # readers snapshot _HM once, in-flight hooks finish on the old
+        # (closed-tolerant) monitor object
         _HM = None
 
 

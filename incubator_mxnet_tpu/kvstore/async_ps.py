@@ -30,7 +30,6 @@ well as by traffic.
 """
 from __future__ import annotations
 
-import os
 import pickle
 import socket
 import struct
@@ -101,10 +100,9 @@ class AsyncPSTransport:
         self._seq = 0                 # my push sequence (per-worker FIFO)
         self._pushed = 0
         self._poll_s = poll_ms / 1e3
-        if flush_timeout is None:
-            flush_timeout = float(os.environ.get(
-                "MXTPU_APS_FLUSH_TIMEOUT", "120"))
-        self.flush_timeout = float(flush_timeout)
+        from ..autotune.knobs import env_float
+        self.flush_timeout = float(env_float(
+            "MXTPU_APS_FLUSH_TIMEOUT", 120.0, call_site=flush_timeout))
         self._stop = threading.Event()
         self._applied = {}            # server: worker rank -> applied count
         self._last_seq = {}           # server: rank -> newest applied seq
@@ -119,7 +117,8 @@ class AsyncPSTransport:
                                            socket.SOCK_STREAM)
             self._listener.setsockopt(socket.SOL_SOCKET,
                                       socket.SO_REUSEADDR, 1)
-            host = os.environ.get("MXTPU_APS_HOST", "127.0.0.1")
+            from ..autotune.knobs import env_str
+            host = env_str("MXTPU_APS_HOST", "127.0.0.1")
             self._listener.bind((host, 0))
             self._listener.listen(64)
             self._listener.settimeout(0.2)   # lets the accept loop stop

@@ -1,0 +1,258 @@
+"""mxtpu.mxlint.engine — the AST lint harness behind ``tools/mxlint.py``.
+
+Plain stdlib ``ast``: parse each file once, hand the tree (with parent
+links) to every rule whose scope covers the file, collect
+:class:`Finding` records, then apply inline suppressions.
+
+Suppression grammar (docs/mxlint.md):
+
+* ``# mxlint: disable=<rule>[,<rule2>] -- <reason>`` suppresses those
+  rules on the SAME line (or, when the directive is alone on its line,
+  on the next code line — the long-statement form).
+* ``# mxlint: disable-file=<rule>[,...] -- <reason>`` anywhere in the
+  file suppresses the rules for the whole file.
+* The reason string is REQUIRED: a directive without ``-- <reason>``
+  suppresses nothing and is itself reported under the
+  ``suppression-missing-reason`` rule — the point of a waiver is that
+  the next reader learns why, not just that someone once said so.
+
+Rules are small classes (:class:`Rule`); cross-file rules (the
+duplicated-default-table detector) accumulate state in ``check`` and
+report from ``finish``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+__all__ = ["Finding", "Rule", "FileContext", "lint_paths",
+           "lint_sources", "iter_files", "SUPPRESSION_RULE_ID",
+           "parse_suppressions"]
+
+SUPPRESSION_RULE_ID = "suppression-missing-reason"
+
+_DIRECTIVE = re.compile(
+    r"#\s*mxlint:\s*(disable|disable-file)\s*=\s*([\w\-, ]+?)"
+    r"\s*(?:--\s*(.*\S))?\s*$")
+
+# directories never walked (fixtures under tests/ carry deliberate
+# violations; examples are user-facing snippets, not framework code)
+SKIP_DIRS = {"__pycache__", ".git", ".jax_test_cache", "tests",
+             "examples", "docs", "node_modules"}
+
+
+class Finding:
+    """One lint finding: rule id + location + message + fix-it hint."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "hint")
+
+    def __init__(self, rule, path, line, col, message, hint=""):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.hint = hint
+
+    def render(self, root=None) -> str:
+        path = os.path.relpath(self.path, root) if root else self.path
+        out = f"{path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "hint": self.hint}
+
+    def __repr__(self):
+        return f"Finding({self.rule}@{self.path}:{self.line})"
+
+
+class FileContext:
+    """One parsed file: source, line list, AST with parent links, and
+    the path both absolute and repo-relative (rules scope on the
+    relative form)."""
+
+    def __init__(self, path: str, relpath: str, src: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)   # may raise SyntaxError
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._mxlint_parent = node
+        # suppressions live on the context so CROSS-FILE rules (which
+        # report from finish(), after per-file filtering already ran)
+        # can honor them at collection time
+        (self.suppress_per_line, self.suppress_file,
+         self.bad_directives) = parse_suppressions(self.lines)
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        return rule_id in self.suppress_file \
+            or rule_id in self.suppress_per_line.get(lineno, ())
+
+    def parents(self, node):
+        """Ancestors of ``node``, innermost first."""
+        cur = getattr(node, "_mxlint_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_mxlint_parent", None)
+
+    def segment(self, node) -> str:
+        """Source text of a node (empty string when unavailable)."""
+        try:
+            return ast.get_source_segment(self.src, node) or ""
+        except Exception:  # noqa: BLE001 — cosmetic only
+            return ""
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``hint`` and override
+    ``check`` (per file) and optionally ``finish`` (after all files —
+    the cross-file reporting point) and ``applies`` (path scope)."""
+
+    id = "abstract"
+    hint = ""
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> list:
+        return []
+
+    def finish(self) -> list:
+        return []
+
+    def finding(self, ctx, node, message, hint=None) -> Finding:
+        return Finding(self.id, ctx.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message,
+                       self.hint if hint is None else hint)
+
+
+def parse_suppressions(lines):
+    """Scan source lines for mxlint directives.
+
+    Returns ``(per_line, file_level, bad)`` where ``per_line`` maps a
+    1-based line number to the set of rule ids suppressed there,
+    ``file_level`` is the set suppressed file-wide, and ``bad`` lists
+    ``(lineno, directive_text)`` for directives missing the required
+    reason (which therefore suppress nothing)."""
+    per_line: dict = {}
+    file_level: set = set()
+    bad = []
+    for i, line in enumerate(lines, 1):
+        m = _DIRECTIVE.search(line)
+        if not m:
+            continue
+        kind, rules_s, reason = m.groups()
+        if not reason:
+            bad.append((i, m.group(0)))
+            continue
+        rules = {r.strip() for r in rules_s.split(",") if r.strip()}
+        if kind == "disable-file":
+            file_level |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+            # a directive alone on its line covers the NEXT CODE line
+            # (the reason may continue over further comment lines)
+            if line.strip().startswith("#"):
+                j = i + 1
+                while j <= len(lines) and (
+                        not lines[j - 1].strip()
+                        or lines[j - 1].strip().startswith("#")):
+                    j += 1
+                per_line.setdefault(j, set()).update(rules)
+    return per_line, file_level, bad
+
+
+def iter_files(paths, skip_dirs=SKIP_DIRS):
+    """Expand files/directories into the sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in skip_dirs)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _lint_context(ctx, rules) -> list:
+    """Per-file core: run the in-scope rules, apply suppressions,
+    report reasonless directives."""
+    findings = []
+    for rule in rules:
+        if rule.applies(ctx.relpath):
+            for f in rule.check(ctx):
+                if ctx.suppressed(f.rule, f.line):
+                    continue
+                findings.append(f)
+    for lineno, text in ctx.bad_directives:
+        findings.append(Finding(
+            SUPPRESSION_RULE_ID, ctx.path, lineno, 0,
+            f"suppression without a reason: {text!r} (it suppresses "
+            f"nothing)",
+            "append ' -- <why this is safe here>' to the directive"))
+    return findings
+
+
+def lint_paths(paths, rules, root=None, skip_dirs=SKIP_DIRS) -> list:
+    """Run ``rules`` over every .py file under ``paths``. Returns the
+    surviving findings, sorted by (path, line).
+
+    ``root`` anchors the repo-relative path rules scope on (default:
+    the common prefix of ``paths``)."""
+    files = iter_files(paths, skip_dirs=skip_dirs)
+    root = root or (os.path.commonpath(files) if files else ".")
+    findings = []
+    for path in files:
+        ap = os.path.abspath(path).replace(os.sep, "/")
+        # rules scope on the package-relative spelling
+        # ("incubator_mxnet_tpu/..."): anchor on the package component
+        # when the path has one, so linting the package DIRECTLY
+        # (lint_tree([pkg_dir]) — where commonpath strips the prefix)
+        # still puts every file in the package rules' jurisdiction
+        marker = "/incubator_mxnet_tpu/"
+        if marker in ap:
+            relpath = ap[ap.index(marker) + 1:]
+        else:
+            relpath = os.path.relpath(ap, os.path.abspath(root))
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            ctx = FileContext(path, relpath, src)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding("parse-error", path,
+                                    getattr(e, "lineno", 1) or 1, 0,
+                                    f"cannot lint: {e}",
+                                    "fix the syntax error (or drop the "
+                                    "file from the lint set)"))
+            continue
+        findings.extend(_lint_context(ctx, rules))
+    for rule in rules:
+        findings.extend(rule.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_sources(items, rules) -> list:
+    """Lint in-memory sources as if they lived at the given
+    repo-relative paths: ``items`` is ``(relpath, src)`` pairs. The
+    fixture-test entry point — rules scope on the pretend path, so a
+    fixture can stand in for any package module."""
+    findings = []
+    for relpath, src in items:
+        ctx = FileContext(relpath, relpath, src)
+        findings.extend(_lint_context(ctx, rules))
+    for rule in rules:
+        findings.extend(rule.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

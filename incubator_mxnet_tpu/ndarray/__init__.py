@@ -44,6 +44,14 @@ _op_hook = None
 _mem_hook = None
 _flight_hook = None
 
+# mxlint strict-mode host-sync sentinel (mxlint/runtime.py): armed under
+# MXTPU_STRICT=1, it counts NDArray host materializations that happen
+# inside a guarded steady-loop dispatch — the CPU backend's zero-copy
+# arrays never trip jax's transfer guard, so the framework's own sync
+# funnel is the detection channel tier-1 can prove. None = off (one
+# predicate per materialization, the _op_hook discipline).
+_STRICT_SYNC = None
+
 
 def _apply(fn, inputs: Sequence["NDArray"], n_out: int = 1, name: Optional[str] = None,
            fn_fwd=None, fn_vjp=None):
@@ -230,15 +238,21 @@ class NDArray:
 
     # -- materialization --------------------------------------------------
     def asnumpy(self) -> np.ndarray:
+        if _STRICT_SYNC is not None:
+            _STRICT_SYNC("asnumpy")
         return np.asarray(self._data)
 
     def __array__(self, dtype=None):
+        if _STRICT_SYNC is not None:
+            _STRICT_SYNC("__array__")
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 
     def asscalar(self):
         if self.size != 1:
             raise ValueError("The current array is not a scalar")
+        if _STRICT_SYNC is not None:
+            _STRICT_SYNC("asscalar")
         return self._data.reshape(()).item()
 
     def item(self):
@@ -248,6 +262,10 @@ class NDArray:
         return self.asnumpy().tolist()
 
     def wait_to_read(self):
+        if _STRICT_SYNC is not None:
+            # not a transfer, but a barrier: it serializes the async
+            # dispatch pipeline just the same inside a measured loop
+            _STRICT_SYNC("wait_to_read")
         if not _is_tracer(self._data):
             self._data.block_until_ready()
         return self

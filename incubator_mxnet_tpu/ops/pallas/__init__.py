@@ -41,10 +41,6 @@ def register_selftest_passthrough(*exc_types):
     _SELFTEST_PASSTHROUGH = _SELFTEST_PASSTHROUGH + tuple(exc_types)
 
 
-def _truthy(name):
-    return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
-
-
 def enabled() -> bool:
     """Use pallas kernels for framework ops? On by default on TPU (gated by
     the one-time on-device self-test). MXTPU_PALLAS is the master switch:
@@ -53,20 +49,26 @@ def enabled() -> bool:
     interpret-mode kernels); ``force`` selects kernels everywhere with
     no self-test gate (what the CPU parity tests use). MXTPU_NO_PALLAS=1
     / MXTPU_FORCE_PALLAS=1 are the legacy spellings and keep working.
-    Per-call-site qualification
-    (shape/dtype/layout) lives in ops/select.py on top of this switch."""
-    master = os.environ.get("MXTPU_PALLAS", "").strip().lower()
-    if master in ("0", "false", "off"):
+
+    The three spellings resolve through the ONE knob home
+    (``autotune.knobs.resolve("pallas")``, same off > force > on > auto
+    order this function always had) — which also gives this switch the
+    cached-tuning-winner layer: before, a ``pallas`` winner installed by
+    ``MXTPU_AUTOTUNE=1`` configured every knob EXCEPT this one, because
+    this function read the raw env below the cache. Per-call-site
+    qualification (shape/dtype/layout) lives in ops/select.py on top of
+    this switch."""
+    from ...autotune import knobs as _knobs
+    mode = _knobs.resolve("pallas")[0]
+    if mode == "off":
         return False
-    if _truthy("MXTPU_NO_PALLAS"):
-        return False
-    if master == "force" or _truthy("MXTPU_FORCE_PALLAS"):
+    if mode == "force":
         return True
-    if master in ("1", "true", "on"):
+    if mode == "on":
         # explicit on: TPU keeps the self-test gate; off-TPU this means
         # interpret-mode kernels (the MXTPU_*=1 spelling must not no-op)
         return kernels_ok() if is_tpu() else True
-    return is_tpu() and kernels_ok()
+    return is_tpu() and kernels_ok()          # auto
 
 
 def is_tpu() -> bool:
@@ -100,8 +102,8 @@ def kernels_ok() -> bool:
     """
     global _KERNELS_OK
     if _KERNELS_OK is None:
-        skip = (os.environ.get("MXTPU_PALLAS_SELFTEST", "1")
-                .strip().lower() in ("0", "false"))
+        from ...autotune.knobs import env_flag
+        skip = not env_flag("MXTPU_PALLAS_SELFTEST", True)
         if skip or not is_tpu():
             _KERNELS_OK = True
         else:

@@ -9,7 +9,6 @@ the NCCL ring of `kvstore=dist_sync_device`, compiled away.
 """
 from __future__ import annotations
 
-import os
 
 import jax
 import jax.numpy as jnp
@@ -95,7 +94,8 @@ class FusedTrainStep:
         else:
             self.optimizer = optimizer
         if sharding is None:
-            sharding = os.environ.get("MXTPU_SHARDING", "").strip() or None
+            from ..autotune.knobs import env_str
+            sharding = env_str("MXTPU_SHARDING", None)
         if sharding is not None and sharding not in _sharding.MODES:
             raise ValueError(f"unknown sharding mode {sharding!r}; "
                              f"expected one of {_sharding.MODES}")

@@ -73,12 +73,11 @@ class CheckpointManager:
         step = getattr(step, "step", step)   # accept a TrainLoop
         self._step = step
         self.directory = os.path.abspath(directory)
-        self.every = int(every if every is not None else
-                         float(os.environ.get("MXTPU_RESILIENCE_EVERY",
-                                              "50") or 50))
-        self.keep = int(keep if keep is not None else
-                        float(os.environ.get("MXTPU_RESILIENCE_KEEP",
-                                             "3") or 3))
+        from ..autotune.knobs import env_float
+        self.every = int(env_float("MXTPU_RESILIENCE_EVERY", 50.0,
+                                   call_site=every))
+        self.keep = int(env_float("MXTPU_RESILIENCE_KEEP", 3.0,
+                                  call_site=keep))
         if self.keep < 1:
             raise ValueError(f"keep must be >= 1, got {self.keep}")
         os.makedirs(self.directory, exist_ok=True)

@@ -40,7 +40,6 @@ counters on every member that observes the change, plus
 """
 from __future__ import annotations
 
-import os
 import pickle
 import socket
 import threading
@@ -88,12 +87,13 @@ class ElasticGroup:
     def __init__(self, rank, addr=None, port=0, sync_timeout_s=None,
                  host="127.0.0.1", startup_grace_s=None):
         self.rank = int(rank)
-        self.sync_timeout_s = float(
-            sync_timeout_s if sync_timeout_s is not None
-            else os.environ.get("MXTPU_ELASTIC_SYNC_TIMEOUT", "10"))
-        self.startup_grace_s = float(
-            startup_grace_s if startup_grace_s is not None
-            else os.environ.get("MXTPU_ELASTIC_STARTUP_GRACE", "60"))
+        from ..autotune.knobs import env_float
+        self.sync_timeout_s = float(env_float(
+            "MXTPU_ELASTIC_SYNC_TIMEOUT", 10.0,
+            call_site=sync_timeout_s))
+        self.startup_grace_s = float(env_float(
+            "MXTPU_ELASTIC_STARTUP_GRACE", 60.0,
+            call_site=startup_grace_s))
         self._gen_seen = 0
         self._c_departures = _counter("resilience.rank_departures",
                                       "resilience")
@@ -136,7 +136,8 @@ class ElasticGroup:
         if addr is not None:
             return tuple(addr) if not isinstance(addr, str) else \
                 (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1]))
-        env = os.environ.get("MXTPU_ELASTIC_ADDR")
+        from ..autotune.knobs import env_str
+        env = env_str("MXTPU_ELASTIC_ADDR")
         if env:
             host, port = env.rsplit(":", 1)
             return (host, int(port))

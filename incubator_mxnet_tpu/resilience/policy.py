@@ -88,7 +88,8 @@ class Supervisor:
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.skip_poison = bool(skip_poison)
-        self.on_stall = (on_stall or os.environ.get(
+        from ..autotune.knobs import env_str
+        self.on_stall = (on_stall or env_str(
             "MXTPU_RESILIENCE_ON_STALL", "none")).lower()
         if self.on_stall not in ("none", "exit"):
             raise ValueError(f"on_stall must be 'none' or 'exit', "

@@ -28,7 +28,6 @@ cache).
 """
 from __future__ import annotations
 
-import os
 import warnings
 
 import numpy as np
@@ -55,8 +54,8 @@ def check(force=False) -> bool:
 
 
 def _disabled_by_env():
-    return os.environ.get("MXTPU_CACHE_GUARD", "1").strip().lower() in (
-        "0", "false")
+    from ..autotune.knobs import env_flag
+    return not env_flag("MXTPU_CACHE_GUARD", True)
 
 
 def _cache_active():

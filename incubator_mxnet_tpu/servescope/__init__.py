@@ -85,7 +85,8 @@ def _resolve_sample(sample) -> int:
     itself; malformed values fall back to 1 (trace everything) — the
     hot path never raises over an env typo."""
     if sample is None:
-        sample = os.environ.get("MXTPU_SERVESCOPE_SAMPLE", "1")
+        from ..autotune.knobs import env_str
+        sample = env_str("MXTPU_SERVESCOPE_SAMPLE", "1")
     try:
         v = float(sample)
     except (TypeError, ValueError):

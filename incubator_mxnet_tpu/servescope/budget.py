@@ -37,7 +37,6 @@ but it includes transfer, which only a device timeline can separate).
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 import warnings
@@ -59,11 +58,9 @@ _QUANTILES = (0.50, 0.95, 0.99)
 
 
 def _env_window() -> int:
-    try:
-        return max(64, int(os.environ.get("MXTPU_SERVESCOPE_WINDOW",
-                                          str(DEFAULT_WINDOW))))
-    except ValueError:
-        return DEFAULT_WINDOW
+    from ..autotune.knobs import env_int
+    return max(64, env_int("MXTPU_SERVESCOPE_WINDOW", DEFAULT_WINDOW,
+                           on_error="default"))
 
 
 def _nearest_rank(n: int, q: float) -> int:

@@ -27,7 +27,6 @@ artifact (`prefix-symbol.json` + `prefix-0000.params`, via SymbolBlock).
 """
 from __future__ import annotations
 
-import os
 import time
 
 import jax
@@ -49,7 +48,8 @@ __all__ = ["FrozenModel", "default_buckets"]
 def default_buckets(max_batch: int | None = None):
     """Power-of-two bucket ladder, overridable via MXTPU_SERVING_BUCKETS
     (comma-separated batch sizes)."""
-    env = os.environ.get("MXTPU_SERVING_BUCKETS")
+    from ..autotune.knobs import env_str
+    env = env_str("MXTPU_SERVING_BUCKETS")
     if env:
         sizes = sorted({int(s) for s in env.split(",") if s.strip()})
     else:

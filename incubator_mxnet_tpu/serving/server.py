@@ -52,7 +52,8 @@ __all__ = ["ModelServer"]
 
 
 def _env_float(name, default):
-    return float(os.environ.get(name, default))
+    from ..autotune.knobs import env_float
+    return float(env_float(name, default))
 
 
 class ModelServer:
@@ -71,18 +72,17 @@ class ModelServer:
                                  "unfrozen block")
             model = FrozenModel(model, input_shape, **freeze_kwargs)
         self.model = model
-        self.host = host or os.environ.get("MXTPU_SERVING_HOST",
-                                           "127.0.0.1")
-        self.port = int(port if port is not None
-                        else os.environ.get("MXTPU_SERVING_PORT", "0"))
+        from ..autotune.knobs import env_int, env_str
+        self.host = host or env_str("MXTPU_SERVING_HOST", "127.0.0.1")
+        self.port = env_int("MXTPU_SERVING_PORT", 0, call_site=port)
         self.batcher = DynamicBatcher(
             model,
             max_batch=max_batch or
-            int(os.environ.get("MXTPU_SERVING_MAX_BATCH", "0")) or None,
+            env_int("MXTPU_SERVING_MAX_BATCH", 0) or None,
             max_delay_ms=max_delay_ms if max_delay_ms is not None
             else _env_float("MXTPU_SERVING_MAX_DELAY_MS", 5.0),
             queue_limit=queue_limit or
-            int(os.environ.get("MXTPU_SERVING_QUEUE_LIMIT", "256")),
+            env_int("MXTPU_SERVING_QUEUE_LIMIT", 256),
             default_timeout_ms=default_timeout_ms if default_timeout_ms
             is not None else _env_float("MXTPU_SERVING_TIMEOUT_MS", 1000.0))
         self._httpd = None

@@ -20,6 +20,7 @@ def makedirs(d):
 
 def getenv(name):
     """Read an environment variable (reference MXGetEnv path)."""
+    # mxlint: disable=raw-env-read -- MXNet-parity MXGetEnv passthrough
     return os.environ.get(name)
 
 

@@ -1,0 +1,8 @@
+"""BAD fixture (pair half A): the canonical home of a default table."""
+
+DEFAULT_BATCH = {
+    "resnet50": 256,
+    "bert": 32,
+    "lenet": 512,
+    "transformer": 8,
+}

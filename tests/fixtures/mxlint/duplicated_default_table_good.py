@@ -1,0 +1,21 @@
+"""GOOD fixture: small dicts, computed dicts, and function-local
+tables are not default-table duplicates."""
+
+SMALL = {"a": 1, "b": 2}                   # below the size floor
+
+COMPUTED = {
+    "resnet50": 2 * 128,
+    "bert": int("32"),
+    "lenet": 512,
+    "transformer": 8,
+}
+
+
+def scratch():
+    local_table = {
+        "resnet50": 256,
+        "bert": 32,
+        "lenet": 512,
+        "transformer": 8,
+    }
+    return local_table

@@ -1,0 +1,15 @@
+"""BAD fixture: raises leaking out of a module documented never-raise
+(linted as if at incubator_mxnet_tpu/devicescope/ingest.py)."""
+
+
+def parse(doc):
+    if not isinstance(doc, dict):
+        raise ValueError("bad artifact")        # leaks to the caller
+    return doc
+
+
+def rethrower(doc):
+    try:
+        return doc["events"]
+    except KeyError:
+        raise RuntimeError("torn file")         # handler re-raises out

@@ -1,0 +1,22 @@
+"""GOOD fixture: internal raises are fine when a catching handler
+guarantees the module boundary stays never-raise."""
+
+
+def parse(doc):
+    try:
+        if not isinstance(doc, dict):
+            raise ValueError("bad artifact")    # caught two lines down
+        return doc["events"]
+    except Exception:  # noqa: BLE001 — never-raise contract
+        return []
+
+
+def helper_inside_guard(doc):
+    try:
+        def _require(cond):
+            if not cond:
+                raise KeyError("missing")       # still inside the try
+        _require("events" in doc)
+        return doc["events"]
+    except Exception:  # noqa: BLE001
+        return []

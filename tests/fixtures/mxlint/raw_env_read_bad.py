@@ -1,0 +1,15 @@
+"""BAD fixture: every raw-read spelling of a MXTPU_*/BENCH_* knob the
+rule must catch (linted as if at incubator_mxnet_tpu/somemod.py)."""
+import os
+from os import getenv
+
+a = os.environ.get("MXTPU_SOME_KNOB", "1")          # .get
+b = os.getenv("BENCH_SOME_KNOB")                    # os.getenv
+c = getenv("MXTPU_OTHER_KNOB")                      # bare getenv
+d = os.environ["MXTPU_SUBSCRIPT_KNOB"]              # subscript read
+e = "MXTPU_MEMBERSHIP_KNOB" in os.environ           # membership read
+
+
+def helper(name):
+    # dynamic-name wrapper: the drift vector the rule exists for
+    return os.environ.get(name, "")

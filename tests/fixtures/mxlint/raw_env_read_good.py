@@ -1,0 +1,17 @@
+"""GOOD fixture: knob reads routed through the knobs home, allowlisted
+arming knobs, non-knob env reads, and env WRITES (config, not reads)."""
+import os
+
+
+def resolved():
+    from incubator_mxnet_tpu.autotune.knobs import env_int, env_str
+    return env_int("MXTPU_SOME_KNOB", 1), env_str("BENCH_SOME_KNOB")
+
+
+def non_knob():
+    # not a MXTPU_*/BENCH_* name: out of the rule's jurisdiction
+    return os.environ.get("JAX_PLATFORMS", "")
+
+
+def write_is_config():
+    os.environ["MXTPU_SOME_KNOB"] = "1"          # a write, not a read

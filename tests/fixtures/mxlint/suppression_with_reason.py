@@ -1,0 +1,7 @@
+"""Fixture: a reasoned suppression silences the finding."""
+import os
+
+# mxlint: disable=raw-env-read -- fixture proving the waiver grammar
+a = os.environ.get("MXTPU_WAIVED_KNOB", "1")
+
+b = os.environ.get("MXTPU_SAME_LINE", "1")  # mxlint: disable=raw-env-read -- same-line form

@@ -1,0 +1,6 @@
+"""Fixture: a reasonless directive suppresses NOTHING and is itself a
+finding."""
+import os
+
+# mxlint: disable=raw-env-read
+a = os.environ.get("MXTPU_NOT_WAIVED_KNOB", "1")

@@ -1,0 +1,13 @@
+"""BAD fixture: module-global rebinding without the lock in a threaded
+module (linted as if at incubator_mxnet_tpu/serving/batcher.py)."""
+import threading
+
+_STATE = None
+_COUNT = 0
+_lock = threading.Lock()
+
+
+def worker_update(value):
+    global _STATE, _COUNT
+    _STATE = value          # racy rebind
+    _COUNT += 1             # racy read-modify-write

@@ -1,0 +1,26 @@
+"""GOOD fixture: the write happens under the module lock (or is
+suppressed with a reason proving single-threadedness)."""
+import threading
+
+_STATE = None
+_COUNT = 0
+_lock = threading.Lock()
+
+
+def worker_update(value):
+    global _STATE, _COUNT
+    with _lock:
+        _STATE = value
+        _COUNT += 1
+
+
+def arm(value):
+    global _STATE
+    # mxlint: disable=thread-shared-mutation -- written before the
+    # worker thread starts
+    _STATE = value
+
+
+def local_only(value):
+    state = value           # plain local: no global declaration
+    return state

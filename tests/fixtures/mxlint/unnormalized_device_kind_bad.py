@@ -1,0 +1,10 @@
+"""BAD fixture: raw device-kind strings compared against literals —
+jax reports 'TPU v4', the tables store 'tpu v4': a silent never-match."""
+
+
+def lookup(entry, device):
+    if entry["stored_device_kind"] == "tpu v4":          # raw == literal
+        return True
+    if device.device_kind in ("tpu v4", "tpu v5e"):      # raw in tuple
+        return True
+    return False

@@ -1,0 +1,14 @@
+"""GOOD fixture: registered metrics with the right kinds, ungoverned
+domains, and dynamic names (the runtime validator's job, not ast's)."""
+from incubator_mxnet_tpu.profiler.counters import (counter, histogram,
+                                                   observe, set_gauge)
+
+counter("healthmon.nan_alerts", "healthmon").increment()
+set_gauge("perfscope.mfu", 0.5, "perfscope")
+histogram("servescope.e2e_ms", "servescope")
+observe("resilience.save_ms", 12.5, "resilience")
+counter("my.private.metric", "bulk")                 # ungoverned domain
+
+
+def dynamic(verdict):
+    counter(f"perfscope.{verdict}", "perfscope").increment()

@@ -9,6 +9,22 @@
 LOG=${1:-/root/repo/docs/AUTOSWEEP_r04.log}
 cd /root/repo || exit 1
 echo "$(date -u +%F' '%T) auto_sweep armed (pid $$)" >> "$LOG"
+# mxlint static gate FIRST (seconds, no backend): zero findings on the
+# tree gates the sweep — a knob read bypassing the resolution order
+# would make every sweep row's config untrustworthy
+if timeout 300 python tools/mxlint.py --check >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) mxlint gate OK (0 findings)" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) mxlint gate FAILED — tree has findings; aborting (fix or suppress with a reason)" >> "$LOG"
+  exit 1
+fi
+# mxlint strict-mode smoke (CPU lenet under MXTPU_STRICT=1): zero
+# transfer-guard trips + zero steady-state recompiles, trace_check-valid
+if timeout 900 bash tools/mxlint_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) mxlint smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) mxlint smoke FAILED (continuing; steady-loop hygiene suspect)" >> "$LOG"
+fi
 # CPU-side observability smoke BEFORE touching the tunnel (see
 # tools/diag_smoke.sh): a broken telemetry pipeline should fail here,
 # not midway through the on-chip sweep.

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -1025,6 +1026,48 @@ def _recover_main(argv) -> int:
     return print_recover(merged)
 
 
+def _lint_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py lint",
+        description="render the mxlint findings report (rule ids + "
+                    "fix-it hints) for the repo or specific paths")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package, "
+                         "tools/ and bench.py)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only these rule ids (repeatable)")
+    args = ap.parse_args(argv)
+    import importlib.util
+    ml_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "mxlint.py")
+    spec = importlib.util.spec_from_file_location("mxlint_cli", ml_path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    rules = None
+    if args.rule:
+        mxl = cli._load_mxlint()
+        rules = [mxl.rules.rule_by_id(r) for r in args.rule]
+    findings, root = cli.run_lint(args.paths or None, rules=rules)
+    print("== mxlint findings ==")
+    if not findings:
+        print("  tree is clean (0 findings)")
+        return 0
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule in sorted(by_rule):
+        fs = by_rule[rule]
+        print(f"  [{rule}]  {len(fs)} finding{'s' if len(fs) != 1 else ''}")
+        for f in fs:
+            rel = os.path.relpath(f.path, root)
+            print(f"    {rel}:{f.line}: {f.message}")
+        if fs[0].hint:
+            print(f"    fix: {fs[0].hint}")
+    print(f"  {len(findings)} total — suppress only with "
+          f"'# mxlint: disable=<rule> -- <reason>' (docs/mxlint.md)")
+    return 1
+
+
 def _merge_main(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="mxdiag.py merge",
@@ -1065,6 +1108,8 @@ def main(argv=None) -> int:
         return _tune_main(argv[1:])
     if argv and argv[0] == "recover":
         return _recover_main(argv[1:])
+    if argv and argv[0] == "lint":
+        return _lint_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="flight dump .json or metrics .jsonl")
     ap.add_argument("--events", type=int, default=40,

@@ -23,11 +23,13 @@
   run_id/rank/step correlation ids, non-decreasing timestamps;
 * **counter families** — any `healthmon/*`, `io/*`, `trainloop/*`,
   `perfscope/*`, `commscope/*`, `devicescope/*`, `servescope/*`,
-  `autotune/*` or
+  `autotune/*`, `mxlint/*` or
   `sharding/*` metric appearing in a flight dump or metrics series must
   belong to the known family table with the declared kind (an unknown
   or re-kinded metric means a producer drifted from the documented
-  schema).
+  schema). The tables have ONE home —
+  `incubator_mxnet_tpu/mxlint/families.py` — which this validator and
+  mxlint's `unregistered-counter` rule both derive from.
 
 Usage:
     python tools/trace_check.py FILE [more files ...]
@@ -51,110 +53,52 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_commscope_extra", "check_devicescope_extra",
            "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_resilience_extra",
-           "check_autotune_extra", "check_file"]
+           "check_autotune_extra", "check_mxlint_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
 
-# The healthmon metric families (docs/observability.md). Exporters and
-# dashboards key on these names; a producer inventing a new healthmon/*
-# metric (or flipping a kind) must update this table — that is the
-# schema-stability contract this validator enforces.
-HEALTHMON_FAMILIES = {
-    "healthmon/healthmon.steps": "counter",
-    "healthmon/healthmon.exchanges": "counter",
-    "healthmon/healthmon.nan_alerts": "counter",
-    "healthmon/healthmon.stall_alerts": "counter",
-    "healthmon/healthmon.step_time_regressions": "counter",
-    "healthmon/healthmon.straggler_flags": "counter",
-    "healthmon/healthmon.exchange_errors": "counter",
-    "healthmon/healthmon.recovery_hook_errors": "counter",
-    "healthmon/healthmon.collective_skew_ms": "gauge",
-    "healthmon/healthmon.slowest_rank": "gauge",
-    "healthmon/healthmon.step_ms_ewma": "gauge",
-    "healthmon/healthmon.grad_global_norm": "gauge",
-}
+# The counter-family tables. ONE home: they derive from
+# incubator_mxnet_tpu/mxlint/families.py (pure stdlib data, loaded by
+# path so this validator needs no framework/jax import) — the same
+# source mxlint's `unregistered-counter` rule reads, so the validator
+# and the linter cannot disagree. Adding a metric to a governed family
+# is one edit THERE; tests/test_mxlint.py fails on drift between these
+# module globals and the home tables.
+def _load_families():
+    import importlib.util
+    import os as _os
+    here = _os.path.dirname(_os.path.abspath(__file__))
+    path = _os.path.join(_os.path.dirname(here), "incubator_mxnet_tpu",
+                         "mxlint", "families.py")
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_mxlint_families", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
-# The io.* (device prefetcher) and trainloop.* (whole-loop executor)
-# metric families — same schema-stability contract as healthmon: a
-# producer inventing a new name or flipping a kind must update this
-# table (docs/trainloop.md documents each metric).
-IO_TRAINLOOP_FAMILIES = {
-    "io/io.batches_prefetched": "counter",
-    "io/io.batches_skipped": "counter",
-    "io/io.wait_ms": "counter",
-    "io/io.put_ms": "counter",
-    "io/io.depth": "gauge",
-    "io/io.buffer_fill": "gauge",
-    "trainloop/trainloop.chunks": "counter",
-    "trainloop/trainloop.steps": "counter",
-    "trainloop/trainloop.dispatch_ms": "counter",
-    "trainloop/trainloop.k": "gauge",
-    "trainloop/trainloop.chunk_ms": "gauge",
-    "trainloop/trainloop.in_program_lr": "gauge",
-}
 
-# The sharding.* (mesh-native GSPMD layout) metric families
-# (docs/sharding.md): annotation-resolution counters, the registered
-# mesh shape, per-param spec counts and the per-device byte gauges the
-# FSDP memory assertion reads.
-SHARDING_FAMILIES = {
-    "sharding/sharding.resolves": "counter",
-    "sharding/sharding.fallback_replicated": "counter",
-    "sharding/sharding.mesh_devices": "gauge",
-    "sharding/sharding.mesh_dp": "gauge",
-    "sharding/sharding.mesh_mp": "gauge",
-    "sharding/sharding.params_total": "gauge",
-    "sharding/sharding.params_model_sharded": "gauge",
-    "sharding/sharding.params_data_sharded": "gauge",
-    "sharding/sharding.params_replicated": "gauge",
-    "sharding/sharding.fsdp": "gauge",
-    "sharding/sharding.param_bytes_per_device": "gauge",
-    "sharding/sharding.state_bytes_per_device": "gauge",
-}
+_families = (sys.modules.get("incubator_mxnet_tpu.mxlint.families")
+             or _load_families())
+
+HEALTHMON_FAMILIES = _families.family_table("healthmon")
+# io.* (device prefetcher) + trainloop.* (whole-loop executor) share one
+# exported table (docs/trainloop.md documents each metric)
+IO_TRAINLOOP_FAMILIES = _families.family_table("io", "trainloop")
+SHARDING_FAMILIES = _families.family_table("sharding")
+PERFSCOPE_FAMILIES = _families.family_table("perfscope")
+COMMSCOPE_FAMILIES = _families.family_table("commscope")
+DEVICESCOPE_FAMILIES = _families.family_table("devicescope")
+SERVESCOPE_FAMILIES = _families.family_table("servescope")
+RESILIENCE_FAMILIES = _families.family_table("resilience")
+AUTOTUNE_FAMILIES = _families.family_table("autotune")
+# mxlint.* — the strict-mode jit-program auditor (docs/mxlint.md)
+MXLINT_FAMILIES = _families.family_table("mxlint")
 
 # sharding modes a BENCH extra.sharding may declare (parallel/sharding.py)
 SHARDING_MODES = ("dp", "fsdp", "auto")
 
-# The perfscope.* (roofline attribution) metric families
-# (docs/perfscope.md): per-program verdict counters, the step-time
-# decomposition gauges, and the device-time probe histogram.
-PERFSCOPE_FAMILIES = {
-    "perfscope/perfscope.programs_analyzed": "counter",
-    "perfscope/perfscope.compute_bound": "counter",
-    "perfscope/perfscope.hbm_bound": "counter",
-    "perfscope/perfscope.trivial": "counter",
-    "perfscope/perfscope.unknown": "counter",
-    "perfscope/perfscope.step_ms": "gauge",
-    "perfscope/perfscope.device_compute_ms": "gauge",
-    "perfscope/perfscope.collective_ms": "gauge",
-    "perfscope/perfscope.input_wait_ms": "gauge",
-    "perfscope/perfscope.host_gap_ms": "gauge",
-    "perfscope/perfscope.other_ms": "gauge",
-    "perfscope/perfscope.mfu": "gauge",
-    "perfscope/perfscope.device_step_ms": "histogram",
-}
-
 ROOFLINE_VERDICTS = ("compute_bound", "hbm_bound", "trivial", "unknown")
-
-# The commscope.* (collective & resharding observability) metric
-# families (docs/commscope.md): per-program inventory counters, one
-# counter per op kind in the closed taxonomy, and the steady train
-# program's estimated per-step gauges.
-COMMSCOPE_FAMILIES = {
-    "commscope/commscope.programs_analyzed": "counter",
-    "commscope/commscope.collectives": "counter",
-    "commscope/commscope.payload_bytes": "counter",
-    "commscope/commscope.resharding_collectives": "counter",
-    "commscope/commscope.all_reduce": "counter",
-    "commscope/commscope.all_gather": "counter",
-    "commscope/commscope.reduce_scatter": "counter",
-    "commscope/commscope.all_to_all": "counter",
-    "commscope/commscope.collective_permute": "counter",
-    "commscope/commscope.other": "counter",
-    "commscope/commscope.step_collective_est_ms": "gauge",
-    "commscope/commscope.step_collective_bytes": "gauge",
-}
 
 # the closed collective op-kind taxonomy an `extra.commscope` record may
 # use (commscope/hlo.py COLLECTIVE_KINDS — unknown HLO spellings are
@@ -168,84 +112,9 @@ COMMSCOPE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
 COLLECTIVE_SOURCES = ("measured", "measured(profile)", "estimated",
                       "unavailable")
 
-# The devicescope.* (measured device-timeline) metric families
-# (docs/devicescope.md): window lifecycle counters plus the last
-# window's measured per-step gauges.
-DEVICESCOPE_FAMILIES = {
-    "devicescope/devicescope.windows": "counter",
-    "devicescope/devicescope.steps_captured": "counter",
-    "devicescope/devicescope.declined": "counter",
-    "devicescope/devicescope.ingest_errors": "counter",
-    "devicescope/devicescope.drift_warnings": "counter",
-    "devicescope/devicescope.busy_fraction": "gauge",
-    "devicescope/devicescope.device_busy_ms": "gauge",
-    "devicescope/devicescope.collective_ms": "gauge",
-    "devicescope/devicescope.idle_ms": "gauge",
-}
-
 # idle-gap taxonomy buckets an `extra.devicescope` gaps block classifies
 DEVICESCOPE_GAP_TAXONOMY = ("input_starved_ms", "dispatch_serialized_ms",
                             "host_gap_ms")
-
-# The servescope.* (request-lifecycle tracing / tail-latency
-# attribution) metric families (docs/servescope.md): sampling header,
-# span accounting, and the per-component latency histograms.
-SERVESCOPE_FAMILIES = {
-    "servescope/servescope.requests_traced": "counter",
-    "servescope/servescope.rejections_traced": "counter",
-    "servescope/servescope.sampled_out": "counter",
-    "servescope/servescope.device_drift_warnings": "counter",
-    "servescope/servescope.sample_every": "gauge",
-    "servescope/servescope.e2e_ms": "histogram",
-    "servescope/servescope.queue_wait_ms": "histogram",
-    "servescope/servescope.coalesce_delay_ms": "histogram",
-    "servescope/servescope.pad_overhead_ms": "histogram",
-    "servescope/servescope.device_exec_ms": "histogram",
-    "servescope/servescope.respond_ms": "histogram",
-}
-
-# The resilience.* (elastic self-healing training) metric families
-# (docs/resilience.md): checkpoint lifecycle counters, recovery
-# accounting, and the save-cost histograms the BENCH extra.resilience
-# percentiles read. Same schema-stability contract as every other
-# family table.
-RESILIENCE_FAMILIES = {
-    "resilience/resilience.checkpoints_saved": "counter",
-    "resilience/resilience.checkpoints_pruned": "counter",
-    "resilience/resilience.saves_skipped": "counter",
-    "resilience/resilience.save_errors": "counter",
-    "resilience/resilience.corrupt_checkpoints": "counter",
-    "resilience/resilience.recoveries_total": "counter",
-    "resilience/resilience.rollbacks": "counter",
-    "resilience/resilience.resumes": "counter",
-    "resilience/resilience.steps_lost_total": "counter",
-    "resilience/resilience.retries_exhausted": "counter",
-    "resilience/resilience.restarts_requested": "counter",
-    "resilience/resilience.rank_departures": "counter",
-    "resilience/resilience.rank_joins": "counter",
-    "resilience/resilience.last_checkpoint_step": "gauge",
-    "resilience/resilience.rollback_in_progress": "gauge",
-    "resilience/resilience.steps_lost_last": "gauge",
-    "resilience/resilience.copy_ms": "histogram",
-    "resilience/resilience.save_ms": "histogram",
-}
-
-# The autotune.* (measurement-driven knob tuner) metric families
-# (docs/autotune.md): search/trial/cache accounting plus the last
-# search's winner gauges. Same schema-stability contract as every
-# other family table.
-AUTOTUNE_FAMILIES = {
-    "autotune/autotune.searches": "counter",
-    "autotune/autotune.trials": "counter",
-    "autotune/autotune.trials_pruned": "counter",
-    "autotune/autotune.trials_failed": "counter",
-    "autotune/autotune.cache_hits": "counter",
-    "autotune/autotune.cache_misses": "counter",
-    "autotune/autotune.cache_rejects": "counter",
-    "autotune/autotune.env_conflicts": "counter",
-    "autotune/autotune.best_busy_fraction": "gauge",
-    "autotune/autotune.trials_last_search": "gauge",
-}
 
 # score provenance an `extra.autotune` record may declare: the trial's
 # busy fraction came from a measured devicescope window, or degraded to
@@ -439,6 +308,7 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("resilience/", RESILIENCE_FAMILIES,
                "RESILIENCE_FAMILIES"),
               ("autotune/", AUTOTUNE_FAMILIES, "AUTOTUNE_FAMILIES"),
+              ("mxlint/", MXLINT_FAMILIES, "MXLINT_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -1150,6 +1020,48 @@ def check_autotune_extra(at) -> list:
 
 
 # ---------------------------------------------------------------------------
+# mxlint bench section (extra.mxlint)
+# ---------------------------------------------------------------------------
+
+def check_mxlint_extra(mx) -> list:
+    """Validate an `extra.mxlint` BENCH section: the disabled shape
+    (`strict: false`), or the full strict-mode audit record — the
+    finding counters must be present, non-negative, and SUM to the
+    `findings` total, and every recompiled program must be named."""
+    if mx is None:
+        return []
+    if not isinstance(mx, dict):
+        return [f"must be an object, got {type(mx).__name__}"]
+    errors = []
+    strict = mx.get("strict")
+    if not isinstance(strict, bool):
+        errors.append(f"needs a boolean 'strict', got {strict!r}")
+        return errors
+    if not strict:
+        return errors
+    parts = ("transfer_guard_trips", "recompiles", "donation_violations")
+    for key in parts + ("findings", "allowed_syncs",
+                        "guarded_dispatches"):
+        v = mx.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"'{key}' must be an int >= 0, got {v!r}")
+    if all(isinstance(mx.get(k), int) for k in parts + ("findings",)) \
+            and mx["findings"] != sum(mx[k] for k in parts):
+        errors.append(
+            f"findings={mx['findings']} != "
+            f"{' + '.join(parts)} = {sum(mx[k] for k in parts)}")
+    rp = mx.get("recompiled_programs")
+    if not isinstance(rp, list) or \
+            any(not isinstance(n, str) or not n for n in rp):
+        errors.append(f"'recompiled_programs' must be a list of program "
+                      f"names, got {rp!r}")
+    elif isinstance(mx.get("recompiles"), int) \
+            and mx["recompiles"] == 0 and rp:
+        errors.append(f"recompiles=0 but recompiled_programs={rp!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
 # servescope bench section (extra.servescope)
 # ---------------------------------------------------------------------------
 
@@ -1504,6 +1416,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.autotune: {e}"
                for e in check_autotune_extra(
                    (doc.get("extra") or {}).get("autotune"))]
+    errors += [f"extra.mxlint: {e}"
+               for e in check_mxlint_extra(
+                   (doc.get("extra") or {}).get("mxlint"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
