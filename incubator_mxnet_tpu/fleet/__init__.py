@@ -1,0 +1,36 @@
+"""mxtpu.fleet — continuous batching and a multi-replica serving fleet.
+
+PR 4's `mxtpu.serving` took one model to one chip behind one HTTP
+server; this package takes that server to a fleet, in three layers
+(docs/serving.md has the full scheduler model and deploy runbook):
+
+* :class:`ContinuousBatcher` (`continuous.py`) — iteration-level
+  scheduling in place of the coalesce-then-dispatch hold: requests are
+  admitted **mid-flight** into the next bucket dispatch, each such
+  request's servescope span stamped ``slotted``;
+* :class:`CompileCache` (`cache.py`) — the shared on-disk AOT
+  executable cache: replica N+1 deserializes the buckets replica 0
+  compiled (``FrozenModel(..., compile_cache=...)``), counted in the
+  governed ``fleet`` family so a deploy can prove its warmup was a
+  cache hit;
+* :class:`ReplicaSet` + :class:`Router` (`replica.py`, `router.py`) —
+  N replicas behind one front door doing least-loaded dispatch off
+  the deep ``/healthz`` (live outstanding + polled queue depth, with
+  resharding-flagged replicas penalized), plus draining deploys:
+  ``Router.deploy`` rolls drain → swap → readmit with zero dropped
+  requests.
+
+The quantized/sharded half of the serving story lives where the model
+does: ``FrozenModel.quantize()`` (int8 via `contrib/quantization`,
+bf16 via ``compute_dtype``) and ``FrozenModel(..., mesh=...)`` with
+the resharding gate — see `serving/frozen.py`.
+"""
+from __future__ import annotations
+
+from .cache import CompileCache, set_shared_cache, shared_cache
+from .continuous import ContinuousBatcher
+from .replica import Replica
+from .router import ReplicaSet, Router
+
+__all__ = ["ContinuousBatcher", "CompileCache", "shared_cache",
+           "set_shared_cache", "Replica", "ReplicaSet", "Router"]

@@ -1,0 +1,109 @@
+"""ContinuousBatcher — iteration-level (Orca-style) request scheduling.
+
+`DynamicBatcher` is Clipper-shaped: the dispatcher holds the first
+request of a batch open for up to ``max_delay_ms`` hoping more arrive,
+then dispatches and only *afterwards* looks at the queue again. That
+coalescing hold is the right trade for sporadic traffic, but under
+sustained load it is pure added latency: the device sits idle through
+every hold window, and a request that arrives one microsecond after a
+dispatch waits out the *entire* next window before it is even
+considered.
+
+The continuous batcher replaces the hold with iteration-level
+scheduling (the Orca move, the scheduling core of every modern LLM
+serving engine):
+
+* the dispatcher never waits once work exists — each iteration it
+  takes *everything* queued (up to ``max_batch``), picks the smallest
+  compiled bucket that fits, and dispatches immediately;
+* requests arriving **while a dispatch is in flight** are admitted
+  into the queue and land in the very next iteration's slots — they
+  ride the device's own execution wall instead of an artificial timer.
+  Each such request's servescope span is stamped ``slotted`` (and
+  ``serving.slotted_admissions`` counts them all), so mid-flight
+  admission is provable per request;
+* batching still emerges — it is driven by the device being busy
+  (arrivals during an iteration pile up for the next one) rather than
+  by a timer — and every admission-control edge of the base class is
+  inherited unchanged: validation, queue-limit backpressure, deadline
+  rejection before *and* after device time, drain semantics, and the
+  full servescope lifecycle taxonomy.
+
+The scheduler state this class adds on top of `DynamicBatcher` is one
+flag, ``_in_flight``, only ever written under the base class's
+``_cond`` lock: True from the moment an iteration's slots are taken to
+the moment its last response is fulfilled.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import profiler as _prof
+from .. import servescope as _ss
+from ..serving.batcher import DynamicBatcher
+
+__all__ = ["ContinuousBatcher"]
+
+
+def _c(name):
+    return _prof.counter(name, "serving")
+
+
+class ContinuousBatcher(DynamicBatcher):
+    """Slot-based continuous batching over FrozenModel's buckets.
+
+    Accepts the same constructor knobs as `DynamicBatcher` so the two
+    are drop-in interchangeable from `ModelServer`; ``max_delay_ms`` is
+    accepted for that symmetry but never used — this scheduler has no
+    coalescing hold by construction.
+    """
+
+    def __init__(self, model, max_batch=None, max_delay_ms=0.0,
+                 queue_limit=256, default_timeout_ms=1000.0):
+        super().__init__(model, max_batch=max_batch,
+                         max_delay_ms=max_delay_ms,
+                         queue_limit=queue_limit,
+                         default_timeout_ms=default_timeout_ms)
+        self._in_flight = False    # written only under self._cond
+
+    # -- admission --------------------------------------------------------
+    def _on_admit(self, req):
+        # called by the base submit() under self._cond, right after the
+        # request landed in the queue: if an iteration is executing on
+        # the device right now, this request will ride the NEXT
+        # iteration's slots — the mid-flight admission the coalescing
+        # scheduler cannot do
+        if self._in_flight:
+            _c("serving.slotted_admissions").increment()
+            if req.span is not None:
+                _ss.spans.mark_slotted(req.span)
+
+    # -- dispatch loop ----------------------------------------------------
+    def _gather(self):
+        """Take everything queued (up to max_batch) the moment anything
+        is queued — no hold window. Returns [] at shutdown."""
+        with self._cond:
+            while not self._q:
+                if self._stopped:
+                    return []
+                self._cond.wait(0.05)
+            gather_start = time.perf_counter()
+            batch = []
+            while self._q and len(batch) < self.max_batch:
+                batch.append(self._q.popleft())
+            _prof.set_gauge("serving.queue_depth", len(self._q), "serving")
+            # slots are taken: from here until this iteration's fulfil
+            # fan-out completes, arrivals are mid-flight admissions
+            self._in_flight = True
+            if _ss._SS is not None:
+                for req in batch:
+                    if req.span is not None:
+                        _ss.spans.mark_gather(req.span, gather_start)
+            return batch
+
+    def _serve(self, batch):
+        try:
+            super()._serve(batch)
+        finally:
+            with self._cond:
+                self._in_flight = False

@@ -1,0 +1,164 @@
+"""Replica — one serving process-in-miniature plus its fleet identity.
+
+A `Replica` wraps one `ModelServer` with the state the router needs to
+dispatch to it safely:
+
+* **health snapshot** — the last deep ``/healthz`` body (taken over the
+  real HTTP wire, the same path an external load balancer would poll),
+  its status code, and a consecutive-failure count so one dropped poll
+  does not flap the replica out of rotation;
+* **draining flag** — the *router-side* exclusion bit used by draining
+  deploys. Distinct from the server's own ``_draining``: the router
+  stops sending first, the server keeps serving what it already has;
+* **outstanding count** — how many router-forwarded requests are in
+  flight on this replica right now (incremented before the forward,
+  decremented when the response lands, under the router's lock). This
+  is the ground truth a drain waits on, and the freshest half of the
+  load score — the polled queue depth is at worst one poll interval
+  stale.
+
+The load score the router minimizes is ``outstanding + polled queue
+depth``, with a large constant penalty when the replica's last deep
+health carried a flagged resharding verdict — commscope's "accidental
+all-gather on the serve path" is a per-request p99 catastrophe
+(docs/commscope.md), so a layout-clean replica always wins over a
+flagged one, and a flagged one still serves when it is all we have.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+
+__all__ = ["Replica", "RESHARD_PENALTY"]
+
+# load-score penalty for a replica whose deep health flags resharding
+# collectives on any compiled bucket: larger than any realistic queue
+# depth so clean replicas always win, finite so a degraded fleet still
+# serves
+RESHARD_PENALTY = 1_000_000
+
+
+class Replica:
+    """One ModelServer + the router-facing view of it.
+
+    Two ownership modes, one interface: in-process (``server`` is the
+    `ModelServer` object — tests, single-core debug) and spawned
+    (``proc`` is the worker subprocess, ``host``/``port`` from its
+    readiness handshake — the scaling mode; see `fleet/worker.py`).
+    The router never branches on the mode: addressing, probing and the
+    load score read identically over the HTTP wire either way."""
+
+    def __init__(self, name, server=None, proc=None, host=None,
+                 port=None):
+        self.name = str(name)
+        self.server = server
+        self.proc = proc               # worker subprocess (spawn mode)
+        self._host = host
+        self._port = port
+        self.cache_stats = None        # worker-reported warmup cache hits
+        self.draining = False          # router-side exclusion (deploys)
+        self.outstanding = 0           # router-held in-flight forwards
+        self.last_health = None        # last deep /healthz body
+        self.health_code = None
+        self.healthy = False           # no poll yet -> not routable
+        self.consecutive_failures = 0
+
+    # -- addressing -------------------------------------------------------
+    @property
+    def host(self):
+        return self.server.host if self.server is not None else self._host
+
+    @property
+    def port(self):
+        return self.server.port if self.server is not None else self._port
+
+    @property
+    def address(self):
+        return f"http://{self.host}:{self.port}"
+
+    # -- health -----------------------------------------------------------
+    def probe(self, timeout=2.0):
+        """One deep ``GET /healthz`` over the wire; updates the
+        snapshot and returns ``(code, body)``. Raises on transport
+        errors (the router counts those as poll failures)."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            self.health_code = resp.status
+            self.last_health = body
+            self.healthy = resp.status == 200
+            self.consecutive_failures = 0
+            return resp.status, body
+        finally:
+            conn.close()
+
+    def http_get(self, path, timeout=5.0):
+        """GET a JSON document from this replica (``/stats`` mostly)."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    # -- routing inputs ---------------------------------------------------
+    def queue_depth(self) -> int:
+        """Queue depth from the last deep-health poll (0 when no poll
+        has landed yet)."""
+        checks = (self.last_health or {}).get("checks") or {}
+        try:
+            return int(checks.get("queue_depth") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def resharding_flagged(self) -> bool:
+        """Did the last deep health carry a flagged resharding verdict
+        on any compiled bucket?"""
+        checks = (self.last_health or {}).get("checks") or {}
+        resh = checks.get("resharding") or {}
+        return bool(resh.get("buckets_flagged"))
+
+    def live_queue_depth(self) -> int:
+        """The freshest queue depth available — the in-process batcher
+        when we own the server object, else one probe over the wire
+        (what a drain's settle condition polls)."""
+        if self.server is not None:
+            return self.server.batcher.queue_depth
+        try:
+            self.probe(timeout=2.0)
+        except Exception:  # noqa: BLE001 — a dead replica queues nothing
+            return 0
+        return self.queue_depth()
+
+    def load_score(self) -> int:
+        """What the router minimizes: live outstanding forwards + the
+        polled queue depth + the resharding penalty when flagged."""
+        score = self.outstanding + self.queue_depth()
+        if self.resharding_flagged():
+            score += RESHARD_PENALTY
+        return score
+
+    def snapshot(self) -> dict:
+        """The per-replica row /stats and mxdiag render."""
+        return {
+            "name": self.name,
+            "address": self.address,
+            "healthy": self.healthy,
+            "health_code": self.health_code,
+            "draining": self.draining,
+            "outstanding": self.outstanding,
+            "queue_depth": self.queue_depth(),
+            "resharding_flagged": self.resharding_flagged(),
+            "consecutive_failures": self.consecutive_failures,
+            "in_process": self.server is not None,
+            "pid": self.proc.pid if self.proc is not None else None,
+        }
+
+    def __repr__(self):
+        return (f"Replica({self.name!r}, {self.address}, "
+                f"healthy={self.healthy}, draining={self.draining})")

@@ -1,0 +1,127 @@
+"""Fleet worker — one replica as its own OS process.
+
+The in-process `ReplicaSet` is perfect for tests and single-core debug,
+but it cannot *scale*: every replica shares the parent's GIL, so the
+request path's Python work (HTTP parse, JSON decode, pad/stack) is
+serialized no matter how many replicas exist — measured on CPU lenet, a
+2-replica in-process fleet is ~30% SLOWER than one bare server. This
+module is the fix: ``ReplicaSet(spec, spawn=True)`` runs each replica
+as ``python -m incubator_mxnet_tpu.fleet.worker --spec <json>`` — its
+own process, its own GIL, its own metrics registry — and the shared
+on-disk `CompileCache` becomes genuinely load-bearing: replica N+1
+deserializes the AOT buckets replica 0 compiled, across process
+boundaries.
+
+Protocol (parent = `ReplicaSet._spawn_one`):
+
+* the worker builds the model from the **spec** (a model-zoo name +
+  freeze arguments — a closure cannot cross a process boundary), starts
+  a `ModelServer`, then prints ONE readiness line to stdout::
+
+      MXTPU_FLEET_WORKER ready host=H port=P pid=N \\
+          cache_hits=H cache_misses=M cache_stores=S
+
+  The cache numbers are the worker's own registry snapshot at ready
+  time — how the parent proves replica N+1's warmup was a cache hit
+  without reaching into another process's metrics.
+* the worker then serves until SIGTERM/SIGINT, drains its batcher
+  (`stop(drain=True)`: every queued request settles, none dropped),
+  and exits 0. Deploys are rolling **respawns**: drain at the router,
+  start a fresh worker (warming from the shared cache), retire the old
+  process — replicas are cattle, not pets.
+
+Spec keys: ``model`` (model-zoo name), ``classes``, ``model_kwargs``,
+``input_shape`` (per-sample), ``dtype``, ``quantize``
+(``int8``/``bf16``/absent), ``batcher``, ``cache_dir`` (shared
+`CompileCache` directory), ``host``, ``server`` (ModelServer kwargs:
+``max_delay_ms`` / ``queue_limit`` / ``default_timeout_ms``), and
+``events`` (``{path, run_id, rank}`` — opens this worker's own
+``mxtpu.events/1`` log, mergeable with ``mxdiag.py merge``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+__all__ = ["READY_TAG", "build_model", "main"]
+
+READY_TAG = "MXTPU_FLEET_WORKER"
+
+
+def build_model(spec):
+    """Freeze (and optionally quantize) the spec'd model-zoo network,
+    warming through the shared compile cache when one is configured."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models import get_model
+
+    net = get_model(spec["model"], classes=int(spec.get("classes", 10)),
+                    **(spec.get("model_kwargs") or {}))
+    net.initialize(init=mx.init.Xavier())
+    cache = None
+    if spec.get("cache_dir"):
+        from .cache import CompileCache
+        cache = CompileCache(spec["cache_dir"])
+    frozen = net.freeze(input_shape=tuple(spec["input_shape"]),
+                        dtype=spec.get("dtype", "float32"),
+                        compile_cache=cache)
+    if spec.get("quantize"):
+        frozen = frozen.quantize(spec["quantize"], compile_cache=cache)
+    return frozen
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m incubator_mxnet_tpu.fleet.worker",
+        description="one serving replica as its own process "
+                    "(spawned by fleet.ReplicaSet, not run by hand)")
+    ap.add_argument("--spec", required=True,
+                    help="replica spec JSON, inline or @/path/to/file")
+    args = ap.parse_args(argv)
+    raw = args.spec
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    spec = json.loads(raw)
+
+    from .. import profiler as _prof
+    from ..healthmon import events as _events
+    from ..serving.server import ModelServer
+
+    ev = spec.get("events") or {}
+    if ev.get("path"):
+        _events.open_log(ev["path"], run_id=ev.get("run_id", "fleet"),
+                         rank=int(ev.get("rank", 0)))
+
+    model = build_model(spec)
+    srv = ModelServer(model, host=spec.get("host") or "127.0.0.1",
+                      batcher=spec.get("batcher", "continuous"),
+                      **(spec.get("server") or {}))
+    host, port = srv.start()
+
+    snap = _prof.counters()
+
+    def cache_count(name):
+        return int(snap.get(f"fleet/fleet.compile_cache_{name}", 0))
+
+    # the ONE readiness line the parent handshake parses
+    print(f"{READY_TAG} ready host={host} port={port} pid={os.getpid()} "
+          f"cache_hits={cache_count('hits')} "
+          f"cache_misses={cache_count('misses')} "
+          f"cache_stores={cache_count('stores')}", flush=True)
+
+    stop_evt = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_a: stop_evt.set())
+    stop_evt.wait()
+    # drain, never drop: queued requests settle before the process exits
+    srv.stop(drain=True)
+    _events.close_log()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,25 @@ FAMILY_TABLES = {
         "autotune/autotune.best_busy_fraction": "gauge",
         "autotune/autotune.trials_last_search": "gauge",
     },
+    # docs/serving.md — continuous batching + replica fleet (PR 16)
+    "fleet": {
+        "fleet/fleet.routed": "counter",
+        "fleet/fleet.routed_errors": "counter",
+        "fleet/fleet.retries": "counter",
+        "fleet/fleet.no_replica_available": "counter",
+        "fleet/fleet.health_polls": "counter",
+        "fleet/fleet.health_poll_errors": "counter",
+        "fleet/fleet.drains": "counter",
+        "fleet/fleet.readmits": "counter",
+        "fleet/fleet.swaps": "counter",
+        "fleet/fleet.compile_cache_hits": "counter",
+        "fleet/fleet.compile_cache_misses": "counter",
+        "fleet/fleet.compile_cache_stores": "counter",
+        "fleet/fleet.compile_cache_errors": "counter",
+        "fleet/fleet.replicas": "gauge",
+        "fleet/fleet.replicas_healthy": "gauge",
+        "fleet/fleet.forward_ms": "histogram",
+    },
     # docs/mxlint.md — static analyzer + strict-mode jit auditor (PR 14)
     "mxlint": {
         "mxlint/mxlint.strict": "gauge",

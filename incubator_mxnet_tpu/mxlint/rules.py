@@ -389,6 +389,9 @@ class UnnormalizedDeviceKindRule(Rule):
 # share module state (each runs at least one daemon thread)
 THREADED_MODULES = (
     "serving/batcher.py",
+    "fleet/continuous.py",
+    "fleet/router.py",
+    "fleet/cache.py",
     "io/prefetch.py",
     "resilience/checkpoint.py",
     "resilience/elastic.py",

@@ -54,7 +54,8 @@ from ..diagnostics import flight as _flight
 from ..healthmon import events as _events
 
 __all__ = ["RequestSpan", "COMPONENTS", "components_of", "begin",
-           "mark_gather", "mark_batch", "finish", "reject"]
+           "mark_gather", "mark_slotted", "mark_batch", "finish",
+           "reject"]
 
 # the closed component taxonomy (docs/servescope.md); trace_check
 # validates every published attribution against exactly this set
@@ -74,7 +75,8 @@ class RequestSpan:
 
     __slots__ = ("request_id", "t_admit", "gather_start", "t_dispatched",
                  "t_device_done", "t_respond", "bucket", "real",
-                 "batch_id", "batch_index", "timings", "status")
+                 "batch_id", "batch_index", "timings", "status",
+                 "slotted")
 
     def __init__(self, request_id: int, t_admit: float):
         self.request_id = request_id
@@ -89,6 +91,7 @@ class RequestSpan:
         self.batch_index = None
         self.timings = None
         self.status = "admitted"
+        self.slotted = False
 
 
 def components_of(span: RequestSpan) -> dict:
@@ -152,6 +155,15 @@ def mark_gather(span, gather_start: float):
     span.status = "coalesced"
 
 
+def mark_slotted(span):
+    """Continuous-batching admission mark: this request was admitted
+    while a dispatch was already in flight and landed in the NEXT
+    iteration's slots (it never sat through a coalescing hold). The
+    mark rides the span into the flight/events emission so mid-flight
+    admission is provable per request, not just in aggregate."""
+    span.slotted = True
+
+
 def mark_batch(span, batch_id: int, bucket: int, real: int,
                t_dispatched: float, t_device_done: float,
                timings: dict | None):
@@ -196,6 +208,8 @@ def _emit(span, comp):
     batch_id joins against the per-dispatch ``serving.batch`` record)."""
     args = {"request_id": span.request_id, "status": span.status,
             "bucket": span.bucket, "batch_id": span.batch_id}
+    if span.slotted:
+        args["slotted"] = True
     if comp is not None:
         args["e2e_ms"] = round(comp["e2e_ms"], 3)
         for key in COMPONENTS:

@@ -36,7 +36,8 @@ extra wiring. See docs/serving.md.
 from __future__ import annotations
 
 from .errors import (ServingError, InvalidInputError, QueueFullError,
-                     DeadlineExceededError, ServerClosedError)
+                     DeadlineExceededError, ServerClosedError,
+                     ReshardingGateError)
 from .frozen import FrozenModel, default_buckets
 from .batcher import DynamicBatcher, Request
 from .server import ModelServer
@@ -45,5 +46,5 @@ __all__ = [
     "FrozenModel", "default_buckets", "DynamicBatcher", "Request",
     "ModelServer",
     "ServingError", "InvalidInputError", "QueueFullError",
-    "DeadlineExceededError", "ServerClosedError",
+    "DeadlineExceededError", "ServerClosedError", "ReshardingGateError",
 ]

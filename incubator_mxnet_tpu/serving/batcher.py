@@ -138,15 +138,35 @@ class DynamicBatcher:
         with self._cond:
             self._closed = True
             if not drain:
-                while self._q:
-                    req = self._q.popleft()
-                    req._fulfil(error=ServerClosedError(
-                        "server stopped before this request was served"))
-                    _c("serving.rejected_closed").increment()
+                self._flush_closed_locked()
             self._stopped = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        # drain backstop: with a dead / never-started dispatcher (or a
+        # join that timed out) there is nobody left to serve what is
+        # still queued — without this flush those clients hang in
+        # req.wait() until their wait timeout. Every flushed request
+        # gets a settled rejected_closed span, same as a reject at
+        # submit.
+        with self._cond:
+            self._flush_closed_locked()
+        _prof.set_gauge("serving.queue_depth", 0, "serving")
+
+    def _flush_closed_locked(self):
+        """Reject everything still queued after close (caller holds
+        ``self._cond``): counter + settled span + ServerClosedError to
+        the waiting client — the same taxonomy a reject-at-submit gets,
+        so a drained-away request is never distinguishable from one
+        that was turned away at the door."""
+        now = time.perf_counter()
+        while self._q:
+            req = self._q.popleft()
+            _c("serving.rejected_closed").increment()
+            if req.span is not None:
+                _ss.spans.reject(req.span, "rejected_closed", now)
+            req._fulfil(error=ServerClosedError(
+                "server stopped before this request was served"))
         _prof.set_gauge("serving.queue_depth", 0, "serving")
 
     @property
@@ -194,9 +214,15 @@ class DynamicBatcher:
                 raise QueueFullError(
                     f"request queue at capacity ({self.queue_limit})")
             self._q.append(req)
+            self._on_admit(req)
             _prof.set_gauge("serving.queue_depth", len(self._q), "serving")
             self._cond.notify()
         return req
+
+    def _on_admit(self, req):
+        """Admission hook, called under ``self._cond`` right after the
+        request lands in the queue. The base batcher does nothing; the
+        continuous batcher stamps mid-flight admissions here."""
 
     def predict(self, x, timeout_ms=None):
         """Blocking submit-and-wait convenience."""

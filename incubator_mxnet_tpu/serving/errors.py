@@ -10,7 +10,8 @@ raises one of these from `wait()`, including during shutdown drain.
 from __future__ import annotations
 
 __all__ = ["ServingError", "InvalidInputError", "QueueFullError",
-           "DeadlineExceededError", "ServerClosedError"]
+           "DeadlineExceededError", "ServerClosedError",
+           "ReshardingGateError"]
 
 
 class ServingError(RuntimeError):
@@ -48,3 +49,13 @@ class ServerClosedError(ServingError):
     """The server/batcher is draining or stopped; no new work accepted."""
 
     code = 503
+
+
+class ReshardingGateError(ServingError):
+    """A mesh-sharded FrozenModel compile produced resharding
+    collectives (commscope's accidental-all-gather verdict) on the
+    serve path — a per-request p99 catastrophe, refused at deploy time
+    rather than discovered in production tails. Fix the layout (or pass
+    ``reshard_gate=False`` to serve degraded, flagged in /healthz)."""
+
+    code = 500

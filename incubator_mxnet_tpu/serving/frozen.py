@@ -40,7 +40,7 @@ from ..gluon.block import HybridBlock, _flatten_out, _unflatten_out
 from ..gluon.parameter import DeferredInitializationError, _ParamTraceScope
 from ..ndarray import NDArray
 from ..ndarray import random as ndrandom
-from .errors import InvalidInputError
+from .errors import InvalidInputError, ReshardingGateError
 
 __all__ = ["FrozenModel", "default_buckets"]
 
@@ -87,10 +87,37 @@ class FrozenModel:
         Donate the input buffer to the executable. Default: only on
         backends that support donation (not CPU, where XLA would warn
         and ignore it).
+    compute_dtype : str, optional
+        Execute the forward in this dtype ("bfloat16"/"bf16") while the
+        request/response surface stays `dtype`: params are cast once at
+        freeze, the input is cast on entry, floating outputs are cast
+        back on exit. None/"float32" leaves the path untouched.
+    mesh : Mesh, optional
+        Shard the frozen params across this device mesh via the
+        resolution layer (`parallel.sharding.resolve_param` — logical
+        axis rules, counted replicated fallback) and compile every
+        bucket as a GSPMD program over it.
+    mesh_mode : str
+        Commscope layout-signature mode for the resharding detector
+        ("auto" default; "dp"/"mp"/"fsdp" narrow the expected kinds).
+    reshard_gate : bool
+        With a mesh, refuse to deploy (raise
+        :class:`ReshardingGateError`) when any compiled bucket's
+        optimized HLO contains resharding collectives — an accidental
+        all-gather per request is a p99 catastrophe, caught at freeze
+        time. Default True; False serves degraded with the verdict
+        still flagged in /healthz + /stats.
+    compile_cache : optional
+        A `fleet.CompileCache`-shaped object (``load(lowered)`` /
+        ``store(lowered, compiled)``): buckets found in the cache are
+        deserialized instead of compiled, so replica N+1 of a fleet
+        skips the XLA compiles replica 0 already paid for.
     """
 
     def __init__(self, block, input_shape, dtype="float32",
-                 batch_buckets=None, ctx=None, warmup=True, donate=None):
+                 batch_buckets=None, ctx=None, warmup=True, donate=None,
+                 compute_dtype=None, mesh=None, mesh_mode="auto",
+                 reshard_gate=True, compile_cache=None):
         if not isinstance(block, HybridBlock):
             raise TypeError("FrozenModel requires a HybridBlock (or "
                             f"SymbolBlock), got {type(block).__name__}")
@@ -98,6 +125,16 @@ class FrozenModel:
         self._input_shape = tuple(int(d) for d in input_shape)
         self._dtype = np.dtype(dtype)
         self._ctx = ctx
+        self._mesh = mesh
+        self._mesh_mode = mesh_mode
+        self._compile_cache = compile_cache
+        self._compute = None
+        if compute_dtype is not None and str(compute_dtype) != "float32":
+            if str(compute_dtype) not in ("bfloat16", "bf16"):
+                raise ValueError(
+                    f"compute_dtype must be 'float32' or 'bfloat16', "
+                    f"got {compute_dtype!r}")
+            self._compute = jax.numpy.bfloat16
         self.buckets = tuple(sorted(batch_buckets)) if batch_buckets \
             else default_buckets()
 
@@ -107,11 +144,30 @@ class FrozenModel:
                                  else jax.device_put(p.data()._data,
                                                      ctx.device)
                                  for p in params)
+        if self._compute is not None:
+            # cast once at freeze: floating params live in the compute
+            # dtype for the model's lifetime (integer tables untouched)
+            self._param_raws = tuple(
+                r.astype(self._compute)
+                if jax.numpy.issubdtype(r.dtype, jax.numpy.floating)
+                else r for r in self._param_raws)
+        self._x_sharding = None
+        self._key = jax.random.PRNGKey(0)  # inference: dropout is identity
+        if mesh is not None:
+            # the resolution layer decides each param's placement
+            # (logical axis rules; counted replicated fallback); the
+            # request batch and the trace key ride replicated
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.sharding import resolve_param
+            self._param_raws = tuple(
+                jax.device_put(r, resolve_param(p, mesh))
+                for p, r in zip(params, self._param_raws))
+            self._x_sharding = NamedSharding(mesh, PartitionSpec())
+            self._key = jax.device_put(self._key, self._x_sharding)
         if donate is None:
             donate = jax.default_backend() not in ("cpu",)
         self.donate = bool(donate)
 
-        self._key = jax.random.PRNGKey(0)  # inference: dropout is identity
         self._out_tree = None
         raw_fn = self._make_raw_fn()
         self._jit = jax.jit(raw_fn,
@@ -121,6 +177,8 @@ class FrozenModel:
             self._compile_bucket(b, warmup)
         _prof.set_gauge("serving.compiled_buckets", len(self._exec),
                         "serving")
+        if mesh is not None and reshard_gate:
+            self._check_reshard_gate()
 
     # -- freezing ---------------------------------------------------------
     def _frozen_params(self, block):
@@ -144,9 +202,17 @@ class FrozenModel:
     def _make_raw_fn(self):
         block = self._block
         param_ids = self._param_ids
+        compute = self._compute
+        out_dtype = self._dtype
         info = {}
 
         def raw_fn(key_raw, p_raws, x_raw):
+            if compute is not None:
+                # the compute-dtype boundary: requests stay `dtype` on
+                # the wire, the forward runs in bf16, floating outputs
+                # come back in `dtype` (int outputs — argmax heads —
+                # pass through)
+                x_raw = x_raw.astype(compute)
             sub = dict(zip(param_ids, p_raws))
             # recording=False, training=False: pure inference semantics —
             # BN running stats are read, never written; dropout passes
@@ -156,22 +222,43 @@ class FrozenModel:
                 out = block.forward(NDArray(x_raw))
                 leaves, tree = _flatten_out(out)
             info["tree"] = tree
-            return tuple(x._data for x in leaves)
+            outs = tuple(x._data for x in leaves)
+            if compute is not None:
+                outs = tuple(
+                    o.astype(out_dtype)
+                    if jax.numpy.issubdtype(o.dtype, jax.numpy.floating)
+                    else o for o in outs)
+            return outs
 
         self._raw_info = info
         return raw_fn
 
     def _compile_bucket(self, b, warmup):
         shape = (b,) + self._input_shape
-        x_spec = jax.ShapeDtypeStruct(shape, self._dtype)
+        if self._x_sharding is not None:
+            x_spec = jax.ShapeDtypeStruct(shape, self._dtype,
+                                          sharding=self._x_sharding)
+        else:
+            x_spec = jax.ShapeDtypeStruct(shape, self._dtype)
         if _flight._REC is not None:
             _flight.record("compile", f"serving.freeze:b{b}",
                            {"shape": list(shape), "dtype": str(self._dtype)})
         with _prof.Scope(f"serving.compile:b{b}", "serving", sync=False):
+            # lower always (it is cheap tracing, and it learns the
+            # output tree); the expensive compile consults the shared
+            # AOT cache first — a hit deserializes replica 0's
+            # executable instead of recompiling it
             lowered = self._jit.lower(self._key, self._param_raws, x_spec)
-            self._exec[b] = lowered.compile()
+            compiled = (self._compile_cache.load(lowered)
+                        if self._compile_cache is not None else None)
+            if compiled is None:
+                compiled = lowered.compile()
+                if self._compile_cache is not None:
+                    self._compile_cache.store(lowered, compiled)
+            self._exec[b] = compiled
         if self._out_tree is None:
             self._out_tree = self._raw_info["tree"]
+        commscoped = False
         if _ps._PS is not None:
             # the bucket is already lowered — the roofline verdict is a
             # free host-side read here (no extra trace). The compiled
@@ -180,14 +267,47 @@ class FrozenModel:
             _ps.analyze_lowered(
                 lowered, name=self.program_name(b),
                 dtype=self._dtype, kind="serving_bucket",
-                extra={"bucket": b}, compiled=self._exec[b])
+                extra={"bucket": b}, compiled=self._exec[b],
+                mesh=self._mesh, mode=self._mesh_mode)
+            try:
+                from .. import commscope as _cs
+                commscoped = _cs._CS is not None
+            except Exception:  # noqa: BLE001
+                commscoped = False
+        if self._mesh is not None and not commscoped:
+            # the resharding gate must see a verdict even with the
+            # observability stack unarmed: hand the compiled HLO to
+            # commscope's extractor directly (total, never raises)
+            try:
+                from .. import commscope as _cs
+                _cs.capture(self.program_name(b), compiled=self._exec[b],
+                            mesh=self._mesh, mode=self._mesh_mode,
+                            kind="serving_bucket", extra={"bucket": b})
+            except Exception:  # noqa: BLE001 — verdicts, not serving
+                pass
         _prof.counter("serving.compiles", "serving").increment()
         if warmup:
             x0 = np.zeros(shape, self._dtype)
-            outs = self._exec[b](self._key, self._param_raws,
-                                 jax.numpy.asarray(x0))
+            outs = self.run_raw(x0)
             jax.block_until_ready(outs)
             _prof.counter("serving.warmup_runs", "serving").increment()
+
+    def _check_reshard_gate(self):
+        """Refuse a sharded deploy whose compiled buckets contain
+        resharding collectives (commscope's verdict over the optimized
+        HLO) — the accidental all-gather is caught at freeze time, not
+        in production p99."""
+        verdicts = self.comm_verdicts()
+        flagged = sorted(b for b, v in verdicts.items()
+                         if v.get("resharding_collectives"))
+        if flagged:
+            detail = {b: verdicts[b]["resharding_collectives"]
+                      for b in flagged}
+            raise ReshardingGateError(
+                f"sharded serve path for {self._block.name!r} contains "
+                f"resharding collectives in buckets {detail} — fix the "
+                f"param layout (see docs/commscope.md) or pass "
+                f"reshard_gate=False to serve degraded")
 
     # -- execution --------------------------------------------------------
     @property
@@ -279,7 +399,10 @@ class FrozenModel:
         if ex is None:
             raise InvalidInputError(
                 f"no compiled bucket for batch {n}; buckets={self.buckets}")
-        return ex(self._key, self._param_raws, jax.numpy.asarray(x))
+        xj = jax.numpy.asarray(x)
+        if self._x_sharding is not None:
+            xj = jax.device_put(xj, self._x_sharding)
+        return ex(self._key, self._param_raws, xj)
 
     def predict_batch(self, x: np.ndarray, timings: dict | None = None) \
             -> list:
@@ -327,6 +450,44 @@ class FrozenModel:
         leaves = [NDArray(jax.numpy.asarray(o)) for o in outs]
         return _unflatten_out(self._out_tree, leaves)
 
+    # -- quantization -----------------------------------------------------
+    def quantize(self, mode="int8", calib_data=None, calib_mode=None,
+                 exclude=(), **freeze_kwargs):
+        """A NEW serving-ready FrozenModel in reduced precision; this
+        model keeps serving float32 unchanged from its frozen snapshot.
+
+        * ``mode="bf16"`` — same block, ``compute_dtype="bfloat16"``:
+          params cast once at freeze, activations computed in bf16,
+          floating outputs cast back; the request/response dtype is
+          untouched. No calibration needed.
+        * ``mode="int8"`` — `contrib.quantization.quantize_net` swaps
+          every Dense/Conv2D for its int8 twin (symmetric, per-output-
+          channel weight scales; with ``calib_data`` + ``calib_mode``
+          the activation scales are baked static first). NOTE: the
+          conversion mutates the underlying block in place (the contrib
+          contract); this FrozenModel's already-compiled executables
+          and its frozen param snapshot are unaffected, but the source
+          block object the caller holds is converted.
+
+        ``freeze_kwargs`` override the new freeze (``mesh=``,
+        ``compile_cache=``, ``batch_buckets=``, ...); buckets and ctx
+        default to this model's.
+        """
+        kw = {"batch_buckets": self.buckets, "ctx": self._ctx}
+        kw.update(freeze_kwargs)
+        if mode in ("bf16", "bfloat16"):
+            kw.setdefault("compute_dtype", "bfloat16")
+            return FrozenModel(self._block, self._input_shape,
+                               dtype=self._dtype.name, **kw)
+        if mode == "int8":
+            from ..contrib.quantization import quantize_net
+            qnet = quantize_net(self._block, calib_data=calib_data,
+                                exclude=exclude, calib_mode=calib_mode)
+            return FrozenModel(qnet, self._input_shape,
+                               dtype=self._dtype.name, **kw)
+        raise ValueError(
+            f"quantize mode must be 'int8' or 'bf16', got {mode!r}")
+
     # -- checkpoints ------------------------------------------------------
     @staticmethod
     def from_exported(prefix, input_shape, epoch=0, input_name="data",
@@ -340,6 +501,11 @@ class FrozenModel:
         return FrozenModel(block, input_shape, ctx=ctx, **kwargs)
 
     def __repr__(self):
-        return (f"FrozenModel(input={self._input_shape}, "
-                f"dtype={self._dtype.name}, buckets={self.buckets}, "
-                f"donate={self.donate})")
+        bits = [f"FrozenModel(input={self._input_shape}",
+                f"dtype={self._dtype.name}", f"buckets={self.buckets}",
+                f"donate={self.donate}"]
+        if self._compute is not None:
+            bits.append("compute=bfloat16")
+        if self._mesh is not None:
+            bits.append(f"mesh={dict(self._mesh.shape)}")
+        return ", ".join(bits) + ")"

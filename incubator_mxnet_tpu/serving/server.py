@@ -45,7 +45,7 @@ from .. import profiler as _prof
 from .. import resilience as _resilience
 from .. import servescope as _ss
 from .batcher import DynamicBatcher
-from .errors import InvalidInputError, ServingError
+from .errors import InvalidInputError, ServerClosedError, ServingError
 from .frozen import FrozenModel
 
 __all__ = ["ModelServer"]
@@ -65,7 +65,7 @@ class ModelServer:
 
     def __init__(self, model, input_shape=None, host=None, port=None,
                  max_batch=None, max_delay_ms=None, queue_limit=None,
-                 default_timeout_ms=None, **freeze_kwargs):
+                 default_timeout_ms=None, batcher=None, **freeze_kwargs):
         if not isinstance(model, FrozenModel):
             if input_shape is None:
                 raise ValueError("input_shape is required when passing an "
@@ -75,19 +75,39 @@ class ModelServer:
         from ..autotune.knobs import env_int, env_str
         self.host = host or env_str("MXTPU_SERVING_HOST", "127.0.0.1")
         self.port = env_int("MXTPU_SERVING_PORT", 0, call_site=port)
-        self.batcher = DynamicBatcher(
-            model,
-            max_batch=max_batch or
+        # scheduler selection: "dynamic" (coalesce-then-dispatch, the
+        # sporadic-traffic default) or "continuous" (iteration-level
+        # slots, the fleet/sustained-load path — docs/serving.md)
+        self.batcher_kind = env_str("MXTPU_SERVING_BATCHER", "dynamic",
+                                    call_site=batcher)
+        if self.batcher_kind not in ("dynamic", "continuous"):
+            raise ValueError(f"batcher must be 'dynamic' or 'continuous',"
+                             f" got {self.batcher_kind!r}")
+        self._batcher_settings = {
+            "max_batch": max_batch or
             env_int("MXTPU_SERVING_MAX_BATCH", 0) or None,
-            max_delay_ms=max_delay_ms if max_delay_ms is not None
+            "max_delay_ms": max_delay_ms if max_delay_ms is not None
             else _env_float("MXTPU_SERVING_MAX_DELAY_MS", 5.0),
-            queue_limit=queue_limit or
+            "queue_limit": queue_limit or
             env_int("MXTPU_SERVING_QUEUE_LIMIT", 256),
-            default_timeout_ms=default_timeout_ms if default_timeout_ms
-            is not None else _env_float("MXTPU_SERVING_TIMEOUT_MS", 1000.0))
+            "default_timeout_ms": default_timeout_ms
+            if default_timeout_ms is not None
+            else _env_float("MXTPU_SERVING_TIMEOUT_MS", 1000.0)}
+        self.batcher = self._make_batcher(model)
         self._httpd = None
         self._started_at = None
         self._draining = False
+
+    def _make_batcher(self, model):
+        """One batcher of the server's configured kind over `model` —
+        shared by construction and `swap_model` so a hot-swapped model
+        serves under exactly the same scheduler + knobs."""
+        if self.batcher_kind == "continuous":
+            from ..fleet.continuous import ContinuousBatcher
+            cls = ContinuousBatcher
+        else:
+            cls = DynamicBatcher
+        return cls(model, **self._batcher_settings)
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -97,6 +117,11 @@ class ModelServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # loopback p99 killer: headers and body leave as separate
+            # small segments, and Nagle holds the second until the
+            # first is ACKed — which the peer's delayed ACK sits on for
+            # ~40 ms. TCP_NODELAY turns that stall into microseconds.
+            disable_nagle_algorithm = True
 
             def _reply(self, code, obj):
                 body = json.dumps(obj).encode()
@@ -136,11 +161,28 @@ class ModelServer:
                     except (ValueError, TypeError) as e:
                         raise InvalidInputError(str(e)) from e
                     t0 = time.perf_counter()
-                    req = server.batcher.submit(
-                        x, timeout_ms=doc.get("timeout_ms"))
+                    # swap-safe admission: a hot swap may close the
+                    # batcher we read between the read and the submit —
+                    # when a NEW batcher has already been published,
+                    # resubmit there instead of bouncing the client
+                    # (zero dropped requests across a deploy); a real
+                    # drain (batcher unchanged) still raises 503
+                    for _ in range(8):
+                        b = server.batcher
+                        try:
+                            req = b.submit(
+                                x, timeout_ms=doc.get("timeout_ms"))
+                            break
+                        except ServerClosedError:
+                            if server.batcher is b:
+                                raise
+                    else:
+                        raise ServerClosedError(
+                            "server is swapping models faster than "
+                            "requests can be admitted")
                     outs = req.wait(
                         (doc.get("timeout_ms")
-                         or server.batcher.default_timeout_ms) / 1e3 + 30.0)
+                         or b.default_timeout_ms) / 1e3 + 30.0)
                     out = outs[0] if len(outs) == 1 else outs
                     self._reply(200, {
                         "output": (out.tolist() if isinstance(out, np.ndarray)
@@ -184,6 +226,28 @@ class ModelServer:
         self._draining = False
         _prof.set_gauge("serving.up", 1, "serving")
         return self.host, self.port
+
+    def swap_model(self, model, input_shape=None, **freeze_kwargs):
+        """Zero-downtime model hot-swap (the deploy primitive under
+        `fleet.Router.deploy`): build and START the new model's batcher
+        first, publish it atomically (`self.batcher` — the request
+        handler re-reads it per request, and resubmits there if it
+        raced the old one's close), then drain the old batcher so every
+        request it had already accepted is served. At no instant is
+        there no admitting batcher, so a swap drops zero requests even
+        under concurrent load."""
+        if not isinstance(model, FrozenModel):
+            if input_shape is None:
+                raise ValueError("input_shape is required when passing an "
+                                 "unfrozen block")
+            model = FrozenModel(model, input_shape, **freeze_kwargs)
+        new_batcher = self._make_batcher(model).start()
+        old = self.batcher
+        self.model = model
+        self.batcher = new_batcher
+        _prof.counter("serving.model_swaps", "serving").increment()
+        old.stop(drain=True)
+        return model
 
     def stop(self, drain: bool = True):
         """Graceful shutdown: mark draining (healthz 503), stop
@@ -310,6 +374,7 @@ class ModelServer:
         s["max_batch"] = self.batcher.max_batch
         s["max_delay_ms"] = self.batcher.max_delay_s * 1e3
         s["queue_limit"] = self.batcher.queue_limit
+        s["batcher"] = self.batcher_kind
         verdicts = self.model.comm_verdicts()
         if verdicts:
             s["resharding"] = verdicts

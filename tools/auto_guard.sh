@@ -40,6 +40,14 @@ if timeout 900 bash tools/serve_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) serve smoke FAILED (continuing; serving path suspect)" >> "$LOG"
 fi
+# fleet smoke (CPU-only): continuous batching live under load,
+# zero-drop draining deploys, and the 2-replica spawned fleet's
+# artifacts must validate before any fleet claim is trusted
+if timeout 1200 bash tools/fleet_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) fleet smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) fleet smoke FAILED (continuing; fleet path suspect)" >> "$LOG"
+fi
 # healthmon smoke (CPU-only 2-proc cluster + overhead budget): cross-rank
 # health must hold before trusting any distributed run's telemetry
 if timeout 1200 bash tools/health_smoke.sh >> "$LOG" 2>&1; then

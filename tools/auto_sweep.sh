@@ -40,6 +40,13 @@ if timeout 900 bash tools/serve_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) serve smoke FAILED (continuing; serving path suspect)" >> "$LOG"
 fi
+# fleet smoke (CPU-only): continuous batching + draining deploys +
+# spawned 2-replica fleet artifacts must validate before the sweep
+if timeout 1200 bash tools/fleet_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) fleet smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) fleet smoke FAILED (continuing; fleet path suspect)" >> "$LOG"
+fi
 # healthmon smoke (CPU-only 2-proc cluster + overhead budget): the
 # cross-rank health layer must validate before any distributed sweep
 if timeout 1200 bash tools/health_smoke.sh >> "$LOG" 2>&1; then

@@ -38,6 +38,12 @@ coalesce_delay / pad_overhead / device_exec / respond) with roofline +
 resharding verdicts, and the one-line advice ("p99 is 83% queue_wait
 at bucket 128 - raise max_batch, not the kernel").
 
+`fleet`: the replica-fleet report from a serve_load ``--fleet`` BENCH
+json (`extra.fleet`) — per-replica dispatch table with client-observed
+tails, the dispatch-imbalance ratio, the shared compile-cache verdict
+(replica N+1's warmup: hit or recompile?), and the drain/swap/readmit
+deploy timeline from the events log.
+
 `tune`: the autotune report from a BENCH json (`extra.autotune`) —
 cache hit/miss verdict, the trial table with measured busy fraction /
 step wall / MFU / score provenance per config, the pruning reasons
@@ -51,6 +57,7 @@ Usage:
     python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py device BENCH.json
     python tools/mxdiag.py serve BENCH.json
+    python tools/mxdiag.py fleet BENCH.json [--events EVENTS.jsonl]
     python tools/mxdiag.py tune BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
@@ -783,6 +790,101 @@ def _serve_main(argv) -> int:
     return print_serve(doc)
 
 
+def print_fleet(doc: dict, events_path=None) -> int:
+    """The fleet report from a serve_load ``--fleet`` BENCH json
+    (`extra.fleet`): the per-replica dispatch table with
+    client-observed tails, the imbalance ratio, the shared
+    compile-cache verdict (did replica N+1's warmup hit?), and — when
+    the events log is reachable — the drain/swap/readmit deploy
+    timeline."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    fl = extra.get("fleet")
+    if not isinstance(fl, dict):
+        print("\n  no extra.fleet section — this BENCH json is not a "
+              "serve_load --fleet run (try `mxdiag.py serve` instead)")
+        return 1
+    print(f"\n  fleet: {fl.get('replicas')} replicas "
+          f"({fl.get('batcher')} batcher), dispatch imbalance "
+          f"{fl.get('dispatch_imbalance')} (max/mean; 1.0 = perfectly "
+          f"balanced)")
+    print(f"  router: {fl.get('routed')} routed, "
+          f"{fl.get('routed_errors', 0)} forward errors, "
+          f"{fl.get('no_replica_available', 0)} x no-replica-available")
+    rows = fl.get("per_replica") or []
+    if rows:
+        print("\n  replica        requests  dispatched        qps  "
+              "p50/p95/p99 ms")
+        for row in rows:
+            pcts = "/".join(str(row.get(k, "-"))
+                            for k in ("p50_ms", "p95_ms", "p99_ms"))
+            print(f"    {row.get('name', '?'):<12} {row.get('requests', 0):>9}"
+                  f"  {row.get('dispatched', 0):>10}  {row.get('qps', 0):>9}"
+                  f"  {pcts}")
+    cache = fl.get("compile_cache")
+    if isinstance(cache, dict):
+        hits = cache.get("hits", 0)
+        misses = cache.get("misses", 0)
+        verdict = ("replica warmups were cache hits (no duplicate XLA "
+                   "compiles)" if hits else
+                   "NO cache hits — every replica recompiled from "
+                   "scratch (cold or unshared cache dir?)")
+        print(f"\n  shared AOT cache ({fl.get('cache_dir')}): "
+              f"{hits} hits / {misses} misses / "
+              f"{cache.get('stores', 0)} stores — {verdict}")
+    # deploy timeline: fleet.drain / fleet.swap / fleet.readmit events
+    path = events_path or extra.get("events_file")
+    deploys = []
+    if path and os.path.exists(path):
+        try:
+            with open(path) as f:
+                for ln in f:
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("kind") == "fleet":
+                        deploys.append(rec)
+        except OSError:
+            pass
+    if deploys:
+        t0 = deploys[0].get("ts") or 0
+        print(f"\n  deploy timeline ({len(deploys)} fleet events):")
+        for rec in deploys:
+            args = rec.get("args") or {}
+            dt = (rec.get("ts") or 0) - t0
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
+            print(f"    +{dt:8.3f}s  {rec.get('name'):<14} {detail}")
+    elif path:
+        print(f"\n  no fleet drain/swap/readmit events in {path} "
+              f"(no deploy happened during this run)")
+    return 0
+
+
+def _fleet_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py fleet",
+        description="replica-fleet report from a serve_load --fleet "
+                    "BENCH json (extra.fleet)")
+    ap.add_argument("path", help="BENCH json (serve_load.py --fleet "
+                                 "output or the driver wrapper)")
+    ap.add_argument("--events", default=None,
+                    help="mxtpu.events/1 log for the deploy timeline "
+                         "(default: the json's extra.events_file)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 1
+    return print_fleet(doc, events_path=args.events)
+
+
 # ---------------------------------------------------------------------------
 # merge: cross-rank timeline from per-rank flight dumps / event logs
 # ---------------------------------------------------------------------------
@@ -1104,6 +1206,8 @@ def main(argv=None) -> int:
         return _device_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return _fleet_main(argv[1:])
     if argv and argv[0] == "tune":
         return _tune_main(argv[1:])
     if argv and argv[0] == "recover":

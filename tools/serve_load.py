@@ -31,10 +31,27 @@ dead /healthz) produces a self-describing ``{"status": "env_failure"}``
 artifact — the bench.py convention perf_regress skips — instead of a
 zero that would poison the BENCH trajectory.
 
+With ``--fleet N`` the harness drives a whole replica fleet instead of
+one server: N **spawned worker processes** (each its own GIL, warmed
+through the shared on-disk
+:class:`~incubator_mxnet_tpu.fleet.CompileCache`) behind a
+:class:`~incubator_mxnet_tpu.fleet.Router`, the load aimed at the
+router's front door. The artifact gains ``extra.fleet`` — per-replica
+client-observed QPS/p99 (keyed off the ``replica`` tag the router
+stamps into every reply), the dispatch-imbalance ratio, and the
+router/cache accounting — validated by ``check_fleet_extra`` and
+rendered by ``mxdiag.py fleet``; ``extra.serving`` is the MERGE of the
+workers' ``/stats`` exports (each process owns a registry). The metric
+name grows a ``_fleetN`` suffix so perf_regress's both-sides contract
+compares fleet runs against fleet baselines, never against the
+single-server trajectory. Replica scaling is a multi-core claim: on a
+1-core host the fleet only measures its own routing overhead.
+
 Usage:
     python tools/serve_load.py [--model lenet] [--ramp 4,8,16,32,64]
         [--level-requests 128] [--max-delay-ms 5] [--out BENCH.json]
         [--events EVENTS.jsonl] [--sample N] [--devicescope N]
+        [--fleet N] [--fleet-cache DIR]
 
 Pure helpers (:func:`find_knee`, :func:`run_level`, :func:`sweep`,
 :func:`write_env_failure`) are importable without a backend —
@@ -51,8 +68,8 @@ import threading
 import time
 
 __all__ = ["find_knee", "run_level", "sweep", "build_result",
-           "write_env_failure", "ServerDied", "main",
-           "DEFAULT_RAMP", "KNEE_QPS_GAIN", "KNEE_P99_MULT"]
+           "merge_serving_stats", "write_env_failure", "ServerDied",
+           "main", "DEFAULT_RAMP", "KNEE_QPS_GAIN", "KNEE_P99_MULT"]
 
 DEFAULT_RAMP = "4,8,16,32,64"
 # knee rules: saturation begins at the first level whose marginal QPS
@@ -198,6 +215,63 @@ def sweep(send_fn, ramp, level_requests: int, log=print,
 # artifacts
 # ---------------------------------------------------------------------------
 
+def _hist_quantile(buckets, count, q):
+    """Prometheus-style quantile estimate from a cumulative bucket dict
+    (upper bound of the first bucket covering the target rank; the
+    largest finite bound stands in for +Inf)."""
+    target = q * count
+    finite = sorted(((float(le), c) for le, c in buckets.items()
+                     if le not in ("+Inf", "inf")), key=lambda x: x[0])
+    for le, c in finite:
+        if c >= target:
+            return le
+    return finite[-1][0] if finite else 0.0
+
+
+def merge_serving_stats(snaps) -> dict:
+    """Merge per-replica ModelServer ``/stats`` snapshots into one
+    fleet-wide serving section (the --fleet path: spawned replicas
+    each own a metrics registry, so the aggregate must be computed from
+    their exported snapshots). Counters sum; the latency histograms —
+    identical bucket bounds, same histogram family in every process —
+    merge by summing cumulative counts per bound, with percentiles
+    re-estimated from the merged buckets."""
+    merged = {}
+    hist = {"count": 0, "sum": 0.0, "buckets": {}}
+    mins, maxs = [], []
+    for s in snaps:
+        for k, v in s.items():
+            if k == "serving.latency_ms":
+                continue
+            if k.startswith("serving.") and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                merged[k] = merged.get(k, 0) + v
+        h = s.get("serving.latency_ms")
+        if isinstance(h, dict):
+            hist["count"] += h.get("count", 0)
+            hist["sum"] += h.get("sum", 0.0)
+            if h.get("min") is not None:
+                mins.append(h["min"])
+            if h.get("max") is not None:
+                maxs.append(h["max"])
+            for le, c in (h.get("buckets") or {}).items():
+                hist["buckets"][le] = hist["buckets"].get(le, 0) + c
+    if mins:
+        hist["min"] = min(mins)
+    if maxs:
+        hist["max"] = max(maxs)
+    if hist["count"]:
+        for key, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            hist[key] = _hist_quantile(hist["buckets"], hist["count"], q)
+    if hist["buckets"]:
+        merged["serving.latency_ms"] = hist
+    batches = merged.get("serving.batches", 0)
+    merged["batch_fill"] = (
+        merged.get("serving.batched_requests", 0) / batches
+        if batches else 0.0)
+    return merged
+
+
 def build_result(model_name: str, levels, knee_idx: int, reason: str,
                  server_stats: dict, servescope_extra=None,
                  devicescope_extra=None, meta=None) -> dict:
@@ -223,6 +297,8 @@ def build_result(model_name: str, levels, knee_idx: int, reason: str,
                                  0)),
         "rejected_invalid":
             int(server_stats.get("serving.rejected_invalid", 0)),
+        "slotted_admissions":
+            int(server_stats.get("serving.slotted_admissions", 0)),
         "qps": knee["qps"],
         "p50_ms": knee["p50_ms"],
         "p95_ms": knee["p95_ms"],
@@ -294,6 +370,12 @@ def main(argv=None) -> int:
     ap.add_argument("--devicescope", type=int, default=0,
                     help="capture a devicescope window over N dispatches "
                          "of the final ramp level (0 = off)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="drive an N-replica fleet behind the Router "
+                         "instead of one ModelServer (0 = off)")
+    ap.add_argument("--fleet-cache", default=None,
+                    help="shared AOT compile-cache dir for --fleet "
+                         "(default: <out>_cache)")
     ap.add_argument("--out", default="/tmp/mxtpu_serve_load.json")
     ap.add_argument("--events", default=None,
                     help="write the mxtpu.events/1 request/batch stream "
@@ -304,7 +386,10 @@ def main(argv=None) -> int:
     if not ramp:
         print("serve_load: empty --ramp", file=sys.stderr)
         return 2
-    metric = f"serve_load_{args.model}_qps_at_knee"
+    fleet_n = max(0, int(args.fleet))
+    bench_name = (f"{args.model}_fleet{fleet_n}" if fleet_n
+                  else args.model)
+    metric = f"serve_load_{bench_name}_qps_at_knee"
     events_path = args.events or (
         os.path.splitext(args.out)[0] + "_events.jsonl")
 
@@ -337,20 +422,58 @@ def main(argv=None) -> int:
     hm_events.open_log(events_path, run_id=run_id, rank=0)
 
     kwargs = {"layout": "NHWC"} if args.model.startswith("resnet") else {}
-    net = get_model(args.model,
-                    classes=10 if args.model == "lenet" else 1000,
-                    **kwargs)
-    net.initialize(init=mx.init.Xavier())
-    print(f"serve_load: freezing {args.model} (AOT compile + warmup)")
-    frozen = net.freeze(input_shape=shape)
-    srv = serving.ModelServer(
-        frozen, max_delay_ms=args.max_delay_ms,
-        queue_limit=max(256, ramp[-1] * 4),
-        default_timeout_ms=args.timeout_ms)
-    host, port = srv.start()
-    print(f"serve_load: {args.model} at {srv.address} "
-          f"buckets={frozen.buckets} ramp={ramp} "
-          f"x{args.level_requests} req/level")
+
+    def make_model(compile_cache=None):
+        net = get_model(args.model,
+                        classes=10 if args.model == "lenet" else 1000,
+                        **kwargs)
+        net.initialize(init=mx.init.Xavier())
+        return net.freeze(input_shape=shape, compile_cache=compile_cache)
+
+    rset = router = srv = None
+    buckets_list = []
+    if fleet_n:
+        from incubator_mxnet_tpu import fleet as fleet_mod
+        cache_dir = args.fleet_cache or \
+            (os.path.splitext(args.out)[0] + "_cache")
+        # spawned workers: each replica is its own PROCESS (own GIL —
+        # in-process replicas cannot out-scale one bare server), warmed
+        # through the shared on-disk AOT cache
+        spec = {"model": args.model,
+                "classes": 10 if args.model == "lenet" else 1000,
+                "model_kwargs": kwargs,
+                "input_shape": list(shape),
+                "batcher": "continuous",
+                "cache_dir": cache_dir,
+                "server": {"max_delay_ms": args.max_delay_ms,
+                           "queue_limit": max(256, ramp[-1] * 4),
+                           "default_timeout_ms": args.timeout_ms}}
+        print(f"serve_load: spawning {fleet_n} {args.model} worker "
+              f"processes (shared AOT cache at {cache_dir})")
+        rset = fleet_mod.ReplicaSet(spec, n=fleet_n, spawn=True)
+        rset.start()
+        router = fleet_mod.Router(rset)
+        host, port = router.start()
+        try:
+            _, r0 = rset.replicas[0].http_get("/stats")
+            buckets_list = list(r0.get("buckets") or [])
+        except Exception:  # noqa: BLE001 — cosmetic only
+            pass
+        print(f"serve_load: {args.model} fleet({fleet_n}) router at "
+              f"{router.address} buckets={buckets_list} ramp={ramp} "
+              f"x{args.level_requests} req/level")
+    else:
+        print(f"serve_load: freezing {args.model} (AOT compile + warmup)")
+        frozen = make_model()
+        srv = serving.ModelServer(
+            frozen, max_delay_ms=args.max_delay_ms,
+            queue_limit=max(256, ramp[-1] * 4),
+            default_timeout_ms=args.timeout_ms)
+        host, port = srv.start()
+        buckets_list = list(frozen.buckets)
+        print(f"serve_load: {args.model} at {srv.address} "
+              f"buckets={frozen.buckets} ramp={ramp} "
+              f"x{args.level_requests} req/level")
 
     import http.client
     rng = np.random.RandomState(0)
@@ -366,11 +489,23 @@ def main(argv=None) -> int:
     # retransmit timeouts (measured: exact 1s/3s modes)
     tls = threading.local()
 
+    # --fleet: client-observed per-replica latencies, keyed off the
+    # `replica` tag the router stamps into every reply (the ONLY place
+    # per-replica p99 exists: the in-process replicas share one metrics
+    # registry, so server-side counters are already fleet-aggregated)
+    fleet_lock = threading.Lock()
+    fleet_lats = {}
+
     def send(i):
         conn = getattr(tls, "conn", None)
         if conn is None:
             conn = tls.conn = http.client.HTTPConnection(
                 host, port, timeout=120)
+            conn.connect()
+            import socket as _socket
+            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+        t0 = time.perf_counter()
         try:
             conn.request("POST", "/predict", body=bodies[i % len(bodies)],
                          headers={"Content-Type": "application/json"})
@@ -384,6 +519,15 @@ def main(argv=None) -> int:
             finally:
                 tls.conn = None
             raise
+        if fleet_n:
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            try:
+                rep = json.loads(data).get("replica")
+            except ValueError:
+                rep = None
+            if rep:
+                with fleet_lock:
+                    fleet_lats.setdefault(rep, []).append(dt_ms)
 
     win = None
 
@@ -403,25 +547,91 @@ def main(argv=None) -> int:
               f"{e}", file=sys.stderr)
         write_env_failure(args.out, metric, str(e))
         hm_events.close_log()
+        if router is not None:
+            router.stop()
+        if rset is not None:
+            rset.stop(drain=False)
         return 0
     finally:
         if win is not None:
             win.stop()
 
     knee_idx, reason = find_knee(levels)
-    stats = srv.stats()            # ONE cumulative registry snapshot
-    servescope_extra = servescope.bench_extra()
+    # ONE cumulative snapshot per replica. Spawned replicas each own a
+    # metrics registry, so the fleet-wide serving section is the MERGE
+    # of their /stats exports (counters sum, histograms merge by
+    # bucket).
+    if fleet_n:
+        snaps = []
+        for rep in rset.replicas:
+            try:
+                code, s = rep.http_get("/stats")
+                if code == 200:
+                    snaps.append(s)
+            except Exception as e:  # noqa: BLE001 — partial fleet stats
+                print(f"serve_load: /stats from {rep.name} failed: {e}",
+                      file=sys.stderr)
+        stats = merge_serving_stats(snaps)
+    else:
+        stats = srv.stats()
+    fleet_meta = None
+    if fleet_n:
+        router_stats = router.stats()
+        sweep_wall = sum(lv["wall_s"] for lv in levels) or 1.0
+        rows = []
+        for rep in rset.replicas:
+            lats = sorted(fleet_lats.get(rep.name, []))
+            row = {"name": rep.name, "requests": len(lats),
+                   "qps": round(len(lats) / sweep_wall, 2),
+                   "dispatched": router_stats.get(
+                       "dispatch_counts", {}).get(rep.name, 0)}
+            if lats:
+                row.update(p50_ms=round(_percentile(lats, 0.50), 3),
+                           p95_ms=round(_percentile(lats, 0.95), 3),
+                           p99_ms=round(_percentile(lats, 0.99), 3))
+            rows.append(row)
+        fleet_meta = {
+            "replicas": fleet_n,
+            "batcher": "continuous",
+            "cache_dir": cache_dir,
+            "per_replica": rows,
+            "dispatch_counts": router_stats.get("dispatch_counts"),
+            "dispatch_imbalance": round(
+                router_stats.get("dispatch_imbalance", 0.0), 4),
+            "routed": int(router_stats.get("fleet.routed", 0)),
+            "routed_errors": int(
+                router_stats.get("fleet.routed_errors", 0)),
+            "no_replica_available": int(
+                router_stats.get("fleet.no_replica_available", 0)),
+            # worker-reported warmup cache traffic (each worker owns
+            # its registry; the readiness handshake carries these)
+            "compile_cache": {
+                key: sum(int((rep.cache_stats or {}).get(key, 0))
+                         for rep in rset.replicas)
+                for key in ("hits", "misses", "stores")
+            },
+        }
+    # spawned replicas trace their own spans in their own processes —
+    # the parent has no servescope data to attribute in fleet mode
+    servescope_extra = None if fleet_n else servescope.bench_extra()
     ds_extra = devicescope.bench_extra() if win is not None else None
-    srv.stop()
+    if fleet_n:
+        router.stop()
+        rset.stop(drain=True)
+    else:
+        srv.stop()
     hm_events.close_log()
 
-    doc = build_result(args.model, levels, knee_idx, reason, stats,
+    meta = {"run_id": run_id, "events_file": events_path,
+            "buckets": buckets_list,
+            "max_delay_ms": args.max_delay_ms,
+            "level_requests": args.level_requests}
+    if fleet_meta is not None:
+        meta["fleet"] = fleet_meta
+    doc = build_result(bench_name, levels, knee_idx, reason, stats,
                        servescope_extra=servescope_extra,
                        devicescope_extra=ds_extra,
-                       meta={"run_id": run_id, "events_file": events_path,
-                             "buckets": list(frozen.buckets),
-                             "max_delay_ms": args.max_delay_ms,
-                             "level_requests": args.level_requests})
+                       meta=meta)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)
     knee = levels[knee_idx]

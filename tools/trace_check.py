@@ -94,6 +94,8 @@ RESILIENCE_FAMILIES = _families.family_table("resilience")
 AUTOTUNE_FAMILIES = _families.family_table("autotune")
 # mxlint.* — the strict-mode jit-program auditor (docs/mxlint.md)
 MXLINT_FAMILIES = _families.family_table("mxlint")
+# fleet.* — continuous batching + replica fleet (docs/serving.md)
+FLEET_FAMILIES = _families.family_table("fleet")
 
 # sharding modes a BENCH extra.sharding may declare (parallel/sharding.py)
 SHARDING_MODES = ("dp", "fsdp", "auto")
@@ -309,6 +311,7 @@ def check_healthmon_kinds(kinds: dict) -> list:
                "RESILIENCE_FAMILIES"),
               ("autotune/", AUTOTUNE_FAMILIES, "AUTOTUNE_FAMILIES"),
               ("mxlint/", MXLINT_FAMILIES, "MXLINT_FAMILIES"),
+              ("fleet/", FLEET_FAMILIES, "FLEET_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -1260,6 +1263,80 @@ def check_serve_load_extra(sl) -> list:
     return errors
 
 
+def check_fleet_extra(fl) -> list:
+    """Validate an `extra.fleet` BENCH section (tools/serve_load.py
+    ``--fleet N`` runs): a replica count that matches the per-replica
+    rows, client-observed per-replica QPS + ordered percentiles, a
+    dispatch-imbalance ratio that is mathematically possible (max/mean
+    >= 1 once anything was dispatched), and router accounting that
+    covers the per-replica totals."""
+    if fl is None:
+        return []
+    if not isinstance(fl, dict):
+        return [f"must be an object, got {type(fl).__name__}"]
+    errors = []
+    n = fl.get("replicas")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        errors.append(f"replicas must be an int >= 1, got {n!r}")
+    rows = fl.get("per_replica")
+    if not isinstance(rows, list) or not rows:
+        return errors + ["needs a non-empty 'per_replica' list"]
+    if isinstance(n, int) and not isinstance(n, bool) and n >= 1 \
+            and len(rows) != n:
+        errors.append(f"per_replica has {len(rows)} rows but "
+                      f"replicas={n}")
+    names = set()
+    total_requests = 0
+    for i, row in enumerate(rows):
+        where = f"per_replica[{i}]"
+        if not isinstance(row, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        name = row.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: needs a non-empty 'name'")
+        elif name in names:
+            errors.append(f"{where}: duplicate replica name {name!r}")
+        else:
+            names.add(name)
+        reqs = row.get("requests")
+        if not isinstance(reqs, int) or isinstance(reqs, bool) \
+                or reqs < 0:
+            errors.append(f"{where}: requests must be an int >= 0, "
+                          f"got {reqs!r}")
+        else:
+            total_requests += reqs
+        q = row.get("qps")
+        if not _is_num(q) or q < 0:
+            errors.append(f"{where}: qps must be >= 0, got {q!r}")
+        pcts = [row.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+        if reqs:
+            if not all(_is_num(p) for p in pcts):
+                errors.append(f"{where}: needs numeric p50/p95/p99_ms, "
+                              f"got {pcts!r}")
+            elif not (pcts[0] <= pcts[1] <= pcts[2]):
+                errors.append(f"{where}: percentiles must be ordered, "
+                              f"got {pcts!r}")
+    imb = fl.get("dispatch_imbalance")
+    if total_requests:
+        # max/mean over a non-degenerate dispatch is >= 1 by definition;
+        # anything below 1 means the numbers were not computed from the
+        # same counts
+        if not _is_num(imb) or imb < 1.0:
+            errors.append(f"dispatch_imbalance must be >= 1 once "
+                          f"requests flowed, got {imb!r}")
+    routed = fl.get("routed")
+    if not _is_num(routed) or routed < 0:
+        errors.append(f"routed must be >= 0, got {routed!r}")
+    elif routed < total_requests:
+        errors.append(f"routed={routed} < sum of per-replica "
+                      f"requests={total_requests} (lost accounting)")
+    for key in ("routed_errors", "no_replica_available"):
+        if key in fl and (not _is_num(fl[key]) or fl[key] < 0):
+            errors.append(f"{key} must be >= 0, got {fl[key]!r}")
+    return errors
+
+
 def check_sharding_extra(sh) -> list:
     """Validate an `extra.sharding` BENCH section (bench.py BENCH_MESH
     runs): a positive mesh shape, a mode from the closed taxonomy, and
@@ -1410,6 +1487,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.serve_load: {e}"
                for e in check_serve_load_extra(
                    (doc.get("extra") or {}).get("serve_load"))]
+    errors += [f"extra.fleet: {e}"
+               for e in check_fleet_extra(
+                   (doc.get("extra") or {}).get("fleet"))]
     errors += [f"extra.resilience: {e}"
                for e in check_resilience_extra(
                    (doc.get("extra") or {}).get("resilience"))]
