@@ -1059,6 +1059,45 @@ def _ensure_bench_rec(n, size):
     return rec
 
 
+def _io_slow_transform():
+    """BENCH_IO_SLOW_MS: injected per-batch decode latency (a sleep in
+    the decode pool's transform hook) — the smoke's stand-in for an
+    expensive augment/parse, so a CPU box can demonstrate that the pool
+    hides decode wall behind compute. Returns (transform|None, ms)."""
+    ms = float(os.environ.get("BENCH_IO_SLOW_MS", "0") or 0)
+    if ms <= 0:
+        return None, 0.0
+
+    def slow(x, y, _s=ms / 1e3):
+        time.sleep(_s)
+        return x, y
+    return slow, ms
+
+
+def _io_extra(workers, depth, slow_ms=0.0):
+    """extra.io: the ingest pipeline's geometry + per-stage walls, read
+    from the io.* counter family (trace_check's check_io_extra
+    validates the shape; docs/io.md explains reading the split)."""
+    from incubator_mxnet_tpu import profiler as prof
+    c = prof.counters()
+
+    def ms(k):
+        return round(float(c.get(f"io/io.{k}", 0.0)), 3)
+
+    io = {"workers": int(workers), "depth": int(depth),
+          "batches_prefetched": int(c.get("io/io.batches_prefetched", 0)),
+          "wait_ms": ms("wait_ms"), "read_ms": ms("read_ms"),
+          "decode_ms": ms("decode_ms"), "stage_ms": ms("stage_ms"),
+          "put_ms": ms("put_ms")}
+    if c.get("io/io.batches_skipped"):
+        io["batches_skipped"] = int(c["io/io.batches_skipped"])
+    if c.get("io/io.records_read"):
+        io["records_read"] = int(c["io/io.records_read"])
+    if slow_ms:
+        io["slow_ms"] = float(slow_ms)
+    return io
+
+
 def _record_data_bench(mode, batch, steps, dtype):
     """BENCH_DATA=record | record_cached: ResNet-50 trained from the real
     JPEG input path instead of synthetic tensors.
@@ -1209,6 +1248,151 @@ def _record_data_bench(mode, batch, steps, dtype):
     return result
 
 
+def _ensure_token_rec(n, seq, vocab):
+    """Synthetic indexed .rec of n int32 token sequences (cached on
+    disk beside the JPEG benches' records). Each record is one packed
+    (seq,) int32 row — the LM analogue of the JPEG file."""
+    from incubator_mxnet_tpu import recordio
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_rec")
+    os.makedirs(d, exist_ok=True)
+    rec = os.path.join(d, f"tokens_{seq}_{n}.rec")
+    idx = os.path.join(d, f"tokens_{seq}_{n}.idx")
+    if os.path.exists(rec) and os.path.exists(idx):
+        return rec
+    _log(f"building synthetic token record file: {n} rows @ seq {seq}")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        toks = rng.randint(0, vocab, (seq,)).astype(np.int32)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, 0.0, i, 0), toks.tobytes()))
+    w.close()
+    return rec
+
+
+def _token_record_bench(batch, steps, dtype):
+    """BENCH_DATA=record x BENCH_MODEL=transformer_lm: causal-LM
+    training fed from the indexed record path through the staged ingest
+    pipeline (ShardedRecordReader → DevicePrefetcher) instead of
+    synthetic tensors — token rows unpack on the reader thread, batches
+    assemble and run the optional transform in the decode pool, and the
+    transfer stage lands them on device. The LM twin of
+    _record_data_bench; reports the same data-path vs end-to-end split
+    plus extra.io stage walls."""
+    from incubator_mxnet_tpu.io.pipeline import ShardedRecordReader
+    from incubator_mxnet_tpu.io.prefetch import DevicePrefetcher
+    from incubator_mxnet_tpu.recordio import unpack
+    net, L, x, _y, flops_per_sample, tag = _build_transformer_lm(batch,
+                                                                 dtype)
+    seq = int(x.shape[1])
+    vocab = 50257
+    n_rec = int(os.environ.get("BENCH_REC_IMAGES", str(max(4 * batch,
+                                                           256))))
+    rec = _ensure_token_rec(n_rec, seq, vocab)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              wd=1e-4,
+                              multi_precision=(dtype == "bfloat16"))
+    from incubator_mxnet_tpu.autotune import knobs as _knobs
+    _kc = _knobs.KnobConfig.from_env()
+    step = FusedTrainStep(net, L, opt, remat=_kc.remat,
+                          remat_policy=_kc.remat_policy)
+
+    def decode_row(payload):
+        _h, s = unpack(payload)
+        return np.frombuffer(s, np.int32).reshape(seq)
+
+    reader = ShardedRecordReader(rec[:-4] + ".idx", rec,
+                                 decode_fn=decode_row)
+
+    def batches():
+        it = iter(reader)
+        while True:
+            rows = []
+            while len(rows) < batch:
+                try:
+                    rows.append(next(it))
+                except StopIteration:
+                    reader.reset()
+                    it = iter(reader)
+            xb = np.stack(rows)
+            yield xb, xb       # causal LM: the loss shifts internally
+
+    io_tf, io_slow_ms = _io_slow_transform()
+    pf = DevicePrefetcher(batches(), depth=_kc.prefetch_depth,
+                          workers=_kc.io_workers, transform=io_tf)
+
+    # data-path-only rate: how fast can the sharded reader + pool feed?
+    probe_steps = max(4, min(steps, 8))
+    next(pf)                                      # spin up the stages
+    t0 = time.time()
+    for _ in range(probe_steps):
+        xb, yb = next(pf)
+    np.asarray(xb)[:1]                            # materialize
+    data_rate = batch * probe_steps / (time.time() - t0)
+
+    _log("compiling fused train step (token record path)")
+    xb, yb = next(pf)
+    from incubator_mxnet_tpu import profiler as prof
+    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+        lambda: float(step(nd.NDArray(xb), nd.NDArray(yb))),
+        lambda: float(step(*map(nd.NDArray, next(pf)))))
+
+    _log(f"timing {steps} end-to-end steps @ batch {batch} "
+         f"(token record)")
+    from incubator_mxnet_tpu.mxlint import runtime as _mxa_mod
+    strict_aud = _mxa_mod.auditor()
+    if strict_aud is not None:
+        strict_aud.mark_warmup_done()
+    budget = _perfscope_budget()
+    ds_win = _devicescope_window(steps)
+    t0 = time.time()
+    with prof.record_function("bench.steady", "bench", sync=False):
+        for _ in range(steps):
+            td = time.perf_counter()
+            nb = tuple(map(nd.NDArray, next(pf)))
+            loss = _strict_guarded(strict_aud, lambda: step(*nb))
+            disp_s = time.perf_counter() - td
+            if budget is not None:
+                budget.add_dispatch(disp_s)
+            if ds_win is not None:
+                ds_win.step(1, dispatch_ms=disp_s * 1e3,
+                            sync=lambda: float(loss), workload="train")
+        loss_val = float(loss)                    # host fetch = barrier
+    dt = time.time() - t0
+    if ds_win is not None:
+        ds_win.stop()
+    e2e = batch * steps / dt
+    bottleneck = ("input-bound (read/decode host path)"
+                  if data_rate < 1.2 * e2e else "chip-bound")
+    result = {
+        "metric": f"{tag}_samples_per_sec_per_chip",
+        "value": round(e2e, 2),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "extra": {"model": f"{tag}_record", "batch": batch,
+                  "dtype": dtype, "steps": steps,
+                  "mfu": round(_mfu(e2e, flops_per_sample, dtype), 6),
+                  "data_path_samples_s": round(data_rate, 2),
+                  "bottleneck": bottleneck,
+                  "final_loss": round(loss_val, 4),
+                  "device": str(jax.devices()[0])},
+    }
+    result["extra"]["io"] = _io_extra(pf._workers, _kc.prefetch_depth,
+                                      slow_ms=io_slow_ms)
+    result["extra"]["mxlint"] = _mxa_mod.bench_extra()
+    _perfscope_settle(result, budget, steps, dt,
+                      lambda: float(step(*map(nd.NDArray, next(pf)))),
+                      steps_per_call=1,
+                      flops_per_step=flops_per_sample * batch,
+                      dtype=dtype)
+    _finish_profile(result, trace_path, compile_s=compile_s,
+                    warmup_s=warmup_s, steady_s=dt,
+                    step_ms=dt / steps * 1e3)
+    pf.close()
+    return result
+
+
 def main():
     global _CURRENT_METRIC
     _main_t0 = time.time()
@@ -1312,11 +1496,20 @@ def main():
         return
     data_mode = os.environ.get("BENCH_DATA", "synthetic")
     if data_mode in ("record", "record_cached"):
-        if model != "resnet50":
+        if model == "transformer_lm":
+            if data_mode != "record":
+                raise ValueError(
+                    "BENCH_DATA=record_cached is a JPEG-path mode; "
+                    "transformer_lm's token path supports "
+                    "BENCH_DATA=record only")
+            result = _token_record_bench(batch, steps, dtype)
+        elif model == "resnet50":
+            result = _record_data_bench(data_mode, batch, steps, dtype)
+        else:
             raise ValueError(
                 f"BENCH_DATA={data_mode} supports BENCH_MODEL=resnet50 "
-                f"only (the JPEG input path), got {model!r}")
-        result = _record_data_bench(data_mode, batch, steps, dtype)
+                f"(the JPEG input path) or transformer_lm (the token "
+                f"record path), got {model!r}")
         if autotune_extra is not None:
             autotune_extra["resolved"] = \
                 _knobs.KnobConfig.from_env().to_dict()
@@ -1346,12 +1539,15 @@ def main():
         autotune_extra["resolved"] = knob_cfg.to_dict()
     loop_k = knob_cfg.loop_chunk
     loop = None
+    io_tf, io_slow_ms = _io_slow_transform()
     if loop_k > 1:
         from incubator_mxnet_tpu.trainloop import TrainLoop
         loop = TrainLoop(net, L, opt, chunk=loop_k,
                          remat=knob_cfg.remat,
                          remat_policy=knob_cfg.remat_policy,
-                         sharding=shard_mode)
+                         sharding=shard_mode,
+                         io_workers=knob_cfg.io_workers,
+                         io_transform=io_tf)
         step = loop.step
     else:
         step = FusedTrainStep(net, L, opt,
@@ -1530,6 +1726,13 @@ def main():
                   "final_loss": round(loss_val, 4),
                   "device": str(jax.devices()[0])},
     }
+    if loop is not None:
+        # the ingest pipeline ran the steady phase (loop mode is the
+        # only synthetic path with a prefetcher) — its stage walls are
+        # the starvation-attribution record the smoke compares
+        result["extra"]["io"] = _io_extra(loop.io_workers,
+                                          loop.prefetch_depth,
+                                          slow_ms=io_slow_ms)
     if shard_mode is not None:
         # the resolved layout the executor actually compiled: mesh shape,
         # per-param spec counts, fsdp on/off, per-device bytes

@@ -40,6 +40,8 @@ knob               meaning
 ``remat_policy``   what remat saves: ``dots`` / ``nothing`` /
                    ``everything`` (parallel/trainer_step.py)
 ``prefetch_depth`` io.DevicePrefetcher device-side buffer depth
+``io_workers``     io.Pipeline decode-pool width (host threads decoding
+                   ahead of the transfer stage; docs/io.md)
 ``pallas``         kernel-selection master switch: ``auto`` (TPU +
                    self-test gate) / ``on`` / ``force`` / ``off``
 ``mesh``           BENCH_MESH token grammar (``dp4``, ``dp2mp2``,
@@ -60,7 +62,7 @@ __all__ = ["KnobConfig", "KNOB_FIELDS", "PALLAS_MODES", "REMAT_POLICIES",
            "TRUE_SPELLINGS", "FALSE_SPELLINGS"]
 
 KNOB_FIELDS = ("loop_chunk", "remat", "remat_policy", "prefetch_depth",
-               "pallas", "mesh", "batch")
+               "io_workers", "pallas", "mesh", "batch")
 
 # the pallas master-switch states the three historical spellings resolve
 # into (ops/pallas.enabled() order: off beats force beats on beats auto)
@@ -69,8 +71,8 @@ PALLAS_MODES = ("auto", "on", "force", "off")
 REMAT_POLICIES = (None, "dots", "nothing", "everything")
 
 _DEFAULTS = {"loop_chunk": 0, "remat": False, "remat_policy": None,
-             "prefetch_depth": 2, "pallas": "auto", "mesh": None,
-             "batch": None}
+             "prefetch_depth": 2, "io_workers": 2, "pallas": "auto",
+             "mesh": None, "batch": None}
 
 # (BENCH spelling, MXTPU spelling) per knob; pallas resolves through its
 # own three-spelling table below
@@ -79,6 +81,7 @@ _ENV = {"loop_chunk": ("BENCH_LOOP_CHUNK", "MXTPU_LOOP_CHUNK"),
         "remat_policy": ("BENCH_REMAT_POLICY", "MXTPU_REMAT_POLICY"),
         "prefetch_depth": ("BENCH_PREFETCH_DEPTH",
                            "MXTPU_PREFETCH_DEPTH"),
+        "io_workers": ("BENCH_IO_WORKERS", "MXTPU_IO_WORKERS"),
         "mesh": ("BENCH_MESH", "MXTPU_MESH"),
         "batch": ("BENCH_BATCH", None)}
 
@@ -118,7 +121,7 @@ def _parse(field: str, raw: str):
     """Parse one env string into the knob's type. Raises ValueError on
     garbage — a mistyped knob must fail loudly, not silently default."""
     raw = raw.strip()
-    if field in ("loop_chunk", "prefetch_depth", "batch"):
+    if field in ("loop_chunk", "prefetch_depth", "io_workers", "batch"):
         v = int(raw)
         # loop_chunk 0 = stepwise is legal; a zero buffer depth or
         # batch is not — reject HERE, naming the field, so every
@@ -396,11 +399,13 @@ class KnobConfig:
     field."""
 
     def __init__(self, loop_chunk=0, remat=False, remat_policy=None,
-                 prefetch_depth=2, pallas="auto", mesh=None, batch=None):
+                 prefetch_depth=2, io_workers=2, pallas="auto", mesh=None,
+                 batch=None):
         self.loop_chunk = int(loop_chunk)
         self.remat = bool(remat)
         self.remat_policy = remat_policy
         self.prefetch_depth = int(prefetch_depth)
+        self.io_workers = int(io_workers)
         self.pallas = pallas
         self.mesh = mesh or None
         # None = unset; 0 is NOT coerced to unset — the env-parse path
@@ -417,6 +422,9 @@ class KnobConfig:
         if self.prefetch_depth < 1:
             raise ValueError(f"prefetch_depth must be >= 1, "
                              f"got {self.prefetch_depth}")
+        if self.io_workers < 1:
+            raise ValueError(f"io_workers must be >= 1, "
+                             f"got {self.io_workers}")
         if self.remat_policy not in REMAT_POLICIES:
             raise ValueError(f"unknown remat_policy "
                              f"{self.remat_policy!r}; expected one of "
@@ -465,7 +473,8 @@ class KnobConfig:
         scrubs the parent's pallas spellings)."""
         env = {"BENCH_LOOP_CHUNK": str(self.loop_chunk),
                "BENCH_REMAT": "1" if self.remat else "0",
-               "BENCH_PREFETCH_DEPTH": str(self.prefetch_depth)}
+               "BENCH_PREFETCH_DEPTH": str(self.prefetch_depth),
+               "BENCH_IO_WORKERS": str(self.io_workers)}
         if self.remat_policy:
             env["BENCH_REMAT_POLICY"] = self.remat_policy
         if self.pallas == "off":

@@ -10,10 +10,12 @@ knob family only helps one class of idleness:
 ==================  ====================================================
 diagnosis           knobs worth moving
 ==================  ====================================================
-``input_starved``   ``prefetch_depth`` (feed the chip), ``loop_chunk``
-                    (the executor is what the prefetcher rides) — NOT
-                    ``remat_policy``: a recompute knob cannot feed an
-                    input-starved chip
+``input_starved``   ``prefetch_depth`` (feed the chip), ``io_workers``
+                    (widen the decode pool — promoted to FIRST when the
+                    starvation split says decode dominates),
+                    ``loop_chunk`` (the executor is what the prefetcher
+                    rides) — NOT ``remat_policy``: a recompute knob
+                    cannot feed an input-starved chip
 ``dispatch_bound``  ``loop_chunk`` (amortize the per-step host
                     dispatch); deeper prefetch buys nothing — the
                     buffer is not empty, the host is
@@ -53,6 +55,7 @@ __all__ = ["SPACE", "prune_plan", "candidates", "apply_knob",
 SPACE = {
     "loop_chunk": (0, 4, 8),
     "prefetch_depth": (2, 4, 8),
+    "io_workers": (1, 2, 4, 8),
     "remat_policy": (None, "dots", "nothing"),
     "pallas": ("auto", "off"),
 }
@@ -108,13 +111,24 @@ def prune_plan(measurement, mesh_candidates=(), batch_candidates=()):
 
     allowed, pruned = [], {}
     if diagnosis == "input_starved":
-        allowed = ["prefetch_depth", "loop_chunk"]
+        allowed = ["prefetch_depth", "io_workers", "loop_chunk"]
+        # the pipeline's stage walls (extra.devicescope.gaps
+        # .input_starved_split) say WHICH ingest stage starves the
+        # chip: when host decode dominates, a deeper buffer just
+        # drains slower — the decode pool is the move, so io_workers
+        # leads the trial order
+        split = m.get("starved_split") or {}
+        if split.get("dominant") == "decode":
+            allowed = ["io_workers", "prefetch_depth", "loop_chunk"]
         pruned["remat_policy"] = ("input-starved: a recompute knob "
                                   "cannot feed the chip")
         pruned["pallas"] = ("input-starved: kernel selection is not "
                             "the bottleneck")
     elif diagnosis == "dispatch_bound":
         allowed = ["loop_chunk", "prefetch_depth"]
+        pruned["io_workers"] = ("dispatch-bound: the decode pool is "
+                                "keeping up — the buffer is not empty, "
+                                "the host dispatch is the gap")
         pruned["remat_policy"] = ("dispatch-bound: the chip idles "
                                   "between programs, not inside them")
         pruned["pallas"] = ("dispatch-bound: cheaper kernels widen the "
@@ -125,11 +139,13 @@ def prune_plan(measurement, mesh_candidates=(), batch_candidates=()):
                                 "buys nothing on a busy chip")
         pruned["prefetch_depth"] = ("device-bound: the buffer is never "
                                     "the wait")
+        pruned["io_workers"] = ("device-bound: ingest already keeps "
+                                "the buffer full")
     else:
         # no measured window: nothing to prune WITH — the core knobs
         # stay explorable and throughput decides
-        allowed = ["loop_chunk", "prefetch_depth", "remat_policy",
-                   "pallas"]
+        allowed = ["loop_chunk", "prefetch_depth", "io_workers",
+                   "remat_policy", "pallas"]
 
     # the mesh axis: only when the collective counterfactual promises a
     # real gain AND the caller supplied layouts to try

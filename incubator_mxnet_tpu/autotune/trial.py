@@ -47,8 +47,9 @@ SCORE_SOURCES = ("measured(profile)", "host_wall")
 _SCRUB_PREFIXES = ("BENCH_",)
 _SCRUB_EXACT = ("MXTPU_AUTOTUNE", "MXTPU_LOOP_CHUNK", "MXTPU_REMAT",
                 "MXTPU_REMAT_POLICY", "MXTPU_PREFETCH_DEPTH",
-                "MXTPU_MESH", "MXTPU_PALLAS", "MXTPU_NO_PALLAS",
-                "MXTPU_FORCE_PALLAS", "MXTPU_DEVICESCOPE")
+                "MXTPU_IO_WORKERS", "MXTPU_MESH", "MXTPU_PALLAS",
+                "MXTPU_NO_PALLAS", "MXTPU_FORCE_PALLAS",
+                "MXTPU_DEVICESCOPE")
 
 
 def _repo_root() -> str:
@@ -77,15 +78,22 @@ def measurement_from_artifact(doc: dict) -> dict:
     bf = float(bf) if isinstance(bf, (int, float)) \
         and not isinstance(bf, bool) else None
     gaps = None
-    if isinstance(ds.get("gaps"), dict) \
-            and isinstance(ds["gaps"].get("taxonomy"), dict):
-        gaps = dict(ds["gaps"]["taxonomy"])
+    starved_split = None
+    if isinstance(ds.get("gaps"), dict):
+        if isinstance(ds["gaps"].get("taxonomy"), dict):
+            gaps = dict(ds["gaps"]["taxonomy"])
+        if isinstance(ds["gaps"].get("input_starved_split"), dict):
+            # per-stage ingest attribution (read/decode/transfer) —
+            # lets prune_plan pick io_workers over prefetch_depth when
+            # the starvation is a decode problem
+            starved_split = dict(ds["gaps"]["input_starved_split"])
     dec = (extra.get("perfscope") or {}).get("decomposition") or {}
     mfu = extra.get("mfu")
     value = doc.get("value") if isinstance(doc, dict) else None
     return {
         "busy_fraction": bf,
         "gaps": gaps,
+        "starved_split": starved_split,
         "step_ms": dec.get("step_ms"),
         "mfu": mfu if isinstance(mfu, (int, float)) else None,
         "mfu_if_removed": dec.get("mfu_if_removed"),

@@ -204,6 +204,36 @@ def _gap_histogram(gaps_ms):
     return hist
 
 
+def _starved_split(input_starved_ms, counters_delta):
+    """Split the ``input_starved`` bucket into disk / decode / transfer
+    attribution from the io pipeline's per-stage wall deltas
+    (``io.read_ms`` / ``io.decode_ms`` / ``io.put_ms``).
+
+    The stage walls are not spans of the idle gaps themselves — decode
+    runs on N workers concurrently with compute — so they are used as
+    attribution WEIGHTS: each stage's share of the starved time is its
+    share of the summed stage wall, scaled so the split sums to
+    ``input_starved_ms``. Returns None when there is nothing to split
+    (no starvation, or a pre-pipeline artifact with no stage walls) —
+    absent, not zeros, so old artifacts stay schema-stable."""
+    if not input_starved_ms or input_starved_ms <= 0:
+        return None
+    read = max(0.0, float(counters_delta.get("io_read_ms") or 0.0))
+    decode = max(0.0, float(counters_delta.get("io_decode_ms") or 0.0))
+    put = max(0.0, float(counters_delta.get("io_put_ms") or 0.0))
+    total = read + decode + put
+    if total <= 0:
+        return None
+    shares = {"read_ms": read, "decode_ms": decode, "transfer_ms": put}
+    dominant = {"read_ms": "read", "decode_ms": "decode",
+                "transfer_ms": "transfer"}[max(shares, key=shares.get)]
+    return {
+        **{k: round(v / total * input_starved_ms, 4)
+           for k, v in shares.items()},
+        "dominant": dominant,
+    }
+
+
 def _axis_map_for(program, comms_programs):
     """kind -> mesh axis for one program, from commscope's static
     inventory (None when ambiguous: two axes running the same kind).
@@ -236,7 +266,9 @@ def summarize(events, wall_ms, steps, counters_delta=None,
     wall_ms / steps: the HOST-measured window wall and the step count
     the caller marked — the denominators every per-step number uses.
     counters_delta: ``{"io_wait_ms", "dispatch_ms"}`` deltas over the
-    window (gap taxonomy inputs). program_map: ``hlo_module name ->
+    window (gap taxonomy inputs), plus the optional io stage walls
+    (``io_read_ms`` / ``io_decode_ms`` / ``io_put_ms``) that split the
+    input_starved bucket into disk/decode/transfer attribution. program_map: ``hlo_module name ->
     perfscope program name`` (the join key recorded at compile capture);
     programs: perfscope's program table (roofline verdicts);
     comms_programs: commscope's inventory (mesh-axis attribution).
@@ -353,6 +385,9 @@ def _summarize(events, wall_ms, steps, counters_delta, program_map,
                 "host_gap_ms": round(host_gap, 4),
             },
         }
+        split = _starved_split(input_starved, counters_delta)
+        if split is not None:
+            gaps["input_starved_split"] = split
 
     per_step = None
     if denom:

@@ -42,8 +42,13 @@ __all__ = ["CaptureWindow", "base_dir", "rotate_dirs", "DEFAULT_KEEP"]
 
 DEFAULT_KEEP = 3
 
-# counters the gap taxonomy reads as window-scoped deltas
+# counters the gap taxonomy reads as window-scoped deltas; the io stage
+# walls (read/decode/put) split the input_starved bucket into
+# disk-vs-decode-vs-transfer attribution (ingest.input_starved_split)
 _TRACKED = {"io_wait_ms": "io/io.wait_ms",
+            "io_read_ms": "io/io.read_ms",
+            "io_decode_ms": "io/io.decode_ms",
+            "io_put_ms": "io/io.put_ms",
             "dispatch_ms": "trainloop/trainloop.dispatch_ms"}
 
 

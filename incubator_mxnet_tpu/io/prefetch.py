@@ -1,16 +1,18 @@
-"""On-device double-buffered prefetch (the chip-feeding half of the
-whole-loop executor; reference analogue: the PrefetchingIter + the
-ThreadedEngine IO lane, upgraded to land batches ON DEVICE).
+"""On-device prefetch (the chip-feeding half of the whole-loop
+executor; reference analogue: the PrefetchingIter + the ThreadedEngine
+IO lane, upgraded to land batches ON DEVICE).
 
 `PrefetchingIter` overlaps host-side decode with compute but still hands
 the training loop HOST arrays — the `device_put` (and under a mesh, the
 shard placement) happens synchronously inside the step, on the critical
-path. :class:`DevicePrefetcher` moves that transfer off the path: a
-worker thread pulls batches from the source iterator, optionally groups
-them into whole-loop chunks of k, converts + `jax.device_put`s them with
-the step's batch sharding, and parks up to ``depth`` device-resident
-batches in a bounded buffer. The consumer's ``next()`` is then a queue
-pop of arrays already on the chip.
+path. :class:`DevicePrefetcher` moves that transfer off the path and,
+since PR 17, overlaps the host stages against each other too: it is the
+public face of the staged :class:`~.pipeline.Pipeline` (reader → decode
+pool → ordered staging ring → transfer; see io/pipeline.py and
+docs/io.md for the stage model). Batches are converted +
+`jax.device_put` with the step's batch sharding and parked, up to
+``depth`` deep, in a bounded device-resident buffer. The consumer's
+``next()`` is then a queue pop of arrays already on the chip.
 
 Telemetry (shared counters registry — visible in /metrics, flight dumps,
 and BENCH_*.json like every other family):
@@ -18,56 +20,38 @@ and BENCH_*.json like every other family):
 * ``io/io.batches_prefetched``  counter — batches landed on device;
 * ``io/io.wait_ms``             counter — cumulative ms the CONSUMER
   blocked on the buffer ("TPU starved by input" when this grows);
-* ``io/io.put_ms``              counter — cumulative ms the worker spent
-  converting + transferring (host-side cost of feeding);
+* ``io/io.read_ms``             counter — reader wall inside the
+  source's next() (disk share of the starvation split);
+* ``io/io.decode_ms``           counter — decode-pool wall, summed
+  across workers (host-decode share);
+* ``io/io.stage_ms``            counter — transfer-stage wall waiting
+  for the next in-order chunk (reorder/decode-lag share);
+* ``io/io.put_ms``              counter — cumulative ms spent
+  converting + transferring (host→device share);
 * ``io/io.depth``               gauge — configured buffer depth;
-* ``io/io.buffer_fill``         gauge — buffered batches at last pop.
+* ``io/io.buffer_fill``         gauge — buffered batches at last pop;
+* ``io/io.workers``             gauge — resolved decode-pool width.
 
 Lifecycle: iterate to exhaustion, or ``close()`` early — close() always
-drains the buffer and joins the worker, so device references are dropped
-and nothing leaks when training stops mid-epoch. Context manager does
-the same.
+drains the buffer and joins the stage threads, so device references are
+dropped and nothing leaks when training stops mid-epoch. Context
+manager does the same.
 """
 from __future__ import annotations
 
-import queue as _queue
-import threading
-import time
-
-import numpy as np
-
-from .. import profiler as _prof
+from .pipeline import (Pipeline, _SENTINEL, _raw,  # noqa: F401 — legacy
+                       _split_batch, _stack_dev)   # import surface
 
 __all__ = ["DevicePrefetcher"]
 
-_SENTINEL = object()
-
-# how long close() waits for a worker parked inside the source's next()
-# before abandoning it (daemon thread; nothing can enter the buffer after
-# the stop flag is set)
+# how long close() waits for a reader parked inside the source's next()
+# before abandoning it (daemon threads; nothing can enter the buffer
+# after the stop flag is set). Module-level so tests/operators can tune
+# the tradeoff — read at call time in close().
 _CLOSE_DEADLINE_S = 5.0
 
 
-def _split_batch(b):
-    """Normalize one source item to (x, y): DataBatch, (x, y) pair, or a
-    bare array (y=None)."""
-    data = getattr(b, "data", None)
-    if data is not None and not isinstance(b, (tuple, list, np.ndarray)):
-        label = getattr(b, "label", None)
-        return data[0], (label[0] if label else None)
-    if isinstance(b, (tuple, list)) and len(b) == 2:
-        return b[0], b[1]
-    return b, None
-
-
-def _raw(a):
-    from ..ndarray import NDArray
-    if isinstance(a, NDArray):
-        return a._data
-    return np.asarray(a)
-
-
-class DevicePrefetcher:
+class DevicePrefetcher(Pipeline):
     """Iterate device-resident batches ahead of the consumer.
 
     source    : DataIter / iterable / iterator yielding DataBatch or
@@ -92,195 +76,15 @@ class DevicePrefetcher:
                 restarted run skips the batches its checkpoint manifest
                 records as consumed instead of replaying them. Skipped
                 batches never touch the device; counted as
-                ``io.batches_skipped``.
+                ``io.batches_skipped``. The cursor is applied by the
+                single reader stage BEFORE the decode pool, so resume
+                order is identical at any worker count.
+    workers   : decode-pool width (the ``io_workers`` knob; None
+                resolves through the autotune table —
+                BENCH_IO_WORKERS > MXTPU_IO_WORKERS > cached winner > 2).
+    transform : optional host hook ``(x, y) -> (x, y)`` run inside the
+                decode pool (per-batch decode/augment work).
     """
 
-    def __init__(self, source, depth=2, chunk=None, sharding=None,
-                 cycle=False, skip=0):
-        if depth < 1:
-            raise ValueError(f"depth must be >= 1, got {depth}")
-        if chunk is not None and chunk < 1:
-            raise ValueError(f"chunk must be >= 1, got {chunk}")
-        if skip < 0:
-            raise ValueError(f"skip must be >= 0, got {skip}")
-        self._source = source
-        self._depth = int(depth)
-        self._chunk = int(chunk) if chunk else None
-        self._sharding = sharding
-        self._cycle = bool(cycle)
-        self._skip = int(skip)
-        self._epoch_len = None   # learned at the first source wrap
-        self._buf = _queue.Queue(maxsize=self._depth)
-        self._stop = threading.Event()
-        self._exhausted = False
-        # counters exist from construction so smoke checks can assert on
-        # them even for an all-hits run (wait_ms == 0 is a signal too)
-        self._c_batches = _prof.counter("io.batches_prefetched", "io")
-        self._c_wait = _prof.counter("io.wait_ms", "io")
-        self._c_put = _prof.counter("io.put_ms", "io")
-        _prof.set_gauge("io.depth", self._depth, "io")
-        _prof.set_gauge("io.buffer_fill", 0, "io")
-        self._thread = threading.Thread(target=self._worker, daemon=True,
-                                        name="mxtpu-device-prefetch")
-        self._thread.start()
-
-    # -- worker -----------------------------------------------------------
-    def _iter_source(self):
-        src = self._source
-        while True:
-            it = iter(src) if not hasattr(src, "next") else src
-            n = 0
-            try:
-                for b in it:
-                    n += 1
-                    yield b
-            except StopIteration:
-                pass
-            if n and self._epoch_len is None:
-                self._epoch_len = n
-            if not self._cycle:
-                return
-            if hasattr(src, "reset"):
-                src.reset()
-            elif iter(src) is src:
-                return          # a bare iterator can't be rewound
-
-    def _to_device(self, items):
-        import jax
-        t0 = time.perf_counter()
-        xs = [_raw(x) for x, _ in items]
-        n_labeled = sum(1 for _, y in items if y is not None)
-        if 0 < n_labeled < len(items):
-            # fail HERE, not as a leading-axis mismatch deep inside the
-            # compiled scan: a partially-labeled chunk is a source bug
-            raise ValueError(
-                f"mixed labeled/label-less batches in one prefetch chunk "
-                f"({n_labeled}/{len(items)} labeled)")
-        ys = [_raw(y) for _, y in items if y is not None]
-        if self._chunk is not None:
-            xs = [np.stack(xs) if all(isinstance(a, np.ndarray) for a in xs)
-                  else _stack_dev(xs)]
-            if ys:
-                ys = [np.stack(ys) if all(isinstance(a, np.ndarray)
-                                          for a in ys)
-                      else _stack_dev(ys)]
-        sharding = self._sharding() if callable(self._sharding) \
-            else self._sharding
-        put = (lambda a: jax.device_put(a, sharding)) if sharding is not None \
-            else jax.device_put
-        out = (put(xs[0]), put(ys[0]) if ys else None)
-        self._c_put.increment((time.perf_counter() - t0) * 1e3)
-        return out
-
-    def _worker(self):
-        try:
-            pending = []
-            n = self._chunk or 1
-            to_skip = self._skip
-            if to_skip:
-                c_skip = _prof.counter("io.batches_skipped", "io")
-            for b in self._iter_source():
-                if self._stop.is_set():
-                    return
-                if to_skip > 0:
-                    # cursor resume: already-consumed batches are
-                    # dropped host-side, before any conversion/transfer.
-                    # An ABSOLUTE cursor through a cycling source only
-                    # matters modulo the epoch: once the first wrap
-                    # teaches us the epoch length, whole epochs of the
-                    # remaining skip fold away instead of being read and
-                    # discarded — resume cost stays bounded by ~one
-                    # epoch of host reads however long the run was
-                    if self._cycle and self._epoch_len:
-                        to_skip %= self._epoch_len
-                        if to_skip == 0:
-                            pass   # fell exactly on a boundary: train b
-                        else:
-                            to_skip -= 1
-                            c_skip.increment()
-                            continue
-                    else:
-                        to_skip -= 1
-                        c_skip.increment()
-                        continue
-                pending.append(_split_batch(b))
-                if len(pending) < n:
-                    continue
-                item = self._to_device(pending)
-                pending = []
-                self._c_batches.increment(n)
-                if not self._put(item):
-                    return
-            # a trailing partial chunk is dropped (static-shape programs
-            # can't take a short chunk); per-batch mode has no remainder
-            self._put(_SENTINEL)
-        except Exception as e:  # noqa: BLE001 — surfaced at next()
-            self._put(e)
-
-    def _put(self, item):
-        """Blocking put that stays responsive to close()."""
-        while not self._stop.is_set():
-            try:
-                self._buf.put(item, timeout=0.05)
-                return True
-            except _queue.Full:
-                continue
-        return False
-
-    # -- consumer ---------------------------------------------------------
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        if self._exhausted:
-            raise StopIteration
-        t0 = time.perf_counter()
-        item = self._buf.get()
-        self._c_wait.increment((time.perf_counter() - t0) * 1e3)
-        _prof.set_gauge("io.buffer_fill", self._buf.qsize(), "io")
-        if item is _SENTINEL:
-            self._exhausted = True
-            raise StopIteration
-        if isinstance(item, Exception):
-            self._exhausted = True
-            raise item
-        return item
-
-    next = __next__
-
-    # -- lifecycle --------------------------------------------------------
     def close(self):
-        """Stop the worker and drop every buffered device batch. Safe to
-        call at any point (mid-epoch early stop included) and idempotent;
-        after close() the buffer holds no device references.
-
-        A worker parked inside the SOURCE's ``next()`` (streaming/queue
-        sources) cannot be interrupted; close() stops waiting for it
-        after a short deadline — the thread is a daemon, and once the
-        stop flag is set ``_put`` refuses every item, so nothing can land
-        in the buffer after close() returns either way."""
-        self._stop.set()
-        deadline = time.monotonic() + _CLOSE_DEADLINE_S
-        while True:
-            try:
-                self._buf.get_nowait()
-            except _queue.Empty:
-                if not self._thread.is_alive() \
-                        or time.monotonic() > deadline:
-                    break
-                time.sleep(0.01)
-        self._exhausted = True
-        _prof.set_gauge("io.buffer_fill", 0, "io")
-        self._thread.join(timeout=0.1)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-        return False
-
-
-def _stack_dev(arrs):
-    import jax.numpy as jnp
-    return jnp.stack([jnp.asarray(a) for a in arrs])
+        super().close(deadline_s=_CLOSE_DEADLINE_S)

@@ -49,14 +49,23 @@ FAMILY_TABLES = {
         "healthmon/healthmon.step_ms_ewma": "gauge",
         "healthmon/healthmon.grad_global_norm": "gauge",
     },
-    # docs/trainloop.md — device prefetcher (PR 6)
+    # docs/io.md — staged ingest pipeline (PR 6 prefetcher, PR 17
+    # reader/decode-pool/transfer stages + sharded record reader)
     "io": {
         "io/io.batches_prefetched": "counter",
         "io/io.batches_skipped": "counter",
         "io/io.wait_ms": "counter",
         "io/io.put_ms": "counter",
+        "io/io.read_ms": "counter",
+        "io/io.decode_ms": "counter",
+        "io/io.stage_ms": "counter",
+        "io/io.records_read": "counter",
         "io/io.depth": "gauge",
         "io/io.buffer_fill": "gauge",
+        "io/io.workers": "gauge",
+        "io/io.shard_rank": "gauge",
+        "io/io.shard_ranks": "gauge",
+        "io/io.shard_records": "gauge",
     },
     # docs/trainloop.md — whole-loop executor (PR 6)
     "trainloop": {

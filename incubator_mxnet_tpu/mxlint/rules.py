@@ -393,6 +393,7 @@ THREADED_MODULES = (
     "fleet/router.py",
     "fleet/cache.py",
     "io/prefetch.py",
+    "io/pipeline.py",
     "resilience/checkpoint.py",
     "resilience/elastic.py",
     "resilience/policy.py",

@@ -9,6 +9,7 @@ the NCCL ring of `kvstore=dist_sync_device`, compiled away.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +21,8 @@ from .. import perfscope as _ps
 from .. import profiler as _prof
 from ..gluon.parameter import _ParamTraceScope, _trace
 from ..gluon.trainer import Trainer
+from ..io.pipeline import TRANSFER_GATE as _TRANSFER_GATE
+from ..io.pipeline import _defer_put_needed as _cpu_serial_client
 from ..ndarray import NDArray
 from ..ndarray import random as ndrandom
 from .. import optimizer as opt_mod
@@ -27,6 +30,28 @@ from . import fsdp as _fsdp
 from . import sharding as _sharding
 
 __all__ = ["FusedTrainStep"]
+
+
+@contextmanager
+def _donated_cache_quarantine(step):
+    """Suppress persistent-compile-cache READS while a donating fused
+    step may compile on XLA:CPU.
+
+    PR 4 found this jaxlib mis-deserializes cached donated fused-step
+    executables; runtime/cache_guard re-entered the cache behind a
+    once-per-process canary. PR 17's flake hunt showed the corruption
+    is PROBABILISTIC PER READ — one certified read proves nothing
+    about the next. So donated executables never read the cache at
+    all: the dispatch call that may trigger their compile runs under
+    cache_guard's read quarantine (a forced cache miss — full story in
+    runtime/cache_guard.py). Scoped to donate+CPU; non-donated reads
+    stay canary-guarded and keep the suite's warm-start win."""
+    if not (step.donate and _cpu_serial_client()):
+        yield
+        return
+    from ..runtime.cache_guard import donated_read_quarantine
+    with donated_read_quarantine():
+        yield
 
 
 class FusedTrainStep:
@@ -401,8 +426,9 @@ class FusedTrainStep:
         xb, yb = x._data, y._data
         if self._sharding_info is not None:
             batch_sharding = self._sharding_info[4]   # resolved in _build
-            xb = jax.device_put(xb, batch_sharding)
-            yb = jax.device_put(yb, batch_sharding)
+            with _TRANSFER_GATE:
+                xb = jax.device_put(xb, batch_sharding)
+                yb = jax.device_put(yb, batch_sharding)
         train_raws = [self.params[i].data()._data for i in self.train_idx]
         aux_raws = [self.params[i].data()._data for i in self.aux_idx]
         rescale = self._f32("rescale", self.optimizer.rescale_grad)
@@ -425,8 +451,29 @@ class FusedTrainStep:
                  rescale, xb, yb),
                 name="fused_step", dtype=xb.dtype, kind="train_step",
                 mesh=self.mesh, mode=self.sharding)
-        loss, new_train, new_aux, new_states = self._jitted(
-            train_raws, aux_raws, self._states, key, lr, wd, t, rescale, xb, yb)
+        # the donating dispatch ENQUEUE is serialized against any
+        # in-flight prefetcher device_put (io.pipeline.TRANSFER_GATE) —
+        # the enqueue-ordering half of the PR 14 flake fix; the other
+        # half is the pipeline's consumer-thread put on XLA:CPU. The
+        # guarded region is the async enqueue, not the step execution.
+        with _TRANSFER_GATE, _donated_cache_quarantine(self):
+            loss, new_train, new_aux, new_states = self._jitted(
+                train_raws, aux_raws, self._states, key, lr, wd, t,
+                rescale, xb, yb)
+            if _cpu_serial_client():
+                # XLA:CPU (io/pipeline.py safety model): retire the
+                # donating execution before ANY other client call —
+                # this client races the donated-buffer handoff of a
+                # still-running execution against concurrent client
+                # work regardless of which Python thread issues it.
+                # INSIDE the gate: the donation window and the gate
+                # window coincide, so gate holders (async checkpoint
+                # saves, prefetcher puts) are mutually excluded from
+                # it. Compute∥decode overlap is unaffected (the decode
+                # pool is host-side); only async dispatch depth is
+                # forfeited, on the backend where it buys nothing.
+                jax.block_until_ready(
+                    (loss, new_train, new_aux, new_states))
         for j, i in enumerate(self.train_idx):
             self.params[i]._data._data = new_train[j]
         for j, i in enumerate(self.aux_idx):
@@ -475,8 +522,9 @@ class FusedTrainStep:
         t0 = jnp.int32(self._num_update + 1)
         key = ndrandom._key()
         if self._stacked_sharding is not None:
-            xs = jax.device_put(xs, self._stacked_sharding)
-            ys = jax.device_put(ys, self._stacked_sharding)
+            with _TRANSFER_GATE:
+                xs = jax.device_put(xs, self._stacked_sharding)
+                ys = jax.device_put(ys, self._stacked_sharding)
         train_raws = [self.params[i].data()._data for i in self.train_idx]
         aux_raws = [self.params[i].data()._data for i in self.aux_idx]
         rescale = self._f32("rescale", self.optimizer.rescale_grad)
@@ -491,9 +539,16 @@ class FusedTrainStep:
                  rescale, xs, ys),
                 name=f"fused_step_k{k}", dtype=xs.dtype, kind="train_step",
                 extra={"k": k}, mesh=self.mesh, mode=self.sharding)
-        losses, new_train, new_aux, new_states = self._jitted_k(
-            train_raws, aux_raws, self._states, key, lrs, wd, t0, rescale,
-            xs, ys)
+        # donation-vs-transfer serialization, same contract as __call__
+        with _TRANSFER_GATE, _donated_cache_quarantine(self):
+            losses, new_train, new_aux, new_states = self._jitted_k(
+                train_raws, aux_raws, self._states, key, lrs, wd, t0,
+                rescale, xs, ys)
+            if _cpu_serial_client():
+                # XLA:CPU donating dispatch retires inside the gate —
+                # see the matching __call__ block and io/pipeline.py
+                jax.block_until_ready((losses, new_train, new_aux,
+                                       new_states))
         self._num_update += k
         self.optimizer.num_update = self._num_update
         for j, i in enumerate(self.train_idx):

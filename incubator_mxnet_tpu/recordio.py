@@ -19,7 +19,7 @@ from collections import namedtuple
 
 import numpy as np
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "shard_keys",
            "pack", "unpack", "pack_img", "unpack_img"]
 
 _MAGIC = 0xCED7230A
@@ -137,6 +137,25 @@ class MXIndexedRecordIO(MXRecordIO):
         self.write(buf)
         self.idx[key] = pos
         self.keys.append(key)
+
+
+def shard_keys(keys, rank, num_ranks):
+    """Deterministic interleaved shard of an index: ``keys[rank::num_ranks]``.
+
+    The shard is a pure function of (keys, rank, num_ranks) — no state,
+    no coordination — so fleet replicas and elastic re-joins
+    (mxtpu.resilience) that agree on the index and the rank geometry
+    read disjoint record sets in a reproducible order, and a restarted
+    rank resumes exactly the shard it was reading. Interleaving (rather
+    than contiguous blocks) keeps shard sizes within one record of each
+    other and spreads any on-disk locality skew across ranks."""
+    n = int(num_ranks)
+    r = int(rank)
+    if n < 1:
+        raise ValueError(f"num_ranks must be >= 1, got {num_ranks}")
+    if not 0 <= r < n:
+        raise ValueError(f"rank must be in [0, {n}), got {rank}")
+    return list(keys)[r::n]
 
 
 # ---------------------------------------------------------------------------

@@ -163,7 +163,7 @@ class CheckpointManager:
             n, tree, meta = item
             t0 = time.perf_counter()
             try:
-                path = _ckpt.save_tree(self.directory, n, tree, meta=meta)
+                path = self._gated_save(n, tree, meta)
                 ms = (time.perf_counter() - t0) * 1e3
                 self._h_save.observe(ms)
                 self._c_saved.increment()
@@ -186,6 +186,22 @@ class CheckpointManager:
                       args={"error": self._last_error[:300]})
             finally:
                 self._idle.set()
+
+    def _gated_save(self, n, tree, meta):
+        """On the XLA:CPU client, hold the process-wide transfer gate for
+        the whole orbax serialization: that client is unsafe against
+        concurrent client work (io/pipeline.py's safety model), and the
+        donating-dispatch window on the training thread is also inside
+        the gate there — so the save window and every XLA window are
+        mutually excluded. The tree is already host numpy; training only
+        stalls if a put/dispatch collides with an in-flight save, so the
+        save stays async in the common case. Other backends save
+        ungated (concurrency is the point of the worker thread)."""
+        from ..io.pipeline import TRANSFER_GATE, _defer_put_needed
+        if _defer_put_needed():
+            with TRANSFER_GATE:
+                return _ckpt.save_tree(self.directory, n, tree, meta=meta)
+        return _ckpt.save_tree(self.directory, n, tree, meta=meta)
 
     def _prune(self):
         import shutil

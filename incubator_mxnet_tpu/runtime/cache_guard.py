@@ -28,14 +28,65 @@ cache).
 """
 from __future__ import annotations
 
+import threading
 import warnings
+from contextlib import contextmanager
 
 import numpy as np
 
-__all__ = ["check", "verdict", "_reset_for_tests"]
+__all__ = ["check", "verdict", "donated_read_quarantine",
+           "_reset_for_tests"]
 
 # None = not yet checked; True = cache ok (or not in use); False = tripped
 _VERDICT = None
+
+# -- donated-executable read quarantine (PR 17) ---------------------------
+#
+# The canary certifies ONE cache read per process; PR 17's flake hunt
+# showed the donated-executable corruption is PROBABILISTIC PER READ
+# (resilience suite: 6/10 process crashes with a warm cache vs 1/12
+# with the cache wiped before every run — heap corruption detonating at
+# later allocations, i.e. a deserialized executable whose donation
+# aliasing writes through stale addresses). So donated fused-step
+# executables must never read the cache at all. Toggling
+# ``jax_enable_compilation_cache`` around the dispatch does NOT do
+# this: ``compilation_cache.is_cache_used`` latches its verdict at the
+# first compile of the process and ignores the flag afterwards. The
+# quarantine therefore filters the read primitive itself
+# (``get_executable_and_time`` → miss while quarantined); cache WRITES
+# still happen, serialization is sound — only deserialization is not.
+
+_READ_QUARANTINE = threading.local()
+
+
+def _install_read_filter():
+    from jax._src import compilation_cache as cc
+    if getattr(cc, "_mxtpu_donated_read_filter", None) is not None:
+        return
+    real_get = cc.get_executable_and_time
+
+    def _filtered_get(cache_key, compile_options, backend):
+        if getattr(_READ_QUARANTINE, "on", False):
+            return None, None        # forced miss -> fresh backend compile
+        return real_get(cache_key, compile_options, backend)
+
+    cc.get_executable_and_time = _filtered_get
+    cc._mxtpu_donated_read_filter = real_get
+
+
+@contextmanager
+def donated_read_quarantine():
+    """Force persistent-compile-cache MISSES for any compile triggered
+    inside the scope (this thread only). Entered by FusedTrainStep
+    around every donating dispatch on XLA:CPU — the compile, when one
+    happens, then always goes through the sound fresh-compile path."""
+    _install_read_filter()
+    prev = getattr(_READ_QUARANTINE, "on", False)
+    _READ_QUARANTINE.on = True
+    try:
+        yield
+    finally:
+        _READ_QUARANTINE.on = prev
 
 
 def verdict():

@@ -99,7 +99,8 @@ class TrainLoop:
     Parameters mirror FusedTrainStep (mesh/data_axis/donate/remat/
     remat_policy); ``chunk`` defaults through
     Trainer.loop_chunk → MXTPU_LOOP_CHUNK → 4, ``prefetch_depth`` sizes
-    the device-side input buffer (2 = double buffering).
+    the device-side input buffer (2 = double buffering), ``io_workers``
+    sizes the ingest decode pool (docs/io.md).
 
     Donation safety: every chunk donates the parameter/optimizer-state
     buffers into the program and rebinds the live Parameters to the
@@ -110,7 +111,7 @@ class TrainLoop:
     def __init__(self, net, loss_fn, optimizer, chunk=None, mesh=None,
                  data_axis=None, donate=True, remat=False, remat_policy=None,
                  prefetch_depth=None, schedule_in_program=True,
-                 sharding=None):
+                 sharding=None, io_workers=None, io_transform=None):
         self.chunk = resolve_chunk(explicit=chunk, optimizer=optimizer)
         if self.chunk < 1:
             raise ValueError(f"loop chunk must be >= 1, got {self.chunk}")
@@ -125,6 +126,17 @@ class TrainLoop:
         if self.prefetch_depth < 1:
             raise ValueError(f"prefetch_depth must be >= 1, "
                              f"got {self.prefetch_depth}")
+        # decode-pool width through the same table: explicit arg >
+        # BENCH_IO_WORKERS > MXTPU_IO_WORKERS > cached winner > 2;
+        # io_transform is a per-item decode hook (docs/io.md) run on
+        # the pool threads, off the training thread's critical path
+        self.io_workers = int(
+            io_workers if io_workers is not None
+            else _knobs.resolve("io_workers")[0])
+        if self.io_workers < 1:
+            raise ValueError(f"io_workers must be >= 1, "
+                             f"got {self.io_workers}")
+        self.io_transform = io_transform
         # sharding mode and mesh resolve exactly like FusedTrainStep's:
         # explicit arg > Trainer.sharding > MXTPU_SHARDING; explicit
         # mesh > process-global sharding.set_mesh (docs/sharding.md)
@@ -335,4 +347,5 @@ class TrainLoop:
         return DevicePrefetcher(
             data, depth=self.prefetch_depth, chunk=self.chunk,
             sharding=lambda: self.step._stacked_sharding, cycle=cycle,
-            skip=skip)
+            skip=skip, workers=self.io_workers,
+            transform=self.io_transform)

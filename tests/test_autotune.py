@@ -87,7 +87,8 @@ class TestKnobPrecedence:
         cfg = KnobConfig.from_env()
         assert cfg.to_dict() == {"loop_chunk": 0, "remat": False,
                                  "remat_policy": None,
-                                 "prefetch_depth": 2, "pallas": "auto",
+                                 "prefetch_depth": 2, "io_workers": 2,
+                                 "pallas": "auto",
                                  "mesh": None, "batch": None}
         assert set(cfg.sources.values()) == {"default"}
 

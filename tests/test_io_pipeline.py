@@ -1,0 +1,361 @@
+"""mxtpu.io pipeline (PR 17) — the staged host ingest engine:
+
+* shard_keys / ShardedRecordReader: disjoint deterministic rank shards,
+  decode hook, reset()/cycle, io.records_read telemetry;
+* Pipeline order determinism: batch order is bit-identical to the
+  serial reader at any worker count, even when a slow transform
+  scrambles decode completion order;
+* resume cursor x decode pool: skip=N through a 4-worker pool yields
+  exactly the serial tail — the data-cursor contract resilience resumes
+  depend on;
+* per-stage counters (io.read_ms / decode_ms / stage_ms / put_ms) and
+  the io.workers gauge;
+* error propagation from every stage (source, transform) to next();
+* the transfer gate + deferred-put safety model: on XLA:CPU no pipeline
+  worker thread may issue an XLA call while donating executions run —
+  the loaded stress test that pins the PR 14 1-in-3 segfault fix;
+* trace_check.check_io_extra schema validation for the extra.io BENCH
+  section the smoke gates on.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, recordio
+from incubator_mxnet_tpu import profiler as prof
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.io import DevicePrefetcher
+from incubator_mxnet_tpu.io.pipeline import (Pipeline, ShardedRecordReader,
+                                             TRANSFER_GATE, transfer_gate)
+from incubator_mxnet_tpu.profiler import counters
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from trace_check import check_io_extra  # noqa: E402
+
+
+def _np_batches(n, batch=4, dim=3, seed=0):
+    """Deterministic numpy (x, y) pairs; x[0,0] encodes the batch index
+    so order assertions are cheap."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        x = rng.randn(batch, dim).astype(np.float32)
+        x[0, 0] = float(i)
+        out.append((x, np.full((batch,), i, np.float32)))
+    return out
+
+
+def _order(pf):
+    """Consume a prefetcher fully, returning the batch-index trace
+    encoded in x[0,0] (chunk mode: x has a leading chunk axis)."""
+    seen = []
+    for x, _y in pf:
+        x = np.asarray(x)
+        if x.ndim == 3:                      # chunked: (k, batch, dim)
+            seen.extend(int(v) for v in x[:, 0, 0])
+        else:
+            seen.append(int(x[0, 0]))
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# sharded record reader
+# ---------------------------------------------------------------------------
+
+def _write_rec(tmp_path, n=10):
+    idx = str(tmp_path / "t.idx")
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        payload = recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                np.full((4,), i, np.int32).tobytes())
+        w.write_idx(i, payload)
+    w.close()
+    return idx, rec
+
+
+class TestShardKeys:
+    def test_disjoint_and_complete(self):
+        keys = list(range(103))
+        shards = [recordio.shard_keys(keys, r, 4) for r in range(4)]
+        flat = sorted(k for s in shards for k in s)
+        assert flat == keys                       # complete, no dupes
+        sizes = sorted(len(s) for s in shards)
+        assert sizes[-1] - sizes[0] <= 1          # within one record
+
+    def test_pure_function_of_inputs(self):
+        keys = list(range(20))
+        assert recordio.shard_keys(keys, 2, 4) \
+            == recordio.shard_keys(keys, 2, 4) == keys[2::4]
+
+    def test_bad_geometry_rejected(self):
+        with pytest.raises(ValueError, match="num_ranks"):
+            recordio.shard_keys([1], 0, 0)
+        with pytest.raises(ValueError, match="rank"):
+            recordio.shard_keys([1], 3, 2)
+
+
+class TestShardedRecordReader:
+    def test_roundtrip_and_decode(self, tmp_path):
+        idx, rec = _write_rec(tmp_path, n=10)
+
+        def decode(payload):
+            hdr, s = recordio.unpack(payload)
+            return np.frombuffer(s, np.int32).copy(), hdr.label
+
+        with ShardedRecordReader(idx, rec, decode_fn=decode) as rd:
+            assert len(rd) == 10
+            rows = list(rd)
+        assert [int(lbl) for _, lbl in rows] == list(range(10))
+        assert all((row == int(lbl)).all() for row, lbl in rows)
+
+    def test_shards_disjoint_deterministic(self, tmp_path):
+        idx, rec = _write_rec(tmp_path, n=11)
+
+        def ids(rank, num):
+            with ShardedRecordReader(idx, rec, rank=rank,
+                                     num_ranks=num) as rd:
+                return [recordio.unpack(p)[0].id for p in rd]
+
+        per_rank = [ids(r, 3) for r in range(3)]
+        assert sorted(i for s in per_rank for i in s) == list(range(11))
+        assert per_rank == [ids(r, 3) for r in range(3)]   # replayable
+
+    def test_reset_and_counters(self, tmp_path):
+        idx, rec = _write_rec(tmp_path, n=6)
+        base = counters().get("io/io.records_read", 0)
+        with ShardedRecordReader(idx, rec, rank=1, num_ranks=2) as rd:
+            first = list(rd)
+            rd.reset()
+            assert list(rd) == first
+        assert counters()["io/io.records_read"] == base + 2 * len(first)
+        c = counters()
+        assert c["io/io.shard_rank"] == 1
+        assert c["io/io.shard_ranks"] == 2
+
+    def test_empty_index_rejected(self, tmp_path):
+        idx = str(tmp_path / "e.idx")
+        rec = str(tmp_path / "e.rec")
+        recordio.MXIndexedRecordIO(idx, rec, "w").close()
+        with pytest.raises(ValueError, match="no index"):
+            ShardedRecordReader(idx, rec)
+
+
+# ---------------------------------------------------------------------------
+# pipeline ordering + cursor semantics
+# ---------------------------------------------------------------------------
+
+class TestPipelineOrder:
+    def test_order_matches_serial_any_worker_count(self):
+        data = _np_batches(24)
+        gold = _order(DevicePrefetcher(iter(data), depth=2, workers=1))
+        for w in (2, 4):
+            got = _order(DevicePrefetcher(iter(data), depth=2, workers=w))
+            assert got == gold == list(range(24))
+
+    def test_order_pinned_under_scrambled_completion(self):
+        # a transform whose latency DECREASES with batch index makes
+        # later chunks finish decode first — the staging ring must
+        # still emit in sequence order
+        data = _np_batches(12)
+
+        def slow(x, y):
+            time.sleep(0.03 * max(0.0, 6.0 - float(x[0, 0]) / 2))
+            return x, y
+
+        got = _order(DevicePrefetcher(iter(data), depth=2, workers=4,
+                                      transform=slow))
+        assert got == list(range(12))
+
+    def test_chunk_stacking_order(self):
+        data = _np_batches(12)
+        got = _order(DevicePrefetcher(iter(data), depth=2, chunk=3,
+                                      workers=4))
+        assert got == list(range(12))
+
+    def test_skip_cursor_parity_with_serial(self):
+        # the resume contract: skip=N through a pool == serial tail
+        data = _np_batches(20)
+        for skip in (0, 3, 7):
+            serial = _order(DevicePrefetcher(iter(data), depth=1,
+                                             workers=1, skip=skip))
+            pooled = _order(DevicePrefetcher(iter(data), depth=3,
+                                             workers=4, skip=skip))
+            assert pooled == serial == list(range(skip, 20))
+
+    def test_cycling_skip_folds_under_pool(self):
+        # absolute cursor 25 through a 10-batch cycling source folds to
+        # epoch position 5 — same as the serial reader's fold
+        data = _np_batches(10)
+
+        class Src:
+            def __iter__(self):
+                return iter(list(data))
+
+        out = []
+        with DevicePrefetcher(Src(), depth=2, workers=4, cycle=True,
+                              skip=25) as pf:
+            for x, _ in pf:
+                out.append(int(np.asarray(x)[0, 0]))
+                if len(out) == 7:
+                    break
+        assert out == [5, 6, 7, 8, 9, 0, 1]
+
+    def test_transform_error_surfaces_at_next(self):
+        data = _np_batches(6)
+
+        def boom(x, y):
+            if int(x[0, 0]) == 3:
+                raise RuntimeError("decode exploded")
+            return x, y
+
+        pf = DevicePrefetcher(iter(data), depth=2, workers=4,
+                              transform=boom)
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            _order(pf)
+
+    def test_workers_knob_resolution_and_floor(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_IO_WORKERS", "3")
+        with DevicePrefetcher(iter(_np_batches(2)), depth=1) as pf:
+            assert pf._workers == 3
+        # call-site beats env
+        with DevicePrefetcher(iter(_np_batches(2)), depth=1,
+                              workers=1) as pf:
+            assert pf._workers == 1
+        with pytest.raises(ValueError, match="workers"):
+            DevicePrefetcher(iter(_np_batches(2)), depth=1, workers=0)
+
+    def test_stage_counters_accumulate(self):
+        keys = ("io/io.read_ms", "io/io.decode_ms", "io/io.stage_ms",
+                "io/io.put_ms")
+        base = {k: counters().get(k, 0) for k in keys}
+
+        def slow(x, y):
+            time.sleep(0.005)
+            return x, y
+
+        _order(DevicePrefetcher(iter(_np_batches(8)), depth=2, workers=2,
+                                transform=slow))
+        c = counters()
+        assert c["io/io.workers"] == 2
+        # decode wall must register the injected 5 ms x 8 batches
+        assert c["io/io.decode_ms"] - base["io/io.decode_ms"] > 20
+        for k in keys:
+            assert c[k] >= base[k]
+
+    def test_close_midstream_drains_and_joins(self):
+        def slow_src():
+            for b in _np_batches(100):
+                time.sleep(0.002)
+                yield b
+
+        pf = DevicePrefetcher(slow_src(), depth=3, workers=4)
+        next(pf)
+        pf.close()
+        assert pf._buf.qsize() == 0
+        assert not any(t.is_alive() for t in pf._threads)
+        pf.close()                               # idempotent
+
+
+# ---------------------------------------------------------------------------
+# transfer-gate / deferred-put safety model (the PR 14 segfault pin)
+# ---------------------------------------------------------------------------
+
+class TestTransferSafety:
+    def test_gate_is_process_wide_lock(self):
+        assert transfer_gate() is TRANSFER_GATE
+        from incubator_mxnet_tpu.parallel import trainer_step
+        assert trainer_step._TRANSFER_GATE is TRANSFER_GATE
+
+    @pytest.mark.skipif(jax.default_backend() != "cpu",
+                        reason="deferred-put model is CPU-only")
+    def test_no_xla_calls_off_consumer_thread_on_cpu(self, monkeypatch):
+        # the safety invariant itself: on XLA:CPU every device_put the
+        # pipeline issues must run on the CONSUMER's thread (the one
+        # that also dispatches), never on a pipeline worker
+        put_threads = set()
+        real_put = jax.device_put
+
+        def spy(x, *a, **k):
+            put_threads.add(threading.current_thread().name)
+            return real_put(x, *a, **k)
+
+        # pipeline.py imports jax lazily inside _to_device, so patching
+        # the module attribute covers every pipeline call site
+        monkeypatch.setattr(jax, "device_put", spy)
+        consumer = threading.current_thread().name
+        got = _order(DevicePrefetcher(iter(_np_batches(8)), depth=2,
+                                      workers=4))
+        assert got == list(range(8))
+        assert put_threads == {consumer}, \
+            f"device_put leaked onto pipeline threads: {put_threads}"
+
+    def test_loaded_donation_stress(self):
+        # the regression pin for the PR 14 1-in-3 flake: donating
+        # executions dispatched back-to-back while the 4-worker
+        # pipeline churns — under the old off-thread device_put this
+        # segfaulted XLA:CPU within a few hundred steps
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+        net.initialize(init=mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01}, kvstore=None)
+        loop = mx.TrainLoop(net, gluon.loss.L2Loss(), tr, chunk=2,
+                            io_workers=4, prefetch_depth=3)
+        w = np.random.RandomState(7).randn(3, 1).astype(np.float32)
+        data = [(x, (x @ w).astype(np.float32))
+                for x, _ in _np_batches(60, batch=8)]
+        losses = loop.fit(data, steps=40, cycle=True)
+        assert len(losses) == 40
+        assert np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# extra.io schema (trace_check.check_io_extra)
+# ---------------------------------------------------------------------------
+
+def _good_io():
+    return {"workers": 4, "depth": 2, "batches_prefetched": 24,
+            "wait_ms": 1.5, "read_ms": 0.2, "decode_ms": 480.0,
+            "stage_ms": 3.0, "put_ms": 12.0, "batches_skipped": 0,
+            "records_read": 96, "slow_ms": 20.0}
+
+
+class TestCheckIoExtra:
+    def test_absent_ok_and_good_ok(self):
+        assert check_io_extra(None) == []
+        assert check_io_extra(_good_io()) == []
+
+    def test_optional_keys_optional(self):
+        io = _good_io()
+        for k in ("batches_skipped", "records_read", "slow_ms"):
+            io.pop(k)
+        assert check_io_extra(io) == []
+
+    @pytest.mark.parametrize("mutate, frag", [
+        (lambda d: d.pop("workers"), "workers"),
+        (lambda d: d.update(workers=0), "workers"),
+        (lambda d: d.update(depth=True), "depth"),
+        (lambda d: d.pop("wait_ms"), "wait_ms"),
+        (lambda d: d.update(decode_ms=-1), "decode_ms"),
+        (lambda d: d.update(slow_ms="20"), "slow_ms"),
+    ])
+    def test_bad_shapes_rejected(self, mutate, frag):
+        io = _good_io()
+        mutate(io)
+        errs = check_io_extra(io)
+        assert errs and any(frag in e for e in errs), errs
+
+    def test_non_dict_rejected(self):
+        assert check_io_extra([1, 2]) == \
+            ["must be an object, got list"]

@@ -458,6 +458,57 @@ class TestPrefetcherSkip:
         # one full learning pass (4) + in-epoch remainder (2), not 10
         assert counters()["io/io.batches_skipped"] == skipped0 + 6
 
+    def test_sharded_rejoin_replays_zero_batches(self, tmp_path):
+        # the PR 17 resume matrix: sharded record reader x skip cursor
+        # x an evicted rank re-joining. The re-joined rank must resume
+        # ITS shard exactly where the cursor says — zero replayed
+        # batches, zero holes, order bit-identical to a serial rank
+        # that never left, at any decode-pool width.
+        from incubator_mxnet_tpu import recordio
+        from incubator_mxnet_tpu.io.pipeline import ShardedRecordReader
+        idx = str(tmp_path / "s.idx")
+        rec = str(tmp_path / "s.rec")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(21):
+            w.write_idx(i, recordio.pack(
+                recordio.IRHeader(0, float(i), i, 0),
+                np.full((2, 2), i, np.float32).tobytes()))
+        w.close()
+
+        def decode(payload):
+            _h, s = recordio.unpack(payload)
+            x = np.frombuffer(s, np.float32).reshape(2, 2).copy()
+            return x, x[:, :1]
+
+        def rank_reader():
+            return ShardedRecordReader(idx, rec, rank=1, num_ranks=3,
+                                       decode_fn=decode)
+
+        def trace(pf, n=None):
+            out = []
+            for x, _ in pf:
+                out.append(int(np.asarray(x)[0, 0]))
+                if n is not None and len(out) == n:
+                    break
+            return out
+
+        # the never-evicted serial reference for this rank's shard
+        with DevicePrefetcher(rank_reader(), depth=1,
+                              workers=1) as pf:
+            gold = trace(pf)
+        assert gold == list(range(1, 21, 3))      # keys[1::3]
+
+        # rank trains 3 batches through the 4-worker pool, is evicted
+        # (close), re-joins with skip=cursor: the tail must butt-join
+        cursor = 3
+        with DevicePrefetcher(rank_reader(), depth=2, workers=4) as pf:
+            head = trace(pf, n=cursor)
+        with DevicePrefetcher(rank_reader(), depth=2, workers=4,
+                              skip=cursor) as pf:
+            tail = trace(pf)
+        assert head + tail == gold                # zero replay, no holes
+        assert len(set(head + tail)) == len(gold)
+
 
 # ---------------------------------------------------------------------------
 # elastic membership

@@ -63,6 +63,14 @@ if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
 fi
+# ingest-pipeline smoke (CPU-only): serial vs pipelined lenet with an
+# injected slow decode — the pool must cut io.wait_ms, the overlap
+# inequality must hold, and the decode-starvation triage must render
+if timeout 1200 bash tools/io_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) io smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) io smoke FAILED (continuing; ingest pipeline suspect)" >> "$LOG"
+fi
 # perfscope smoke (CPU-only): step-time decomposition sums, roofline
 # verdicts present, and the perf_regress gate passes self-vs-self /
 # fails on an injected regression / skips env_failure artifacts

@@ -61,6 +61,14 @@ if timeout 900 bash tools/trainloop_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) trainloop smoke FAILED (continuing; whole-loop executor suspect)" >> "$LOG"
 fi
+# ingest-pipeline smoke (CPU-only): the staged prefetcher's overlap
+# win + starvation attribution must validate before sweeping any
+# data-path configuration on the tunnel
+if timeout 1200 bash tools/io_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) io smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) io smoke FAILED (continuing; ingest pipeline suspect)" >> "$LOG"
+fi
 # perfscope smoke (CPU-only): decomposition + roofline verdicts + the
 # perf_regress gate must validate before any on-chip number is trusted
 if timeout 900 bash tools/perfscope_smoke.sh >> "$LOG" 2>&1; then

@@ -44,6 +44,13 @@ tails, the dispatch-imbalance ratio, the shared compile-cache verdict
 (replica N+1's warmup: hit or recompile?), and the drain/swap/readmit
 deploy timeline from the events log.
 
+`io`: the ingest-pipeline report from a BENCH json (`extra.io`) —
+pipeline geometry (decode workers, buffer depth), cumulative per-stage
+walls (read / decode / reorder / put), the consumer's empty-buffer
+wait, and devicescope's measured input-starvation split with the
+one-line triage ("starved 31% of idle: 80% decode → raise io_workers,
+not prefetch depth").
+
 `tune`: the autotune report from a BENCH json (`extra.autotune`) —
 cache hit/miss verdict, the trial table with measured busy fraction /
 step wall / MFU / score provenance per config, the pruning reasons
@@ -56,6 +63,7 @@ Usage:
     python tools/mxdiag.py perf BENCH.json
     python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py device BENCH.json
+    python tools/mxdiag.py io BENCH.json
     python tools/mxdiag.py serve BENCH.json
     python tools/mxdiag.py fleet BENCH.json [--events EVENTS.jsonl]
     python tools/mxdiag.py tune BENCH.json
@@ -671,6 +679,105 @@ def _device_main(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# io: ingest-pipeline report from a BENCH json (extra.io +
+# extra.devicescope's input_starved_split)
+# ---------------------------------------------------------------------------
+
+def print_io(doc: dict) -> int:
+    """The "is the chip input-starved, and whose fault is it" report:
+    the ingest pipeline's geometry and cumulative per-stage walls from
+    extra.io, joined to devicescope's measured starvation split —
+    ending in the one-line advice ("starved 31% of idle: 80% decode →
+    raise io_workers, not prefetch depth")."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')})")
+    if doc.get("status") == "env_failure" or doc.get("error"):
+        print(f"  run failed ({doc.get('status') or 'error'}): "
+              f"{doc.get('error')}")
+        return 1
+    io = extra.get("io")
+    if not isinstance(io, dict):
+        print("\n  no extra.io section (the run had no ingest pipeline "
+              "— synthetic single-step mode, or a pre-PR-17 artifact)")
+        return 1
+    print(f"\n  pipeline: {io.get('workers')} decode worker(s), "
+          f"depth {io.get('depth')}, "
+          f"{io.get('batches_prefetched')} batches staged"
+          + (f", {io.get('batches_skipped')} skipped (resume cursor)"
+             if io.get("batches_skipped") else "")
+          + (f", {io.get('records_read')} records read"
+             if io.get("records_read") else "")
+          + (f", injected slow-decode {io.get('slow_ms')} ms/batch"
+             if io.get("slow_ms") else ""))
+    stages = [("read (source next)", io.get("read_ms")),
+              ("decode pool", io.get("decode_ms")),
+              ("stage (reorder wait)", io.get("stage_ms")),
+              ("put (host->device)", io.get("put_ms"))]
+    total = sum(v for _, v in stages if isinstance(v, (int, float)))
+    print("  cumulative stage walls (threads overlap — attribution, "
+          "not a span):")
+    for name, v in stages:
+        v = float(v or 0.0)
+        share = v / total if total else 0.0
+        bar = "#" * int(round(share * 30))
+        print(f"    {name:<22} {v:>10.1f} ms  {share:>6.1%}  {bar}")
+    print(f"  consumer wait (io.wait_ms): {float(io.get('wait_ms') or 0):.1f} ms "
+          f"— time next() sat on an empty buffer")
+    ds = extra.get("devicescope") or {}
+    gaps = ds.get("gaps") or {}
+    starved = (gaps.get("taxonomy") or {}).get("input_starved_ms")
+    split = gaps.get("input_starved_split")
+    if not isinstance(split, dict):
+        if starved in (None, 0):
+            print("\n  device window: no input starvation measured — "
+                  "the buffer kept ahead of the chip")
+        else:
+            print(f"\n  device window: input_starved {starved} ms, but "
+                  f"no stage split (no stage walls in the window)")
+        return 0
+    idle = ds.get("idle_ms") or 0
+    dom = split.get("dominant")
+    parts = {"read": split.get("read_ms"),
+             "decode": split.get("decode_ms"),
+             "transfer": split.get("transfer_ms")}
+    tot = sum(float(v or 0) for v in parts.values())
+    dom_share = (float(parts.get(dom) or 0) / tot) if tot else 0.0
+    starved_share = (float(starved or 0) / float(idle)) if idle else 0.0
+    print(f"\n  device window: input_starved {starved} ms of "
+          f"{idle} ms idle — split:")
+    for k, v in parts.items():
+        v = float(v or 0)
+        share = v / tot if tot else 0.0
+        tag = "  << DOMINANT" if k == dom else ""
+        print(f"    {k:<10} {v:>9.1f} ms  {share:>6.1%}{tag}")
+    knob = {"read": "shard wider / faster storage, not prefetch depth",
+            "decode": "raise io_workers, not prefetch depth",
+            "transfer": "raise prefetch_depth (deeper overlap), "
+                        "not io_workers"}.get(dom, "")
+    if knob:
+        print(f"\n  ADVICE: starved {starved_share:.0%} of idle: "
+              f"{dom_share:.0%} {dom} -> {knob}")
+    return 0
+
+
+def _io_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py io",
+        description="ingest-pipeline report from a BENCH json "
+                    "(extra.io + devicescope starvation split)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"io: {e}", file=sys.stderr)
+        return 1
+    return print_io(doc)
+
+
+# ---------------------------------------------------------------------------
 # serve: tail-latency attribution report from a BENCH json
 # (extra.servescope / extra.serve_load / extra.serving)
 # ---------------------------------------------------------------------------
@@ -1204,6 +1311,8 @@ def main(argv=None) -> int:
         return _comms_main(argv[1:])
     if argv and argv[0] == "device":
         return _device_main(argv[1:])
+    if argv and argv[0] == "io":
+        return _io_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
     if argv and argv[0] == "fleet":

@@ -53,7 +53,8 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_commscope_extra", "check_devicescope_extra",
            "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_resilience_extra",
-           "check_autotune_extra", "check_mxlint_extra", "check_file"]
+           "check_autotune_extra", "check_mxlint_extra", "check_io_extra",
+           "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -118,6 +119,11 @@ COLLECTIVE_SOURCES = ("measured", "measured(profile)", "estimated",
 DEVICESCOPE_GAP_TAXONOMY = ("input_starved_ms", "dispatch_serialized_ms",
                             "host_gap_ms")
 
+# per-stage attribution keys of the optional input_starved_split block
+# (devicescope/ingest.py _starved_split), plus its dominant-stage tags
+DEVICESCOPE_STARVED_SPLIT = ("read_ms", "decode_ms", "transfer_ms")
+DEVICESCOPE_STARVED_DOMINANTS = ("read", "decode", "transfer")
+
 # score provenance an `extra.autotune` record may declare: the trial's
 # busy fraction came from a measured devicescope window, or degraded to
 # host-side wall/throughput scoring (autotune/trial.py SCORE_SOURCES)
@@ -126,7 +132,8 @@ AUTOTUNE_SCORE_SOURCES = ("measured(profile)", "host_wall")
 # the knob fields a winner/resolved config may carry
 # (autotune/knobs.py KNOB_FIELDS)
 AUTOTUNE_KNOB_FIELDS = ("loop_chunk", "remat", "remat_policy",
-                        "prefetch_depth", "pallas", "mesh", "batch")
+                        "prefetch_depth", "io_workers", "pallas", "mesh",
+                        "batch")
 
 AUTOTUNE_PALLAS_MODES = ("auto", "on", "force", "off")
 AUTOTUNE_REMAT_POLICIES = (None, "dots", "nothing", "everything")
@@ -843,6 +850,25 @@ def check_devicescope_extra(ds) -> list:
                     if not _is_num(v) or v < 0:
                         errors.append(f"gaps.taxonomy[{key!r}] must be "
                                       f"numeric >= 0, got {v!r}")
+            split = gaps.get("input_starved_split")
+            if split is not None:
+                # optional: present only when the pipeline's stage walls
+                # could attribute a nonzero starved bucket
+                if not isinstance(split, dict):
+                    errors.append("gaps.input_starved_split must be an "
+                                  "object or absent")
+                else:
+                    for key in DEVICESCOPE_STARVED_SPLIT:
+                        v = split.get(key)
+                        if not _is_num(v) or v < 0:
+                            errors.append(
+                                f"gaps.input_starved_split[{key!r}] must "
+                                f"be numeric >= 0, got {v!r}")
+                    dom = split.get("dominant")
+                    if dom not in DEVICESCOPE_STARVED_DOMINANTS:
+                        errors.append(
+                            f"gaps.input_starved_split.dominant={dom!r} "
+                            f"not in {DEVICESCOPE_STARVED_DOMINANTS}")
     recon = ds.get("reconciliation")
     if recon is not None:
         if not isinstance(recon, dict):
@@ -899,6 +925,11 @@ def _check_knob_dict(d, where: str) -> list:
                          or v < 0):
             errors.append(f"{where}[{key!r}] must be an int >= 0, "
                           f"got {v!r}")
+    w = d.get("io_workers")
+    if "io_workers" in d and (not isinstance(w, int)
+                              or isinstance(w, bool) or w < 1):
+        errors.append(f"{where}['io_workers'] must be an int >= 1, "
+                      f"got {w!r}")
     if "remat" in d and not isinstance(d["remat"], bool):
         errors.append(f"{where}['remat'] must be a bool, "
                       f"got {d['remat']!r}")
@@ -1061,6 +1092,37 @@ def check_mxlint_extra(mx) -> list:
     elif isinstance(mx.get("recompiles"), int) \
             and mx["recompiles"] == 0 and rp:
         errors.append(f"recompiles=0 but recompiled_programs={rp!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# io pipeline bench section (extra.io)
+# ---------------------------------------------------------------------------
+
+def check_io_extra(io) -> list:
+    """Validate an `extra.io` BENCH section: the ingest-pipeline shape
+    (docs/io.md). Stage walls are cumulative thread-wall milliseconds —
+    they may each exceed the run wall (stages overlap), but never go
+    negative, and the pipeline must declare its geometry (workers,
+    depth) so a smoke comparison knows what it measured."""
+    if io is None:
+        return []
+    if not isinstance(io, dict):
+        return [f"must be an object, got {type(io).__name__}"]
+    errors = []
+    for key in ("workers", "depth"):
+        v = io.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"'{key}' must be an int >= 1, got {v!r}")
+    for key in ("batches_prefetched", "wait_ms", "read_ms",
+                "decode_ms", "stage_ms", "put_ms"):
+        v = io.get(key)
+        if not _is_num(v) or v < 0:
+            errors.append(f"'{key}' must be numeric >= 0, got {v!r}")
+    for key in ("batches_skipped", "records_read", "slow_ms"):
+        if key in io and (not _is_num(io[key]) or io[key] < 0):
+            errors.append(f"'{key}' must be numeric >= 0, "
+                          f"got {io[key]!r}")
     return errors
 
 
@@ -1499,6 +1561,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.mxlint: {e}"
                for e in check_mxlint_extra(
                    (doc.get("extra") or {}).get("mxlint"))]
+    errors += [f"extra.io: {e}"
+               for e in check_io_extra(
+                   (doc.get("extra") or {}).get("io"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
