@@ -313,6 +313,34 @@ def _bench_devicescope_start():
     return ds.enable()
 
 
+def _bench_memscope_start():
+    """BENCH_MEMSCOPE=1: arm memory observability (mxtpu.memscope) —
+    every captured program additionally reads
+    `compiled.memory_analysis()` into a static footprint table joined
+    to the roofline verdicts, the steady loops feed a bounded
+    watermark ring of allocator samples (+ host RSS), an escaping
+    RESOURCE_EXHAUSTED assembles an attributed post-mortem, and
+    `extra.memscope` carries it all (validated by trace_check's
+    check_memscope_extra). OFF by default: a capture site holding only
+    a lowered program pays one extra host-side XLA compile per program
+    (the commscope acquisition cost), so the footprints must be asked
+    for. Rides perfscope's capture hooks (enable() arms perfscope)."""
+    if os.environ.get("BENCH_MEMSCOPE", "0") != "1":
+        return None
+    from incubator_mxnet_tpu import memscope as ms
+    return ms.enable()
+
+
+def _memscope_mark(step_no):
+    """One watermark-ring allocator sample at a steady-loop step
+    boundary when memscope is armed (mxtpu.trainloop marks its own
+    chunks, so loop mode needs no bench-side mark). One predicate when
+    off; sampling never raises."""
+    from incubator_mxnet_tpu import memscope as ms
+    if ms._MS is not None:
+        ms.sample(step=step_no, workload="train")
+
+
 def _bench_strict_start():
     """MXTPU_STRICT=1 (or BENCH_STRICT=1): arm the mxlint strict-mode
     jit-program auditor (mxtpu.mxlint.runtime) — every steady-loop
@@ -518,6 +546,15 @@ def _perfscope_settle(result, budget, steps, steady_s, probe_fn,
                 dsc.bench_extra()
     except Exception as e:  # noqa: BLE001
         _log(f"devicescope attach failed ({type(e).__name__}: {e})")
+    # the memory footprints / watermarks / headroom / reconciliation
+    # ride along whenever memscope is armed — also outside the settle
+    # try, so a failed probe can't cost the memory evidence either
+    try:
+        from incubator_mxnet_tpu import memscope as msc
+        if msc._MS is not None:
+            result.setdefault("extra", {})["memscope"] = msc.bench_extra()
+    except Exception as e:  # noqa: BLE001
+        _log(f"memscope attach failed ({type(e).__name__}: {e})")
 
 
 def _profiled_compile_warmup(run_compile, run_warmup):
@@ -1202,7 +1239,7 @@ def _record_data_bench(mode, batch, steps, dtype):
     ds_win = _devicescope_window(steps)
     t0 = time.time()
     with prof.record_function("bench.steady", "bench", sync=False):
-        for _ in range(steps):
+        for _i in range(steps):
             td = time.perf_counter()
             nb = next_batch()
             loss = _strict_guarded(strict_aud, lambda: step(*nb))
@@ -1212,6 +1249,7 @@ def _record_data_bench(mode, batch, steps, dtype):
             if ds_win is not None:
                 ds_win.step(1, dispatch_ms=disp_s * 1e3,
                             sync=lambda: float(loss), workload="train")
+            _memscope_mark(_i + 1)
         loss_val = float(loss)                    # host fetch = barrier
     dt = time.time() - t0
     if ds_win is not None:
@@ -1348,7 +1386,7 @@ def _token_record_bench(batch, steps, dtype):
     ds_win = _devicescope_window(steps)
     t0 = time.time()
     with prof.record_function("bench.steady", "bench", sync=False):
-        for _ in range(steps):
+        for _i in range(steps):
             td = time.perf_counter()
             nb = tuple(map(nd.NDArray, next(pf)))
             loss = _strict_guarded(strict_aud, lambda: step(*nb))
@@ -1358,6 +1396,7 @@ def _token_record_bench(batch, steps, dtype):
             if ds_win is not None:
                 ds_win.step(1, dispatch_ms=disp_s * 1e3,
                             sync=lambda: float(loss), workload="train")
+            _memscope_mark(_i + 1)
         loss_val = float(loss)                    # host fetch = barrier
     dt = time.time() - t0
     if ds_win is not None:
@@ -1466,6 +1505,9 @@ def main():
         _log("commscope armed (collective inventory + resharding detector)")
     if _bench_devicescope_start() is not None:
         _log("devicescope armed (windowed device-timeline capture)")
+    if _bench_memscope_start() is not None:
+        _log("memscope armed (program footprints + watermark ring + "
+             "OOM forensics)")
     strict_aud = _bench_strict_start()
     if strict_aud is not None:
         _log("mxlint strict mode armed (host-sync + recompile + "
@@ -1648,7 +1690,7 @@ def main():
         ds_win = _devicescope_window(chunks * k, steps_per_dispatch=k)
         t0 = time.time()
         with prof.record_function("bench.steady", "bench", sync=False):
-            for _ in range(chunks):
+            for _i in range(chunks):
                 td = time.perf_counter()
                 losses = _strict_guarded(strict_aud,
                                          lambda: step.run_k(xs, ys))
@@ -1662,6 +1704,7 @@ def main():
                     ds_win.step(k, dispatch_ms=disp_s * 1e3,
                                 sync=lambda: float(losses[k - 1]),
                                 workload="train")
+                _memscope_mark((_i + 1) * k)
                 _healthmon_mark_step()     # one mark per dispatched chunk
                 _resilience_mark_step()
             loss_val = float(losses[k - 1])         # host fetch = barrier
@@ -1676,7 +1719,7 @@ def main():
         ds_win = _devicescope_window(steps)
         t0 = time.time()
         with prof.record_function("bench.steady", "bench", sync=False):
-            for _ in range(steps):
+            for _i in range(steps):
                 td = time.perf_counter()
                 loss = _strict_guarded(strict_aud, lambda: step(x, y))
                 disp_s = time.perf_counter() - td
@@ -1688,6 +1731,7 @@ def main():
                     ds_win.step(1, dispatch_ms=disp_s * 1e3,
                                 sync=lambda: float(loss),
                                 workload="train")
+                _memscope_mark(_i + 1)
                 _healthmon_mark_step()
                 _resilience_mark_step()
             loss_val = float(loss)

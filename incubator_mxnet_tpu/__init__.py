@@ -57,6 +57,7 @@ from . import healthmon
 from . import perfscope
 from . import commscope
 from . import devicescope
+from . import memscope
 from . import servescope
 from . import serving
 from . import resilience
@@ -94,6 +95,10 @@ commscope.enable_from_env()
 # jax-profiler trace + ingestion + analytic-vs-measured reconciliation
 # — see docs/devicescope.md).
 devicescope.enable_from_env()
+# MXTPU_MEMSCOPE=1: arm memory observability (static per-program
+# footprints at the compile sites, the watermark ring at the step
+# marks, OOM forensics — see docs/memscope.md).
+memscope.enable_from_env()
 # MXTPU_SERVESCOPE=1: arm request-lifecycle tracing + tail-latency
 # attribution on the serving path (sampled via MXTPU_SERVESCOPE_SAMPLE
 # — see docs/servescope.md).

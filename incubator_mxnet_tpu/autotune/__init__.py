@@ -64,17 +64,32 @@ def ensure_tuned(model="lenet", batch=None, dtype=None, mesh=None,
     Env knobs: ``MXTPU_AUTOTUNE_BUDGET`` (default 6 trials),
     ``MXTPU_AUTOTUNE_STEPS`` (default 12 steady steps per trial),
     ``MXTPU_AUTOTUNE_TRIAL_TIMEOUT`` (default 900 s),
-    ``MXTPU_AUTOTUNE_CACHE`` (cache dir)."""
+    ``MXTPU_AUTOTUNE_CACHE`` (cache dir),
+    ``MXTPU_AUTOTUNE_BATCH_CANDIDATES`` (comma-separated batch sizes to
+    additionally explore — each candidate first passes memscope's
+    memory-feasibility check, so an over-capacity batch is a counted
+    pre-trial reject instead of a doomed subprocess)."""
     budget = knobs.env_int("MXTPU_AUTOTUNE_BUDGET", 6,
                            call_site=budget)
     steps = knobs.env_int("MXTPU_AUTOTUNE_STEPS", 12, call_site=steps)
     trial_timeout = knobs.env_int("MXTPU_AUTOTUNE_TRIAL_TIMEOUT", 900,
                                   call_site=trial_timeout)
+    raw_bc = knobs.env_str("MXTPU_AUTOTUNE_BATCH_CANDIDATES", "") or ""
+    batch_candidates = []
+    for part in raw_bc.split(","):
+        part = part.strip()
+        if part:
+            try:
+                batch_candidates.append(int(part))
+            except ValueError:
+                pass
     result = tuner.search(model=model, batch=batch, dtype=dtype,
                           steps=steps, budget=budget, mesh=mesh,
                           cache_dir=cache_dir,
                           trial_timeout=trial_timeout,
-                          extra_env=extra_env, log=log)
+                          extra_env=extra_env,
+                          batch_candidates=tuple(batch_candidates),
+                          log=log)
     if result.winner is not None:
         knobs.set_cached_defaults(result.winner.to_dict())
     return result

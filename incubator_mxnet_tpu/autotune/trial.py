@@ -49,7 +49,9 @@ _SCRUB_EXACT = ("MXTPU_AUTOTUNE", "MXTPU_LOOP_CHUNK", "MXTPU_REMAT",
                 "MXTPU_REMAT_POLICY", "MXTPU_PREFETCH_DEPTH",
                 "MXTPU_IO_WORKERS", "MXTPU_MESH", "MXTPU_PALLAS",
                 "MXTPU_NO_PALLAS", "MXTPU_FORCE_PALLAS",
-                "MXTPU_DEVICESCOPE")
+                "MXTPU_DEVICESCOPE", "MXTPU_MEMSCOPE",
+                "MXTPU_MEMSCOPE_CAPACITY", "MXTPU_MEMSCOPE_HEADROOM",
+                "MXTPU_MEMSCOPE_RING")
 
 
 def _repo_root() -> str:
@@ -68,6 +70,41 @@ def last_json_line(stdout: str):
             except json.JSONDecodeError:
                 continue
     return None
+
+
+def _memscope_from_extra(extra: dict):
+    """Pull the memory baseline the feasibility pruner scales from one
+    BENCH artifact's ``extra.memscope``: the measured watermark peak
+    when the ring saw the allocator (host RSS on backends whose devices
+    report no memory_stats), else the largest static per-program
+    footprint. None when the trial didn't arm memscope."""
+    ms = extra.get("memscope")
+    if not isinstance(ms, dict):
+        return None
+    peak, source = None, None
+    wm = ms.get("watermarks") or {}
+    for sect, tag in (("device", "watermark_device"),
+                      ("host_rss", "watermark_host_rss")):
+        s = wm.get(sect) if isinstance(wm, dict) else None
+        p = s.get("peak") if isinstance(s, dict) else None
+        if isinstance(p, (int, float)) and not isinstance(p, bool) \
+                and p > 0:
+            peak, source = int(p), tag
+            break
+    if peak is None:
+        static = [r.get("peak_bytes") for r in (ms.get("programs") or [])
+                  if isinstance(r, dict)
+                  and isinstance(r.get("peak_bytes"), (int, float))
+                  and not isinstance(r.get("peak_bytes"), bool)]
+        if static:
+            peak, source = int(max(static)), "static_footprint"
+    cap = ms.get("capacity") if isinstance(ms.get("capacity"), dict) \
+        else None
+    batch = extra.get("batch")
+    return {"peak_bytes": peak, "peak_source": source,
+            "batch": (int(batch) if isinstance(batch, int)
+                      and not isinstance(batch, bool) else None),
+            "capacity": cap}
 
 
 def measurement_from_artifact(doc: dict) -> dict:
@@ -91,6 +128,7 @@ def measurement_from_artifact(doc: dict) -> dict:
     mfu = extra.get("mfu")
     value = doc.get("value") if isinstance(doc, dict) else None
     return {
+        "memscope": _memscope_from_extra(extra),
         "busy_fraction": bf,
         "gaps": gaps,
         "starved_split": starved_split,
@@ -197,6 +235,9 @@ def trial_env(config=None, model=None, batch=None, dtype=None,
     if measure:
         env["BENCH_DEVICESCOPE"] = "1"
         env["BENCH_DEVICESCOPE_STEPS"] = str(min(8, int(steps or 8)))
+        # memscope rides the same measured trial: its watermark peak is
+        # what the feasibility pruner scales for later batch candidates
+        env["BENCH_MEMSCOPE"] = "1"
         env["BENCH_K1_CONTROL"] = "0"
         env["BENCH_TRACE"] = "0"
     if config is not None:

@@ -52,6 +52,16 @@ def _gauge(name, value):
         pass
 
 
+def _feasibility_check(knob, value, baseline):
+    """memscope's memory-feasibility verdict for one candidate move;
+    fails open (feasible) if memscope is absent or errors."""
+    try:
+        from ..memscope.feasibility import feasibility_check
+        return feasibility_check(knob, value, baseline)
+    except Exception:  # noqa: BLE001 — the pruner never blocks a trial
+        return {"feasible": True, "reason": None}
+
+
 class SearchResult:
     """The outcome of one ``search()`` call (or one cache hit)."""
 
@@ -230,6 +240,39 @@ def search(model="lenet", batch=None, dtype=None, steps=12, budget=6,
         f"allowed={plan['allowed']} "
         f"pruned={sorted(plan['pruned'])} "
         f"({len(cands)} candidates, budget {budget})")
+
+    # 2b. memory-feasibility baseline: the measured watermark peak from
+    # the baseline trial (extra.memscope), joined with the config facts
+    # the prediction scales over. Missing pieces disable the pruner —
+    # it only ever rejects what it can defend.
+    mem_base = None
+    if base.ok and isinstance(base.measurement, dict):
+        msm = base.measurement.get("memscope")
+        if isinstance(msm, dict) and msm.get("peak_bytes"):
+            mem_base = {"peak_bytes": msm["peak_bytes"],
+                        "batch": msm.get("batch") or default_cfg.batch,
+                        "remat": bool(default_cfg.remat)}
+
+    # 2c. memory-feasibility gate, BEFORE the budget is spent: a
+    # candidate whose predicted peak cannot fit under capacity x
+    # headroom is a counted pre-trial reject (reason=memory) — a whole
+    # subprocess trial saved, filed in plan["pruned"] beside the
+    # knob-family prunes so the counter==payload contract holds. The
+    # gate runs over EVERY candidate (a reject is free), so budget
+    # exhaustion can never leave an infeasible candidate unjudged.
+    if mem_base is not None:
+        feasible = []
+        for knob, value, cfg in cands:
+            verdict = _feasibility_check(knob, value, mem_base)
+            if verdict["feasible"]:
+                feasible.append((knob, value, cfg))
+                continue
+            plan["pruned"][f"{knob}={value}"] = verdict["reason"]
+            n_pruned_cands += 1
+            _counter("autotune.trials_pruned").increment()
+            log(f"autotune: candidate {knob}={value} pruned pre-trial "
+                f"({verdict['reason']})")
+        cands = feasible
 
     # 3. bounded coordinate moves, best-so-far under budget
     exhausted = False

@@ -123,6 +123,17 @@ class Replica:
         resh = checks.get("resharding") or {}
         return bool(resh.get("buckets_flagged"))
 
+    def headroom(self):
+        """Memory headroom fraction from the last deep-health poll's
+        memscope block (None when memscope isn't armed on the replica
+        or no poll has landed) — admission/operator context, not a
+        routing input: a tight replica still serves."""
+        checks = (self.last_health or {}).get("checks") or {}
+        ms = checks.get("memscope") or {}
+        hf = ms.get("headroom_fraction")
+        return float(hf) if isinstance(hf, (int, float)) \
+            and not isinstance(hf, bool) else None
+
     def live_queue_depth(self) -> int:
         """The freshest queue depth available — the in-process batcher
         when we own the server object, else one probe over the wire
@@ -154,6 +165,7 @@ class Replica:
             "outstanding": self.outstanding,
             "queue_depth": self.queue_depth(),
             "resharding_flagged": self.resharding_flagged(),
+            "headroom": self.headroom(),
             "consecutive_failures": self.consecutive_failures,
             "in_process": self.server is not None,
             "pid": self.proc.pid if self.proc is not None else None,

@@ -1,0 +1,110 @@
+"""Memory-feasibility math for the autotuner's pre-trial pruner.
+
+A knob candidate that cannot fit is the cheapest possible trial to
+win: reject it BEFORE the subprocess is spawned. The prediction is
+deliberately conservative and only covers moves whose memory effect is
+honestly predictable from the baseline measurement:
+
+* **batch** — activation-dominated peaks scale ~linearly with global
+  batch, so ``predicted = baseline_peak * candidate / baseline_batch``
+  (a lower bound for super-linear programs, which is the safe
+  direction for a *pruner*: it only ever under-predicts, so a pruned
+  candidate was truly hopeless);
+* **remat** off (``remat_policy`` -> None / ``remat`` -> False from a
+  rematerializing baseline) — disabling remat cannot shrink the peak,
+  so the baseline peak is a floor; the candidate is rejected only when
+  even that floor exceeds the limit.
+
+Everything else returns "no prediction" and runs normally — the
+pruner must never invent memory physics it cannot defend. The limit is
+``capacity * MXTPU_MEMSCOPE_HEADROOM`` (capacity from
+:func:`memscope.device_capacity`, override ``MXTPU_MEMSCOPE_CAPACITY``
+— what the smoke uses to inject an over-capacity candidate on CPU).
+An infeasible verdict is counted (``memscope.infeasible_candidates``)
+and breadcrumbed; the tuner files it under ``plan["pruned"]`` with a
+``memory:`` reason so ``extra.autotune.trials_pruned`` keeps its
+counter==payload contract.
+"""
+from __future__ import annotations
+
+from ..diagnostics import flight as _flight
+from ..profiler.counters import counter as _counter
+
+__all__ = ["predict_candidate_peak", "feasibility_check"]
+
+
+def predict_candidate_peak(knob, value, baseline):
+    """Predicted peak bytes for one knob move, or ``(None, basis)``
+    when no honest prediction exists.
+
+    ``baseline`` is the measurement dict the tuner extracted from the
+    baseline artifact's ``extra.memscope``: ``{"peak_bytes", "batch",
+    "remat"}`` (missing fields disable the matching predictions).
+    Returns ``(predicted_bytes_or_None, basis_str)``. Never raises."""
+    try:
+        peak = baseline.get("peak_bytes") if isinstance(baseline, dict) \
+            else None
+        if not peak or peak <= 0:
+            return None, "no_baseline_peak"
+        peak = float(peak)
+        if knob == "batch":
+            b0 = baseline.get("batch")
+            if not b0 or int(b0) <= 0 or value is None:
+                return None, "no_baseline_batch"
+            return peak * float(value) / float(b0), "linear_batch"
+        if knob == "remat_policy" and value is None \
+                and baseline.get("remat"):
+            return peak, "remat_floor"
+        if knob == "remat" and value is False and baseline.get("remat"):
+            return peak, "remat_floor"
+        return None, "not_memory_knob"
+    except Exception:  # noqa: BLE001 — prediction never breaks the tuner
+        return None, "error"
+
+
+def feasibility_check(knob, value, baseline, capacity_bytes=None,
+                      target=None) -> dict:
+    """Full pre-trial verdict for one candidate.
+
+    Returns ``{"feasible", "predicted_peak_bytes", "limit_bytes",
+    "basis", "reason"}`` — ``feasible`` is True (run the trial)
+    whenever prediction or capacity is unavailable; ``reason`` is the
+    ``memory: ...`` string the tuner files under ``plan["pruned"]``
+    when False. An infeasible verdict is counted and breadcrumbed
+    here, the single home of the judgement. Never raises."""
+    out = {"feasible": True, "predicted_peak_bytes": None,
+           "limit_bytes": None, "basis": None, "reason": None}
+    try:
+        from . import device_capacity, headroom_target
+        predicted, basis = predict_candidate_peak(knob, value, baseline)
+        out["basis"] = basis
+        if predicted is None:
+            return out
+        out["predicted_peak_bytes"] = int(predicted)
+        if capacity_bytes is None:
+            capacity_bytes = device_capacity().get("bytes")
+        if not capacity_bytes:
+            return out
+        if target is None:
+            target = headroom_target()
+        limit = float(capacity_bytes) * float(target)
+        out["limit_bytes"] = int(limit)
+        if predicted <= limit:
+            return out
+        out["feasible"] = False
+        out["reason"] = (
+            f"memory: predicted peak {int(predicted)} B "
+            f"({basis}) exceeds capacity {int(capacity_bytes)} B x "
+            f"headroom {float(target):g} = {int(limit)} B")
+        _counter("memscope.infeasible_candidates",
+                 "memscope").increment()
+        if _flight._REC is not None:
+            _flight.record("alert", "memscope.infeasible", {
+                "knob": str(knob), "value": str(value),
+                "predicted_peak_bytes": int(predicted),
+                "limit_bytes": int(limit), "basis": basis})
+        return out
+    except Exception:  # noqa: BLE001 — the pruner fails open
+        out["feasible"] = True
+        out["reason"] = None
+        return out

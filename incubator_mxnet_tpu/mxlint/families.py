@@ -182,6 +182,24 @@ FAMILY_TABLES = {
         "autotune/autotune.best_busy_fraction": "gauge",
         "autotune/autotune.trials_last_search": "gauge",
     },
+    # docs/memscope.md — memory footprints, watermarks, OOM forensics
+    "memscope": {
+        "memscope/memscope.programs_captured": "counter",
+        "memscope/memscope.capture_unknown": "counter",
+        "memscope/memscope.capture_errors": "counter",
+        "memscope/memscope.samples": "counter",
+        "memscope/memscope.samples_unavailable": "counter",
+        "memscope/memscope.stats_unavailable": "counter",
+        "memscope/memscope.oom_events": "counter",
+        "memscope/memscope.drift_warnings": "counter",
+        "memscope/memscope.infeasible_candidates": "counter",
+        "memscope/memscope.bytes_in_use": "gauge",
+        "memscope/memscope.peak_bytes_in_use": "gauge",
+        "memscope/memscope.host_rss_bytes": "gauge",
+        "memscope/memscope.bytes_p50": "gauge",
+        "memscope/memscope.bytes_p95": "gauge",
+        "memscope/memscope.headroom_fraction": "gauge",
+    },
     # docs/serving.md — continuous batching + replica fleet (PR 16)
     "fleet": {
         "fleet/fleet.routed": "counter",

@@ -71,6 +71,9 @@ RAW_ENV_ALLOWLIST = {
     "MXTPU_SERVESCOPE": {
         "reason": "import-time arming knob (servescope enable_from_env)",
         "files": ("servescope/__init__.py",)},
+    "MXTPU_MEMSCOPE": {
+        "reason": "import-time arming knob (memscope enable_from_env)",
+        "files": ("memscope/__init__.py",)},
     "MXTPU_STRICT": {
         "reason": "import-time arming knob (mxlint.runtime "
                   "enable_from_env)",

@@ -32,6 +32,35 @@ from . import sharding as _sharding
 __all__ = ["FusedTrainStep"]
 
 
+def _memscope_oom(exc, program, step):
+    """Attribute an escaping allocator failure before it propagates:
+    when memscope is armed and ``exc`` matches the RESOURCE_EXHAUSTED
+    taxonomy, the OOM post-mortem (this program's static footprint,
+    watermark tail, top-K ledger buffers, resolved knobs) lands on the
+    alert surfaces; the caller re-raises the original error unchanged
+    either way. One predicate when memscope is off; never raises."""
+    try:
+        from .. import memscope as _ms
+        if _ms._MS is not None:
+            _ms.record_oom(exc, program=program, step=step)
+    except Exception:  # noqa: BLE001 — forensics never masks the error
+        pass
+
+
+def _memscope_analytic(step):
+    """Hand memscope the FSDP analytic per-device byte budget so its
+    reconciliation can check the sharding claim (e.g. the 3.3x
+    param-memory reduction) against measured watermarks. Fires once per
+    built step, only under fsdp, only when memscope is armed; never
+    raises."""
+    try:
+        from .. import memscope as _ms
+        if _ms._MS is not None and step.sharding == "fsdp":
+            _ms.register_analytic(_fsdp.memory_report(step))
+    except Exception:  # noqa: BLE001 — telemetry never breaks the step
+        pass
+
+
 @contextmanager
 def _donated_cache_quarantine(step):
     """Suppress persistent-compile-cache READS while a donating fused
@@ -456,24 +485,28 @@ class FusedTrainStep:
         # the enqueue-ordering half of the PR 14 flake fix; the other
         # half is the pipeline's consumer-thread put on XLA:CPU. The
         # guarded region is the async enqueue, not the step execution.
-        with _TRANSFER_GATE, _donated_cache_quarantine(self):
-            loss, new_train, new_aux, new_states = self._jitted(
-                train_raws, aux_raws, self._states, key, lr, wd, t,
-                rescale, xb, yb)
-            if _cpu_serial_client():
-                # XLA:CPU (io/pipeline.py safety model): retire the
-                # donating execution before ANY other client call —
-                # this client races the donated-buffer handoff of a
-                # still-running execution against concurrent client
-                # work regardless of which Python thread issues it.
-                # INSIDE the gate: the donation window and the gate
-                # window coincide, so gate holders (async checkpoint
-                # saves, prefetcher puts) are mutually excluded from
-                # it. Compute∥decode overlap is unaffected (the decode
-                # pool is host-side); only async dispatch depth is
-                # forfeited, on the backend where it buys nothing.
-                jax.block_until_ready(
-                    (loss, new_train, new_aux, new_states))
+        try:
+            with _TRANSFER_GATE, _donated_cache_quarantine(self):
+                loss, new_train, new_aux, new_states = self._jitted(
+                    train_raws, aux_raws, self._states, key, lr, wd, t,
+                    rescale, xb, yb)
+                if _cpu_serial_client():
+                    # XLA:CPU (io/pipeline.py safety model): retire the
+                    # donating execution before ANY other client call —
+                    # this client races the donated-buffer handoff of a
+                    # still-running execution against concurrent client
+                    # work regardless of which Python thread issues it.
+                    # INSIDE the gate: the donation window and the gate
+                    # window coincide, so gate holders (async checkpoint
+                    # saves, prefetcher puts) are mutually excluded from
+                    # it. Compute∥decode overlap is unaffected (the decode
+                    # pool is host-side); only async dispatch depth is
+                    # forfeited, on the backend where it buys nothing.
+                    jax.block_until_ready(
+                        (loss, new_train, new_aux, new_states))
+        except Exception as e:  # noqa: BLE001 — re-raised unchanged
+            _memscope_oom(e, "fused_step", self._num_update)
+            raise
         for j, i in enumerate(self.train_idx):
             self.params[i]._data._data = new_train[j]
         for j, i in enumerate(self.aux_idx):
@@ -485,6 +518,7 @@ class FusedTrainStep:
             self._stats_published = True
             _sharding.publish_param_stats(self.params, self._states,
                                           self.mesh, self.sharding)
+            _memscope_analytic(self)
         # fully-fused path: forward+backward+collective+update is ONE XLA
         # dispatch per step (bench.py surfaces this in BENCH_*.json)
         _prof.set_gauge("trainer.dispatches_per_step", 1)
@@ -540,15 +574,19 @@ class FusedTrainStep:
                 name=f"fused_step_k{k}", dtype=xs.dtype, kind="train_step",
                 extra={"k": k}, mesh=self.mesh, mode=self.sharding)
         # donation-vs-transfer serialization, same contract as __call__
-        with _TRANSFER_GATE, _donated_cache_quarantine(self):
-            losses, new_train, new_aux, new_states = self._jitted_k(
-                train_raws, aux_raws, self._states, key, lrs, wd, t0,
-                rescale, xs, ys)
-            if _cpu_serial_client():
-                # XLA:CPU donating dispatch retires inside the gate —
-                # see the matching __call__ block and io/pipeline.py
-                jax.block_until_ready((losses, new_train, new_aux,
-                                       new_states))
+        try:
+            with _TRANSFER_GATE, _donated_cache_quarantine(self):
+                losses, new_train, new_aux, new_states = self._jitted_k(
+                    train_raws, aux_raws, self._states, key, lrs, wd, t0,
+                    rescale, xs, ys)
+                if _cpu_serial_client():
+                    # XLA:CPU donating dispatch retires inside the gate —
+                    # see the matching __call__ block and io/pipeline.py
+                    jax.block_until_ready((losses, new_train, new_aux,
+                                           new_states))
+        except Exception as e:  # noqa: BLE001 — re-raised unchanged
+            _memscope_oom(e, f"fused_step_k{k}", self._num_update)
+            raise
         self._num_update += k
         self.optimizer.num_update = self._num_update
         for j, i in enumerate(self.train_idx):
@@ -560,6 +598,7 @@ class FusedTrainStep:
             self._stats_published = True
             _sharding.publish_param_stats(self.params, self._states,
                                           self.mesh, self.sharding)
+            _memscope_analytic(self)
         # one dispatch drives k micro-steps
         _prof.set_gauge("trainer.dispatches_per_step", round(1.0 / k, 4))
         return NDArray(losses)

@@ -265,6 +265,20 @@ def _commscope_capture(name, lowered=None, compiled=None, mesh=None,
         pass
 
 
+def _memscope_capture(name, lowered=None, compiled=None, kind="program"):
+    """Hand the program to mxtpu.memscope when armed — the static
+    memory-footprint capture rides perfscope's capture hooks (one gate,
+    one set of compile sites, the commscope discipline). Never
+    raises."""
+    try:
+        from .. import memscope as _ms
+        if _ms._MS is not None:
+            _ms.capture(name, lowered=lowered, compiled=compiled,
+                        kind=kind)
+    except Exception:  # noqa: BLE001 — capture never breaks compiles
+        pass
+
+
 def analyze_lowered(lowered, name: str, dtype="float32",
                     kind: str = "program", extra: dict | None = None,
                     compiled=None, mesh=None, mode=None):
@@ -287,6 +301,8 @@ def analyze_lowered(lowered, name: str, dtype="float32",
     _devicescope_register(name, lowered)
     _commscope_capture(name, lowered=lowered, compiled=compiled,
                        mesh=mesh, mode=mode, kind=kind)
+    _memscope_capture(name, lowered=lowered, compiled=compiled,
+                      kind=kind)
     return rec
 
 

@@ -321,10 +321,48 @@ def dumps(reset: bool = False) -> str:
     return out
 
 
+# normalized key -> spellings observed across jaxlib versions/backends
+# (the memscope watermark ring and mxdiag consume the normalized names)
+_MEMSTATS_KEYS = {
+    "bytes_in_use": ("bytes_in_use",),
+    "peak_bytes_in_use": ("peak_bytes_in_use", "max_bytes_in_use"),
+    "bytes_limit": ("bytes_limit", "bytes_reservable_limit"),
+    "largest_alloc_size": ("largest_alloc_size", "largest_allocation"),
+}
+
+
 def device_memory_stats(device=None):
     """XLA allocator counters for a device (bytes_in_use, peak_bytes_in_use,
-    ...). Reference analogue: gpu memory profile / storage stats."""
-    import jax
-    device = device or jax.local_devices()[0]
-    stats = device.memory_stats()
-    return dict(stats) if stats else {}
+    ...), key spellings normalized across jaxlib versions, plus
+    ``"available": True``. Reference analogue: gpu memory profile /
+    storage stats.
+
+    Backends whose devices lack ``memory_stats()`` or return None for
+    it (XLA:CPU) degrade to a counted ``{"available": False}`` instead
+    of raising — every consumer (memscope's watermark ring, the dump
+    payload) branches on the one flag rather than on exceptions."""
+    try:
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        fn = getattr(device, "memory_stats", None)
+        stats = fn() if callable(fn) else None
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        stats = None
+    if not stats:
+        try:
+            from .counters import counter as _ctr
+            _ctr("memscope.stats_unavailable", "memscope").increment()
+        except Exception:  # noqa: BLE001
+            pass
+        return {"available": False}
+    out = dict(stats)
+    for norm, spellings in _MEMSTATS_KEYS.items():
+        if norm in out:
+            continue
+        for s in spellings:
+            if s in stats:
+                out[norm] = stats[s]
+                break
+    out["available"] = True
+    return out

@@ -327,6 +327,24 @@ class ModelServer:
         brief = _ss.attribution_brief()
         if brief is not None:
             checks["servescope_p99"] = brief
+        # memscope's live memory headroom (capacity x target vs current
+        # in-use, docs/memscope.md). Report-only, same discipline as the
+        # healthmon block: a "tight" verdict is admission/operator
+        # context, not a reason for the LB to drop a serving replica.
+        try:
+            from .. import memscope as _memscope
+            if _memscope._MS is not None:
+                hs = _memscope.headroom_state()
+                checks["memscope"] = {
+                    "headroom_fraction": hs.get("headroom_fraction"),
+                    "verdict": hs.get("verdict"),
+                    "capacity_bytes": hs.get("capacity_bytes"),
+                    "in_use_bytes": hs.get("in_use_bytes"),
+                    "oom_events": _prof.counters().get(
+                        "memscope/memscope.oom_events", 0),
+                }
+        except Exception:  # noqa: BLE001 — telemetry never breaks /healthz
+            pass
         problems = []
         if not b.running:
             problems.append("batcher_dead")

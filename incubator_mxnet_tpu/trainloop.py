@@ -56,6 +56,7 @@ import time
 import numpy as np
 
 from . import devicescope as _devicescope
+from . import memscope as _memscope
 from . import profiler as _prof
 from .autotune import knobs as _knobs
 from .io.prefetch import DevicePrefetcher
@@ -211,6 +212,10 @@ class TrainLoop:
         if win is not None:
             win.step(k, sync=lambda: float(losses[k - 1]),
                      workload="train")
+        # memscope watermark ride-along at the same chunk boundary: one
+        # allocator sample per dispatch, one predicate when off
+        if _memscope._MS is not None:
+            _memscope.sample(step=self.num_update, workload="train")
         return losses
 
     def fit(self, data, steps=None, epochs=None, cycle=None,

@@ -122,6 +122,15 @@ if timeout 1800 bash tools/autotune_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) autotune smoke FAILED (continuing; knob tuner suspect)" >> "$LOG"
 fi
+# memscope smoke (CPU-only): static footprints joined to rooflines,
+# bounded watermark ring, headroom verdict, and the autotuner's
+# memory-feasibility pruner rejecting an over-capacity batch candidate
+# pre-trial (reason=memory, zero subprocess spent)
+if timeout 1800 bash tools/memscope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) memscope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) memscope smoke FAILED (continuing; memory observability suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

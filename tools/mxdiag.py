@@ -44,6 +44,13 @@ tails, the dispatch-imbalance ratio, the shared compile-cache verdict
 (replica N+1's warmup: hit or recompile?), and the drain/swap/readmit
 deploy timeline from the events log.
 
+`mem`: the memory report from a BENCH json (`extra.memscope`) — the
+static per-program footprint table joined to the roofline verdicts
+(largest peak flagged), the watermark ring's p50/p95/peak with a tail
+sparkline, the capacity/headroom verdict, the FSDP
+analytic-vs-measured reconciliation, and the OOM post-mortem when the
+run died of RESOURCE_EXHAUSTED.
+
 `io`: the ingest-pipeline report from a BENCH json (`extra.io`) —
 pipeline geometry (decode workers, buffer depth), cumulative per-stage
 walls (read / decode / reorder / put), the consumer's empty-buffer
@@ -63,6 +70,7 @@ Usage:
     python tools/mxdiag.py perf BENCH.json
     python tools/mxdiag.py comms BENCH.json
     python tools/mxdiag.py device BENCH.json
+    python tools/mxdiag.py mem BENCH.json
     python tools/mxdiag.py io BENCH.json
     python tools/mxdiag.py serve BENCH.json
     python tools/mxdiag.py fleet BENCH.json [--events EVENTS.jsonl]
@@ -676,6 +684,180 @@ def _device_main(argv) -> int:
         print(f"device: {e}", file=sys.stderr)
         return 1
     return print_device(doc)
+
+
+# ---------------------------------------------------------------------------
+# mem: memory report from a BENCH json (extra.memscope)
+# ---------------------------------------------------------------------------
+
+_SPARK_LEVELS = ".:-=+*#%@"
+
+
+def _sparkline(values) -> str:
+    """ASCII sparkline over a small series (the watermark tail)."""
+    vals = [float(v) for v in values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_LEVELS[0] * len(vals)
+    out = []
+    for v in vals:
+        i = int((v - lo) / (hi - lo) * (len(_SPARK_LEVELS) - 1))
+        out.append(_SPARK_LEVELS[i])
+    return "".join(out)
+
+
+def print_mem(doc: dict) -> int:
+    """The "where does the memory go" report: the static per-program
+    footprint table joined to the roofline verdicts (the largest peak
+    flagged << PEAK), the watermark ring's p50/p95/peak with a tail
+    sparkline, the capacity/headroom verdict, the analytic-vs-measured
+    reconciliation, and — when the run died — the OOM post-mortem
+    (docs/memscope.md)."""
+    extra = doc.get("extra") or {}
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit')}  (model {extra.get('model')}, batch "
+          f"{extra.get('batch')}, {extra.get('dtype')})")
+    if doc.get("status") == "env_failure":
+        print(f"  run failed (env_failure): {doc.get('error')}")
+        return 1
+    ms = extra.get("memscope")
+    if not isinstance(ms, dict):
+        print("  no extra.memscope section (memscope was off — rerun "
+              "with BENCH_MEMSCOPE=1)")
+        return 1
+    progs = [p for p in (ms.get("programs") or []) if isinstance(p, dict)]
+    if progs:
+        peaks = [p.get("peak_bytes") for p in progs]
+        maxpeak = max((p for p in peaks
+                       if isinstance(p, (int, float))
+                       and not isinstance(p, bool)), default=None)
+        print(f"\n  static program footprints ({len(progs)}):")
+        width = max(len(p.get("name") or "?") for p in progs)
+        for p in progs:
+            name = p.get("name") or "?"
+            if not p.get("available"):
+                print(f"    {name:<{width}}  (no memory_analysis on "
+                      f"this backend)")
+                continue
+            verdict = f"  [{p['roofline']}]" if p.get("roofline") else ""
+            mark = "  << PEAK" if maxpeak is not None \
+                and p.get("peak_bytes") == maxpeak else ""
+            print(f"    {name:<{width}}  peak {_fmt_bytes(p.get('peak_bytes')):>11}  "
+                  f"(args {_fmt_bytes(p.get('argument_bytes'))}, "
+                  f"out {_fmt_bytes(p.get('output_bytes'))}, "
+                  f"temp {_fmt_bytes(p.get('temp_bytes'))}, "
+                  f"{p.get('provenance')})"
+                  f"{verdict}{mark}")
+    else:
+        print("\n  no static footprints captured (no compile crossed "
+              "the perfscope funnel while armed)")
+    wm = ms.get("watermarks")
+    if isinstance(wm, dict):
+        print(f"\n  watermark ring: {wm.get('ring')}/"
+              f"{wm.get('ring_limit')} samples held "
+              f"({wm.get('samples')} taken)")
+        for sect, label in (("device", "device bytes_in_use"),
+                            ("host_rss", "host RSS")):
+            blk = wm.get(sect)
+            if not isinstance(blk, dict):
+                if sect == "device":
+                    print("    device allocator: unavailable on this "
+                          "backend (host RSS carries the watermark)")
+                continue
+            print(f"    {label}: p50 {_fmt_bytes(blk.get('p50'))}  "
+                  f"p95 {_fmt_bytes(blk.get('p95'))}  "
+                  f"peak {_fmt_bytes(blk.get('peak'))}  "
+                  f"latest {_fmt_bytes(blk.get('latest'))}")
+        tail = wm.get("tail") or []
+        key = "host_rss_bytes"
+        series = [t.get(key) for t in tail if isinstance(t, dict)]
+        spark = _sparkline(series)
+        if spark:
+            print(f"    tail ({len(spark)} samples, host RSS): "
+                  f"[{spark}]")
+    hr = ms.get("headroom")
+    if isinstance(hr, dict):
+        cap, frac = hr.get("capacity_bytes"), hr.get("headroom_fraction")
+        verdict = hr.get("verdict")
+        decor = {"ok": "OK", "tight": "!! TIGHT"}.get(verdict, verdict)
+        line = (f"\n  headroom: {decor}")
+        if frac is not None:
+            line += f"  {frac:.1%} of capacity free"
+        if cap:
+            line += (f"  (in use {_fmt_bytes(hr.get('in_use_bytes'))} "
+                     f"of {_fmt_bytes(cap)} "
+                     f"[{hr.get('capacity_source')}], target "
+                     f"{hr.get('target')})")
+        print(line)
+        if verdict == "tight":
+            print("    predicted peaks above capacity x target are "
+                  "infeasible — the autotuner prunes such candidates "
+                  "pre-trial (reason=memory)")
+    recon = ms.get("reconciliation")
+    if isinstance(recon, dict) and recon.get("analytic"):
+        a, m = recon["analytic"], recon.get("measured") or {}
+        print(f"\n  reconciliation ({a.get('source')}):")
+        print(f"    analytic per-device: "
+              f"{_fmt_bytes(a.get('total_per_device'))} "
+              f"(params {_fmt_bytes(a.get('param_bytes_per_device'))}, "
+              f"states {_fmt_bytes(a.get('state_bytes_per_device'))}, "
+              f"claimed reduction x{a.get('reduction')})")
+        print(f"    measured: {_fmt_bytes(m.get('peak_bytes_in_use'))} "
+              f"({m.get('source')})")
+        drift = (recon.get("drift") or {}).get("per_device_bytes")
+        if drift is not None:
+            flag = "  !! STALE ESTIMATE" if recon.get("drift_warning") \
+                else ""
+            print(f"    drift: {drift:.1%} "
+                  f"(threshold {recon.get('threshold'):.0%}){flag}")
+    oom = ms.get("oom")
+    if isinstance(oom, dict):
+        print(f"\n  OOM POST-MORTEM (step {oom.get('step')}, program "
+              f"{oom.get('program')!r}):")
+        print(f"    error: {str(oom.get('error'))[:160]}")
+        fp = oom.get("footprint")
+        if isinstance(fp, dict) and fp.get("available"):
+            print(f"    offending program's static peak: "
+                  f"{_fmt_bytes(fp.get('peak_bytes'))} "
+                  f"({fp.get('provenance')})")
+        tail = oom.get("watermark_tail") or []
+        series = [t.get("host_rss_bytes") for t in tail
+                  if isinstance(t, dict)]
+        spark = _sparkline(series)
+        if spark:
+            print(f"    memory in the steps before death: [{spark}]")
+        bufs = oom.get("top_buffers") or []
+        if bufs:
+            print("    top live buffers at death:")
+            for b in bufs[:8]:
+                if isinstance(b, dict):
+                    print(f"      {b.get('block', '?'):<28} "
+                          f"{_fmt_bytes(b.get('bytes', 0)):>12}")
+        knobs = oom.get("knobs")
+        if isinstance(knobs, dict):
+            set_knobs = {k: v for k, v in knobs.items() if v is not None}
+            print(f"    resolved knobs: {set_knobs or '(all defaults)'}")
+    elif ms.get("oom") is None:
+        print("\n  no OOM recorded (good)")
+    return 0
+
+
+def _mem_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py mem",
+        description="memory report from a BENCH json (extra.memscope)")
+    ap.add_argument("path", help="BENCH json (bench.py output or the "
+                                 "driver wrapper)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"mem: {e}", file=sys.stderr)
+        return 1
+    return print_mem(doc)
 
 
 # ---------------------------------------------------------------------------
@@ -1311,6 +1493,8 @@ def main(argv=None) -> int:
         return _comms_main(argv[1:])
     if argv and argv[0] == "device":
         return _device_main(argv[1:])
+    if argv and argv[0] == "mem":
+        return _mem_main(argv[1:])
     if argv and argv[0] == "io":
         return _io_main(argv[1:])
     if argv and argv[0] == "serve":

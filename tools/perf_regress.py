@@ -66,6 +66,10 @@ DEFAULT_COLL_THRESHOLD = 0.10  # 10% relative increase on bytes/step
 # measured device busy fraction (devicescope window): a >10% relative
 # drop means the chip spent measurably more of the window idle
 DEFAULT_BUSY_THRESHOLD = 0.10
+# measured peak memory (memscope watermark ring, static footprint
+# fallback): >10% growth is a memory regression — the number that eats
+# the autotuner's batch headroom and ends runs in RESOURCE_EXHAUSTED
+DEFAULT_PEAK_THRESHOLD = 0.10
 DEFAULT_NOISE_MULT = 2.0
 
 
@@ -130,6 +134,30 @@ def load_artifact(path):
     rec["busy_fraction"] = (float(bf)
                             if isinstance(bf, (int, float))
                             and not isinstance(bf, bool) else None)
+    # measured peak memory from memscope's watermark ring (host RSS on
+    # backends whose devices report no allocator stats), falling back
+    # to the largest static per-program footprint; None when the run
+    # didn't arm memscope (gate skipped: both-sides contract)
+    msc = extra.get("memscope") or {}
+    peak, src = None, None
+    wm = msc.get("watermarks") if isinstance(msc, dict) else None
+    for sect in ("device", "host_rss"):
+        blk = (wm or {}).get(sect) if isinstance(wm, dict) else None
+        pv = blk.get("peak") if isinstance(blk, dict) else None
+        if isinstance(pv, (int, float)) and not isinstance(pv, bool) \
+                and pv > 0:
+            peak, src = float(pv), f"watermark {sect}"
+            break
+    if peak is None and isinstance(msc, dict):
+        static = [p.get("peak_bytes") for p in (msc.get("programs") or [])
+                  if isinstance(p, dict)
+                  and isinstance(p.get("peak_bytes"), (int, float))
+                  and not isinstance(p.get("peak_bytes"), bool)
+                  and p["peak_bytes"] > 0]
+        if static:
+            peak, src = float(max(static)), "static footprint"
+    rec["peak_bytes"] = peak
+    rec["peak_source"] = src
     # serve_load sweep: the saturation knee (tools/serve_load.py). The
     # real gates are value (= QPS at the knee) and p99_ms (= p99 at the
     # knee, already in extra.serving); the knee's position itself is
@@ -184,7 +212,8 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
             p99_threshold=DEFAULT_P99_THRESHOLD, noise=0.0,
             noise_mult=DEFAULT_NOISE_MULT,
             coll_threshold=DEFAULT_COLL_THRESHOLD,
-            busy_threshold=DEFAULT_BUSY_THRESHOLD):
+            busy_threshold=DEFAULT_BUSY_THRESHOLD,
+            peak_threshold=DEFAULT_PEAK_THRESHOLD):
     """Compare two loaded records → (regressions, notes): lists of
     human-readable strings. Lower-is-worse metrics (value, mfu) regress
     on a relative DROP beyond the effective threshold; p99 and the
@@ -288,6 +317,35 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         notes.append(f"note: only the {side} carries a devicescope "
                      f"busy fraction — busy gate skipped (needs a "
                      f"window on both sides)")
+    bpk, cpk = baseline.get("peak_bytes"), candidate.get("peak_bytes")
+    if bpk is not None and cpk is not None and bpk > 0:
+        if baseline.get("peak_source") != candidate.get("peak_source"):
+            # a watermark peak and a static footprint are different
+            # instruments — comparing them would manufacture a verdict
+            notes.append(
+                f"note: peak memory sources differ "
+                f"({baseline.get('peak_source')} vs "
+                f"{candidate.get('peak_source')}) — peak gate skipped "
+                f"(needs the same instrument on both sides)")
+        else:
+            rise = (cpk - bpk) / bpk
+            line = (f"peak memory ({candidate.get('peak_source')}): "
+                    f"{bpk:.4g} -> {cpk:.4g} B "
+                    f"({rise:+.2%} vs threshold +{peak_threshold:.1%})")
+            if rise > peak_threshold:
+                regressions.append(
+                    "REGRESSION " + line + " (the run got hungrier — "
+                    "see mxdiag.py mem for the footprint table)")
+            else:
+                notes.append("ok " + line)
+    elif (bpk is None) != (cpk is None):
+        # 0→nonzero memscope transition: only one side armed memscope —
+        # a note, never an indictment (both-sides contract, same as the
+        # devicescope busy gate)
+        side = "candidate" if bpk is None else "baseline"
+        notes.append(f"note: only the {side} carries a memscope peak — "
+                     f"peak-memory gate skipped (needs memscope armed "
+                     f"on both sides)")
     bkc, ckc = baseline.get("knee_concurrency"), \
         candidate.get("knee_concurrency")
     if bkc is not None and ckc is not None:
@@ -343,7 +401,8 @@ def _natural_key(path):
 def trajectory(paths, threshold, p99_threshold, noise_mult,
                candidate_path=None,
                coll_threshold=DEFAULT_COLL_THRESHOLD,
-               busy_threshold=DEFAULT_BUSY_THRESHOLD):
+               busy_threshold=DEFAULT_BUSY_THRESHOLD,
+               peak_threshold=DEFAULT_PEAK_THRESHOLD):
     """Directory mode: newest usable artifact vs the median of all
     earlier usable ones, thresholds widened by the observed spread.
     Returns (exit_code, lines)."""
@@ -387,7 +446,8 @@ def trajectory(paths, threshold, p99_threshold, noise_mult,
                           p99_threshold=p99_threshold, noise=noise,
                           noise_mult=noise_mult,
                           coll_threshold=coll_threshold,
-                          busy_threshold=busy_threshold)
+                          busy_threshold=busy_threshold,
+                          peak_threshold=peak_threshold)
     lines.extend(notes + regs)
     return (1 if regs else 0), lines
 
@@ -424,6 +484,12 @@ def main(argv=None) -> int:
                     help="relative drop threshold for the measured "
                          "device busy fraction (default 0.10; skipped "
                          "unless BOTH sides carry a devicescope window)")
+    ap.add_argument("--peak-threshold", type=float,
+                    default=DEFAULT_PEAK_THRESHOLD,
+                    help="relative increase threshold for measured peak "
+                         "memory bytes (default 0.10; skipped unless "
+                         "BOTH sides carry memscope data from the same "
+                         "instrument)")
     args = ap.parse_args(argv)
 
     if args.dir:
@@ -436,7 +502,8 @@ def main(argv=None) -> int:
                                args.noise_mult,
                                candidate_path=args.candidate,
                                coll_threshold=args.coll_threshold,
-                               busy_threshold=args.busy_threshold)
+                               busy_threshold=args.busy_threshold,
+                               peak_threshold=args.peak_threshold)
         for ln in lines:
             print(ln)
         print("perf_regress: " + ("REGRESSION" if rc else "OK"))
@@ -459,7 +526,8 @@ def main(argv=None) -> int:
     regs, notes = compare(base, cand, threshold=args.threshold,
                           p99_threshold=args.p99_threshold,
                           coll_threshold=args.coll_threshold,
-                          busy_threshold=args.busy_threshold)
+                          busy_threshold=args.busy_threshold,
+                          peak_threshold=args.peak_threshold)
     for ln in notes + regs:
         print(ln)
     print("perf_regress: " + ("REGRESSION" if regs else "OK"))

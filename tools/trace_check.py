@@ -91,6 +91,9 @@ PERFSCOPE_FAMILIES = _families.family_table("perfscope")
 COMMSCOPE_FAMILIES = _families.family_table("commscope")
 DEVICESCOPE_FAMILIES = _families.family_table("devicescope")
 SERVESCOPE_FAMILIES = _families.family_table("servescope")
+# memscope.* — static footprints + watermark ring + OOM forensics
+# (docs/memscope.md)
+MEMSCOPE_FAMILIES = _families.family_table("memscope")
 RESILIENCE_FAMILIES = _families.family_table("resilience")
 AUTOTUNE_FAMILIES = _families.family_table("autotune")
 # mxlint.* — the strict-mode jit-program auditor (docs/mxlint.md)
@@ -118,6 +121,25 @@ COLLECTIVE_SOURCES = ("measured", "measured(profile)", "estimated",
 # idle-gap taxonomy buckets an `extra.devicescope` gaps block classifies
 DEVICESCOPE_GAP_TAXONOMY = ("input_starved_ms", "dispatch_serialized_ms",
                             "host_gap_ms")
+
+# the closed footprint provenance taxonomy an `extra.memscope` program
+# record may declare (memscope/footprint.py FOOTPRINT_PROVENANCE):
+# XLA reported the peak, we derived it from the component sum, or the
+# backend has no memory_analysis at all
+MEMSCOPE_PROVENANCE = ("reported", "derived", "unavailable")
+
+# capacity resolution sources (memscope.device_capacity)
+MEMSCOPE_CAPACITY_SOURCES = ("env", "memory_stats", "host_ram", "unknown")
+
+# headroom verdicts (memscope.headroom_state) and the in-use pairing
+MEMSCOPE_HEADROOM_VERDICTS = ("ok", "tight", "unknown")
+MEMSCOPE_IN_USE_SOURCES = ("memory_stats", "host_rss")
+
+# non-negative byte fields of one footprint record (peak checked apart)
+MEMSCOPE_BYTE_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+                        "alias_bytes", "generated_code_bytes")
+
+MEMSCOPE_OOM_SCHEMA = "mxtpu.memscope.oom/1"
 
 # per-stage attribution keys of the optional input_starved_split block
 # (devicescope/ingest.py _starved_split), plus its dominant-stage tags
@@ -314,6 +336,7 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("devicescope/", DEVICESCOPE_FAMILIES,
                "DEVICESCOPE_FAMILIES"),
               ("servescope/", SERVESCOPE_FAMILIES, "SERVESCOPE_FAMILIES"),
+              ("memscope/", MEMSCOPE_FAMILIES, "MEMSCOPE_FAMILIES"),
               ("resilience/", RESILIENCE_FAMILIES,
                "RESILIENCE_FAMILIES"),
               ("autotune/", AUTOTUNE_FAMILIES, "AUTOTUNE_FAMILIES"),
@@ -902,6 +925,156 @@ def check_devicescope_extra(ds) -> list:
             if not isinstance(recon.get("drift_warning"), bool):
                 errors.append(f"reconciliation.drift_warning must be a "
                               f"bool, got {recon.get('drift_warning')!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# memscope bench section (extra.memscope)
+# ---------------------------------------------------------------------------
+
+def check_memscope_extra(ms) -> list:
+    """Validate an `extra.memscope` BENCH section: footprint records
+    with non-negative bytes and the closed provenance taxonomy (an
+    unavailable backend must keep the honest all-None shape), a
+    bounded watermark ring whose peak dominates the latest in-use
+    reading, a capacity block from the closed source taxonomy, a
+    headroom verdict, and — when present — an OOM post-mortem with the
+    right schema tag."""
+    if ms is None:
+        return []
+    if not isinstance(ms, dict):
+        return [f"must be an object, got {type(ms).__name__}"]
+    errors = []
+    progs = ms.get("programs")
+    if not isinstance(progs, list):
+        errors.append("needs a 'programs' list")
+        progs = []
+    for i, p in enumerate(progs):
+        if not isinstance(p, dict):
+            errors.append(f"programs[{i}]: not an object")
+            continue
+        name = p.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"programs[{i}]: missing/empty 'name'")
+        prov = p.get("provenance")
+        if prov not in MEMSCOPE_PROVENANCE:
+            errors.append(f"programs[{i}] ({name!r}): provenance "
+                          f"{prov!r} not in {MEMSCOPE_PROVENANCE}")
+        avail = p.get("available")
+        if not isinstance(avail, bool):
+            errors.append(f"programs[{i}] ({name!r}): 'available' must "
+                          f"be a bool, got {avail!r}")
+        if avail is False:
+            # armed-but-unavailable: the byte fields must stay honest
+            # Nones, not invented zeros
+            if prov != "unavailable":
+                errors.append(f"programs[{i}] ({name!r}): unavailable "
+                              f"record declares provenance {prov!r}")
+            for key in MEMSCOPE_BYTE_FIELDS + ("peak_bytes",):
+                if p.get(key) is not None:
+                    errors.append(f"programs[{i}] ({name!r}): "
+                                  f"unavailable record carries "
+                                  f"{key}={p.get(key)!r}")
+            continue
+        for key in MEMSCOPE_BYTE_FIELDS:
+            v = p.get(key)
+            if not _is_num(v) or v < 0:
+                errors.append(f"programs[{i}] ({name!r}): {key} must "
+                              f"be numeric >= 0, got {v!r}")
+        peak = p.get("peak_bytes")
+        if not _is_num(peak) or peak < 0:
+            errors.append(f"programs[{i}] ({name!r}): peak_bytes must "
+                          f"be numeric >= 0, got {peak!r}")
+        verdict = p.get("roofline")
+        if verdict is not None and verdict not in ROOFLINE_VERDICTS:
+            errors.append(f"programs[{i}] ({name!r}): roofline "
+                          f"{verdict!r} not in {ROOFLINE_VERDICTS}")
+    wm = ms.get("watermarks")
+    if wm is not None:
+        if not isinstance(wm, dict):
+            errors.append("watermarks must be an object or null")
+        else:
+            n, ring, limit = (wm.get("samples"), wm.get("ring"),
+                              wm.get("ring_limit"))
+            for key, v in (("samples", n), ("ring", ring),
+                           ("ring_limit", limit)):
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    errors.append(f"watermarks.{key} must be an int "
+                                  f">= 0, got {v!r}")
+            if isinstance(ring, int) and isinstance(limit, int) \
+                    and ring > limit:
+                errors.append(f"watermarks.ring={ring} exceeds "
+                              f"ring_limit={limit} (unbounded ring)")
+            if isinstance(ring, int) and isinstance(n, int) and ring > n:
+                errors.append(f"watermarks.ring={ring} > samples={n} "
+                              f"(phantom samples)")
+            for sect in ("device", "host_rss"):
+                blk = wm.get(sect)
+                if blk is None:
+                    continue
+                if not isinstance(blk, dict):
+                    errors.append(f"watermarks.{sect} must be an object "
+                                  f"or null")
+                    continue
+                for key in ("p50", "p95", "peak", "latest"):
+                    v = blk.get(key)
+                    if v is not None and (not _is_num(v) or v < 0):
+                        errors.append(f"watermarks.{sect}.{key} must be "
+                                      f"numeric >= 0, got {v!r}")
+                peak, latest = blk.get("peak"), blk.get("latest")
+                if sect == "device" and _is_num(peak) \
+                        and _is_num(latest) and peak < latest:
+                    errors.append(f"watermarks.device peak={peak} < "
+                                  f"latest in-use={latest} (a peak "
+                                  f"watermark cannot undercut current "
+                                  f"use)")
+    cap = ms.get("capacity")
+    if cap is not None:
+        if not isinstance(cap, dict):
+            errors.append("capacity must be an object or null")
+        else:
+            if cap.get("source") not in MEMSCOPE_CAPACITY_SOURCES:
+                errors.append(f"capacity.source={cap.get('source')!r} "
+                              f"not in {MEMSCOPE_CAPACITY_SOURCES}")
+            v = cap.get("bytes")
+            if v is not None and (not _is_num(v) or v <= 0):
+                errors.append(f"capacity.bytes must be positive or "
+                              f"null, got {v!r}")
+            if cap.get("source") != "unknown" and v is None:
+                errors.append(f"capacity declares source "
+                              f"{cap.get('source')!r} but bytes is null")
+    hr = ms.get("headroom")
+    if hr is not None:
+        if not isinstance(hr, dict):
+            errors.append("headroom must be an object or null")
+        else:
+            if hr.get("verdict") not in MEMSCOPE_HEADROOM_VERDICTS:
+                errors.append(f"headroom.verdict={hr.get('verdict')!r} "
+                              f"not in {MEMSCOPE_HEADROOM_VERDICTS}")
+            hf = hr.get("headroom_fraction")
+            if hf is not None and (not _is_num(hf)
+                                   or not 0.0 <= hf <= 1.0):
+                errors.append(f"headroom_fraction={hf!r} outside [0, 1]")
+            tgt = hr.get("target")
+            if not _is_num(tgt) or not 0.0 < tgt <= 1.0:
+                errors.append(f"headroom.target must be in (0, 1], "
+                              f"got {tgt!r}")
+            src = hr.get("in_use_source")
+            if src is not None and src not in MEMSCOPE_IN_USE_SOURCES:
+                errors.append(f"headroom.in_use_source={src!r} not in "
+                              f"{MEMSCOPE_IN_USE_SOURCES}")
+            if hr.get("verdict") != "unknown" and hf is None:
+                errors.append("headroom verdict is decided but "
+                              "headroom_fraction is null")
+    oom = ms.get("oom")
+    if oom is not None:
+        if not isinstance(oom, dict):
+            errors.append("oom must be an object or null")
+        elif oom.get("schema") != MEMSCOPE_OOM_SCHEMA:
+            errors.append(f"oom.schema={oom.get('schema')!r}, expected "
+                          f"{MEMSCOPE_OOM_SCHEMA!r}")
+        elif not isinstance(oom.get("error"), str) or not oom["error"]:
+            errors.append("oom post-mortem needs a non-empty 'error'")
     return errors
 
 
@@ -1540,6 +1713,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.devicescope: {e}"
                for e in check_devicescope_extra(
                    (doc.get("extra") or {}).get("devicescope"))]
+    errors += [f"extra.memscope: {e}"
+               for e in check_memscope_extra(
+                   (doc.get("extra") or {}).get("memscope"))]
     errors += [f"extra.sharding: {e}"
                for e in check_sharding_extra(
                    (doc.get("extra") or {}).get("sharding"))]
