@@ -827,15 +827,69 @@ def _build_transformer_lm(batch, dtype):
     return net, loss_fn, x, x, flops_per_sample, f"gpt_{units}_seq{seq}"
 
 
+def _recsys_config():
+    """The recsys family's shape knobs (bench.py is the env-exempt
+    root; the package itself reads nothing raw)."""
+    return {
+        "tables": int(os.environ.get("BENCH_RECSYS_TABLES", "8")),
+        "vocab": int(os.environ.get("BENCH_RECSYS_VOCAB", "512")),
+        "dim": int(os.environ.get("BENCH_RECSYS_DIM", "32")),
+        "dense": int(os.environ.get("BENCH_RECSYS_DENSE", "13")),
+        "bag": int(os.environ.get("BENCH_RECSYS_BAG", "4")),
+    }
+
+
+def _recsys_row(rng, cfg):
+    """One synthetic record: dense features + zipf-distributed ids
+    (float-encoded; exact for vocab < 2^24) + a learnable click label
+    (parity of the first table's first id — the tables, not the dense
+    features, carry the signal, so a decreasing loss proves the
+    embedding path trains)."""
+    dense = rng.randn(cfg["dense"]).astype(np.float32)
+    n_ids = cfg["tables"] * cfg["bag"]
+    ids = np.minimum(rng.zipf(1.5, (n_ids,)) - 1,
+                     cfg["vocab"] - 1).astype(np.float32)
+    label = np.float32(int(ids[0]) % 2)
+    return np.concatenate([dense, ids, [label]])
+
+
+def _build_recsys(batch, dtype):
+    """DLRM (models/dlrm.py): embedding bags on the model axis + MLPs +
+    pairwise interaction — the memory/comms-bound family
+    (docs/embedding.md). Ids ride float32 regardless of `dtype` (the
+    id-normalization path rounds them back to int32 exactly); a
+    bfloat16 run casts the MLPs and tables only."""
+    from incubator_mxnet_tpu.models.dlrm import (dlrm_small, dlrm_loss,
+                                                 dlrm_flops_per_sample)
+    cfg = _recsys_config()
+    net = dlrm_small(num_tables=cfg["tables"], vocab_size=cfg["vocab"],
+                     embed_dim=cfg["dim"], dense_dim=cfg["dense"],
+                     bag_size=cfg["bag"])
+    net.initialize(init=mx.init.Normal(0.05))
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    rng = np.random.RandomState(0)
+    rows = np.stack([_recsys_row(rng, cfg) for _ in range(batch)])
+    x = nd.array(rows[:, :-1])
+    y = nd.array(rows[:, -1])
+
+    def loss_fn(logits, yb):
+        return dlrm_loss(logits, yb).mean()
+
+    flops_per_sample = dlrm_flops_per_sample(net)
+    return net, loss_fn, x, y, flops_per_sample, "dlrm_recsys"
+
+
 _BENCH_MODELS = {"resnet50": _build_resnet, "bert": _build_bert,
                  "lenet": _build_lenet, "ssd": _build_ssd,
-                 "transformer_lm": _build_transformer_lm}
+                 "transformer_lm": _build_transformer_lm,
+                 "recsys": _build_recsys}
 
 # per-model default global batch — the ONE home (tools/perf_sweep.py
 # imports it for cache-key fingerprints: a row without an explicit
 # BENCH_BATCH ran at THIS batch, and the tuning-cache key must say so)
 DEFAULT_BATCH = {"resnet50": 128, "bert": 32, "lenet": 512, "ssd": 16,
-                 "transformer_lm": 16, "serving": 1}
+                 "transformer_lm": 16, "recsys": 256, "serving": 1}
 
 
 def _mfu(samples_per_s, flops_per_sample, dtype):
@@ -1432,6 +1486,170 @@ def _token_record_bench(batch, steps, dtype):
     return result
 
 
+def _ensure_recsys_rec(n, cfg):
+    """Synthetic indexed .rec of n recsys rows (cached beside the other
+    benches' records). Each record is one packed float32 row:
+    dense features + float-encoded zipf ids + label."""
+    from incubator_mxnet_tpu import recordio
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_rec")
+    os.makedirs(d, exist_ok=True)
+    stem = (f"recsys_{cfg['dense']}_{cfg['tables']}x{cfg['bag']}"
+            f"_{cfg['vocab']}_{n}")
+    rec = os.path.join(d, stem + ".rec")
+    idx = os.path.join(d, stem + ".idx")
+    if os.path.exists(rec) and os.path.exists(idx):
+        return rec
+    _log(f"building synthetic recsys record file: {n} rows")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        row = _recsys_row(rng, cfg).astype(np.float32)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, 0.0, i, 0), row.tobytes()))
+    w.close()
+    return rec
+
+
+def _recsys_bench(batch, steps, dtype, shard_mode):
+    """BENCH_MODEL=recsys: DLRM training fed from the indexed record
+    path through the staged ingest pipeline (ShardedRecordReader →
+    DevicePrefetcher) — the categorical stream the embedding subsystem
+    exists for. Reports extra.embedding (table census: per-device vs
+    replicated bytes, dedup rate, rows touched/step — schema:
+    tools/trace_check.py check_embedding_extra) on top of the io/
+    sharding/perfscope sections the other record benches carry."""
+    from incubator_mxnet_tpu.io.pipeline import ShardedRecordReader
+    from incubator_mxnet_tpu.io.prefetch import DevicePrefetcher
+    from incubator_mxnet_tpu.recordio import unpack
+    from incubator_mxnet_tpu import embedding as _embmod
+    from incubator_mxnet_tpu.models.dlrm import dlrm_bytes_per_sample
+    cfg = _recsys_config()
+    net, L, x, _y, flops_per_sample, tag = _build_recsys(batch, dtype)
+    row_len = cfg["dense"] + cfg["tables"] * cfg["bag"] + 1
+    n_rec = int(os.environ.get("BENCH_REC_IMAGES", str(max(4 * batch,
+                                                           256))))
+    rec = _ensure_recsys_rec(n_rec, cfg)
+    opt = mx.optimizer.create(
+        os.environ.get("BENCH_RECSYS_OPT", "rowsparseadagrad"),
+        learning_rate=float(os.environ.get("BENCH_LR", "0.05")))
+    from incubator_mxnet_tpu.autotune import knobs as _knobs
+    _kc = _knobs.KnobConfig.from_env()
+    step = FusedTrainStep(net, L, opt, remat=_kc.remat,
+                          remat_policy=_kc.remat_policy,
+                          sharding=shard_mode)
+
+    def decode_row(payload):
+        _h, s = unpack(payload)
+        return np.frombuffer(s, np.float32).reshape(row_len)
+
+    reader = ShardedRecordReader(rec[:-4] + ".idx", rec,
+                                 decode_fn=decode_row)
+
+    def batches():
+        it = iter(reader)
+        while True:
+            rows = []
+            while len(rows) < batch:
+                try:
+                    rows.append(next(it))
+                except StopIteration:
+                    reader.reset()
+                    it = iter(reader)
+            m = np.stack(rows)
+            yield m[:, :-1], m[:, -1]
+
+    io_tf, io_slow_ms = _io_slow_transform()
+    pf = DevicePrefetcher(batches(), depth=_kc.prefetch_depth,
+                          workers=_kc.io_workers, transform=io_tf)
+
+    # data-path-only rate: how fast can the sharded reader + pool feed?
+    probe_steps = max(4, min(steps, 8))
+    next(pf)                                      # spin up the stages
+    t0 = time.time()
+    for _ in range(probe_steps):
+        xb, yb = next(pf)
+    np.asarray(xb)[:1]                            # materialize
+    data_rate = batch * probe_steps / (time.time() - t0)
+
+    _log("compiling fused train step (recsys record path)")
+    xb, yb = next(pf)
+    from incubator_mxnet_tpu import profiler as prof
+    first_loss = []
+    trace_path, compile_s, warmup_s = _profiled_compile_warmup(
+        lambda: (first_loss.append(float(step(nd.NDArray(xb),
+                                              nd.NDArray(yb))))
+                 or first_loss[0]),
+        lambda: float(step(*map(nd.NDArray, next(pf)))))
+
+    _log(f"timing {steps} end-to-end steps @ batch {batch} (recsys)")
+    from incubator_mxnet_tpu.mxlint import runtime as _mxa_mod
+    strict_aud = _mxa_mod.auditor()
+    if strict_aud is not None:
+        strict_aud.mark_warmup_done()
+    budget = _perfscope_budget()
+    ds_win = _devicescope_window(steps)
+    t0 = time.time()
+    with prof.record_function("bench.steady", "bench", sync=False):
+        for _i in range(steps):
+            td = time.perf_counter()
+            raw_x, raw_y = next(pf)
+            # host-side id accounting: the concrete batch is already in
+            # hand, so the dedup-rate gauges cost one np.unique
+            _embmod.observe_batch(
+                np.asarray(raw_x)[:, cfg["dense"]:], cfg["vocab"])
+            nb = (nd.NDArray(raw_x), nd.NDArray(raw_y))
+            loss = _strict_guarded(strict_aud, lambda: step(*nb))
+            disp_s = time.perf_counter() - td
+            if budget is not None:
+                budget.add_dispatch(disp_s)
+            if ds_win is not None:
+                ds_win.step(1, dispatch_ms=disp_s * 1e3,
+                            sync=lambda: float(loss), workload="train")
+            _memscope_mark(_i + 1)
+        loss_val = float(loss)                    # host fetch = barrier
+    dt = time.time() - t0
+    if ds_win is not None:
+        ds_win.stop()
+    e2e = batch * steps / dt
+    bottleneck = ("input-bound (read/decode host path)"
+                  if data_rate < 1.2 * e2e else "chip-bound")
+    result = {
+        "metric": f"{tag}_samples_per_sec_per_chip",
+        "value": round(e2e, 2),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "extra": {"model": f"{tag}_record", "batch": batch,
+                  "dtype": dtype, "steps": steps,
+                  "mfu": round(_mfu(e2e, flops_per_sample, dtype), 6),
+                  "data_path_samples_s": round(data_rate, 2),
+                  "bottleneck": bottleneck,
+                  "first_loss": round(first_loss[0], 4),
+                  "final_loss": round(loss_val, 4),
+                  "device": str(jax.devices()[0])},
+    }
+    emb_extra = _embmod.bench_extra()
+    emb_extra["bytes_per_sample"] = round(dlrm_bytes_per_sample(
+        net, emb_extra.get("dedup_rate") or 0.0), 3)
+    result["extra"]["embedding"] = emb_extra
+    if shard_mode is not None:
+        from incubator_mxnet_tpu.parallel import sharding as _shmod
+        result["extra"]["sharding"] = _shmod.summary()
+    result["extra"]["io"] = _io_extra(pf._workers, _kc.prefetch_depth,
+                                      slow_ms=io_slow_ms)
+    result["extra"]["mxlint"] = _mxa_mod.bench_extra()
+    _perfscope_settle(result, budget, steps, dt,
+                      lambda: float(step(*map(nd.NDArray, next(pf)))),
+                      steps_per_call=1,
+                      flops_per_step=flops_per_sample * batch,
+                      dtype=dtype)
+    _finish_profile(result, trace_path, compile_s=compile_s,
+                    warmup_s=warmup_s, steady_s=dt,
+                    step_ms=dt / steps * 1e3)
+    pf.close()
+    return result
+
+
 def main():
     global _CURRENT_METRIC
     _main_t0 = time.time()
@@ -1533,6 +1751,18 @@ def main():
             f"serving_{os.environ.get('BENCH_SERVING_MODEL', 'lenet')}"
             f"_requests_per_sec")
         result = _serving_bench()
+        watchdog.cancel()
+        print(json.dumps(result))
+        return
+    if model == "recsys":
+        # the recsys family ALWAYS trains from the record stream (the
+        # categorical input path is the workload); BENCH_DATA does not
+        # apply
+        result = _recsys_bench(batch, steps, dtype, shard_mode)
+        if autotune_extra is not None:
+            autotune_extra["resolved"] = \
+                _knobs.KnobConfig.from_env().to_dict()
+            result.setdefault("extra", {})["autotune"] = autotune_extra
         watchdog.cancel()
         print(json.dumps(result))
         return

@@ -63,6 +63,7 @@ from . import serving
 from . import resilience
 from . import autotune
 from . import mxlint
+from . import embedding
 from . import trainloop
 from .trainloop import TrainLoop
 from . import test_utils

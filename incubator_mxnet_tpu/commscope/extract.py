@@ -75,10 +75,14 @@ EXPECTED_KINDS = {
     # model-axis layouts: Megatron f/g pairs (activation all-reduce /
     # all-gather) + the dp gradient reduce; all-to-all stays in the set
     # because XLA:CPU spells reduce-scatter that way (same decomposition
-    # the fsdp row documents) — the param-provenance rule still catches
-    # an accidental all-to-all of an input
+    # the fsdp row documents), and collective-permute because XLA's
+    # SPMD partitioner spells the reshard of an activation whose dim
+    # does NOT divide the mesh axis as pad + halo permute (DLRM's
+    # 28-wide interaction output on an mp4 mesh, e.g.) — the
+    # param-provenance rule still catches an accidental
+    # all-to-all/permute of an input
     "auto": frozenset(("all-reduce", "all-gather", "reduce-scatter",
-                       "all-to-all")),
+                       "all-to-all", "collective-permute")),
     None: frozenset(("all-reduce", "all-gather", "reduce-scatter",
                      "all-to-all", "collective-permute")),
 }
@@ -193,9 +197,11 @@ def detect_resharding(collectives, defs, mode) -> list:
     layout changes, each annotated with a `reason`:
 
     * ``"unexpected-kind"`` — op kind outside the mode's signature;
-    * ``"param-gather"`` — (dp/auto only) an all-gather/all-to-all whose
-      operand is a program input: the compiler is un-sharding an
-      annotated parameter the computation needed replicated.
+    * ``"param-gather"`` — (dp/auto only) an all-gather/all-to-all (or,
+      in auto mode, a collective-permute — the kind XLA spells
+      uneven-dim reshards with) whose operand is a program input: the
+      compiler is un-sharding an annotated parameter the computation
+      needed replicated.
 
     The ``"other"`` bucket (unknown spellings) is exempt from both
     rules: unrecognized is not mis-laid-out."""
@@ -212,8 +218,11 @@ def detect_resharding(collectives, defs, mode) -> list:
         if c["kind"] not in expect:
             flagged.append(dict(c, reason="unexpected-kind"))
             continue
+        provenance_kinds = (("all-gather", "all-to-all",
+                             "collective-permute") if mode == "auto"
+                            else ("all-gather", "all-to-all"))
         if (mode in ("dp", "auto")
-                and c["kind"] in ("all-gather", "all-to-all")
+                and c["kind"] in provenance_kinds
                 and defs
                 and any(_hlo.chases_to_parameter(defs, op)
                         for op in c.get("operands", ()))):

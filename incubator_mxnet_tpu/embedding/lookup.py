@@ -1,0 +1,157 @@
+"""Pure lookup kernels for the embedding subsystem (docs/embedding.md).
+
+Everything here operates on raw jax arrays and is jit-safe — these are
+the functions that run INSIDE the fused train step's single program, so
+the sharded gather, the dedup machinery, and the segment-summed row
+gradients all land in one XLA module where commscope can attribute the
+resulting collective.
+
+Three design points, fixed here so every consumer agrees:
+
+* **One id policy.** ``normalize_ids`` is the single home for index
+  normalization: any carrier dtype (float ids from a record stream, i64
+  from numpy) becomes int32, and out-of-range ids are resolved by ONE
+  documented policy — ``"clip"`` (clamp into ``[0, vocab)``; the
+  reference backend's GPU take semantics) or ``"error"`` (raise on
+  concrete arrays; under a tracer values are unknown, so the policy
+  degrades to clip and the degradation is documented rather than
+  silent). `gluon.nn.Embedding`, `nd.embedding` and `ShardedEmbedding`
+  all route through it, which closes the historical hole where
+  non-integer / out-of-range ids meant backend-dependent garbage.
+* **Dedup lookup.** ``dedup_lookup`` compresses the id stream before
+  touching the (vocab, dim) table: ``unique → gather → inverse-take``.
+  With the table sharded on the model axis, the cross-device traffic of
+  the gather scales with ``capacity`` (the static unique bound), not
+  with the raw id count — on a recsys batch where hot ids repeat, that
+  is the 2-3x comms saving perf_regress.py gates. ``capacity`` must be
+  a static python int (jit requires it); correctness needs
+  ``capacity >= true unique count``, so the default is
+  ``min(n_ids, vocab)`` — never lossy, maximally compressed.
+* **Row-sparse gradients.** ``segment_rowgrads`` is the backward
+  half: (ids, out_grad) → (unique_ids, row_grads, valid) via
+  segment-sum, the exact payload the row-sparse optimizer path
+  (optimizers.py) scatter-applies to touched rows only.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["OOR_POLICIES", "normalize_ids", "dedup_lookup",
+           "dedup_capacity", "segment_rowgrads", "embed"]
+
+OOR_POLICIES = ("clip", "error")
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _is_concrete(x) -> bool:
+    import jax.core
+    return not isinstance(x, jax.core.Tracer)
+
+
+def normalize_ids(ids, input_dim: int, policy: str = "clip"):
+    """int32-normalize an id array and apply the out-of-range policy.
+
+    Float carriers are rounded (``rint``), not truncated: ids that ride
+    a float32 record stream arrive as e.g. ``41.999996`` and truncation
+    would silently shift them — the original `nn.Embedding` bug this
+    satellite fixes. Integer carriers are cast straight to int32.
+
+    Policy ``"clip"`` clamps into ``[0, input_dim)``. Policy
+    ``"error"`` raises ``ValueError`` when `ids` is concrete and any id
+    is out of range; under a tracer (inside jit) values are
+    unobservable, so it clamps like "clip" — the eager-mode error is
+    the debugging affordance, the in-jit clamp is the safety net.
+    Out-of-range occurrences on concrete arrays are counted on the
+    ``embedding/embedding.oor_ids`` counter under either policy.
+    """
+    jnp = _jnp()
+    if policy not in OOR_POLICIES:
+        raise ValueError(
+            f"oor_policy must be one of {OOR_POLICIES}, got {policy!r}")
+    ids = jnp.asarray(ids)
+    if jnp.issubdtype(ids.dtype, jnp.floating):
+        ids = jnp.rint(ids).astype(jnp.int32)
+    elif ids.dtype != jnp.int32:
+        ids = ids.astype(jnp.int32)
+    if _is_concrete(ids):
+        n_oor = int(jnp.sum((ids < 0) | (ids >= input_dim)))
+        if n_oor:
+            from ..profiler.counters import counter
+            counter("embedding.oor_ids", "embedding").increment(n_oor)
+            if policy == "error":
+                raise ValueError(
+                    f"embedding lookup: {n_oor} id(s) outside "
+                    f"[0, {input_dim}) under oor_policy='error'")
+    return jnp.clip(ids, 0, input_dim - 1)
+
+
+def dedup_capacity(n_ids: int, input_dim: int, capacity=None) -> int:
+    """The static unique-id bound for one lookup: the requested
+    `capacity` clamped to ``min(n_ids, input_dim)`` (a batch cannot
+    contain more unique valid ids than either)."""
+    cap = min(int(n_ids), int(input_dim))
+    if capacity is not None:
+        cap = min(cap, max(1, int(capacity)))
+    return max(1, cap)
+
+
+def dedup_lookup(weight, ids, capacity: int):
+    """unique → gather → inverse-take, all jit-safe.
+
+    `ids` must already be normalized (int32, in-range); `capacity` is a
+    static int >= the number of unique ids (use :func:`dedup_capacity`).
+    Returns ``ids.shape + (dim,)`` rows. The table gather touches only
+    ``capacity`` rows — under a vocab-sharded table that gather is the
+    one collective of the lookup (XLA:CPU spells it as a masked local
+    gather + all-reduce of the (capacity, dim) block; a TPU target
+    spells it all-to-all) — and the inverse-take is local fan-out, no
+    comms. Unused capacity slots are filled with id 0; their gathered
+    rows are never selected by the inverse map, so padding is inert.
+    """
+    jnp = _jnp()
+    flat = ids.reshape(-1)
+    uniq, inv = jnp.unique(flat, size=capacity, fill_value=0,
+                           return_inverse=True)
+    rows = jnp.take(weight, uniq, axis=0)
+    return jnp.take(rows, inv.reshape(ids.shape), axis=0)
+
+
+def segment_rowgrads(ids, out_grad, capacity: int):
+    """(ids, dL/d_lookup) → (unique_ids, row_grads, valid).
+
+    The row-sparse backward: duplicate ids' gradients are segment-summed
+    into one row gradient per unique id. `out_grad` has shape
+    ``ids.shape + (dim,)``. Returns ``(capacity,)`` unique ids,
+    ``(capacity, dim)`` summed row grads, and a ``(capacity,)`` bool
+    mask marking the slots that hold a real id (padding slots alias id
+    0 with an all-zero gradient, but the mask lets the optimizer skip
+    even their weight-decay term — lazy semantics touch ONLY rows the
+    batch used). Pure under jit.
+    """
+    import jax
+    jnp = _jnp()
+    flat = ids.reshape(-1)
+    uniq, inv, counts = jnp.unique(flat, size=capacity, fill_value=0,
+                                   return_inverse=True, return_counts=True)
+    g = jax.ops.segment_sum(out_grad.reshape(flat.shape[0], -1),
+                            inv.reshape(-1), num_segments=capacity)
+    return uniq, g, counts > 0
+
+
+def embed(ids, weight, input_dim: int, policy: str = "clip",
+          dedup: bool = True, capacity=None):
+    """The full lookup: normalize → (dedup'd or plain) gather.
+
+    The single entry point the blocks and `nd.embedding` share; `ids`
+    may be any carrier dtype and any shape."""
+    jnp = _jnp()
+    ids = normalize_ids(ids, input_dim, policy=policy)
+    if not dedup:
+        return jnp.take(weight, ids, axis=0)
+    n = int(np.prod(ids.shape)) if ids.shape else 1
+    cap = dedup_capacity(n, input_dim, capacity)
+    return dedup_lookup(weight, ids, cap)

@@ -1,0 +1,166 @@
+"""TPU-native row-sparse optimizers (docs/embedding.md).
+
+The reference framework's recsys trick is ``lazy_update``: when the
+backward produces a RowSparse gradient, the optimizer touches ONLY the
+rows the batch used — weight rows AND their per-row optimizer state.
+Here SGD already has that path (optimizer/__init__.py); this module adds
+the two rules large-table training actually runs on:
+
+* :class:`RowSparseAdaGrad` — AdaGrad whose per-row ``hist`` accumulator
+  only advances for touched rows (parity: reference
+  ``adagrad_update`` on row_sparse weight/grad).
+* :class:`LazyAdam` — Adam whose ``m``/``v`` only advance for touched
+  rows, with bias correction by the GLOBAL step count (parity:
+  reference ``mx.optimizer.LazyAdam`` semantics: staleness of untouched
+  rows' moments is accepted by design).
+
+Both inherit the dense rule (``_update``) from their parent, so inside a
+FusedTrainStep — where the gradient is a dense array whose untouched
+rows are exact zeros produced by the XLA scatter — they run the dense
+math unchanged, and with ``wd == 0`` a zero grad row moves nothing:
+the fused one-jit program IS the row-sparse update, expressed densely.
+The ``_update_sparse`` override below is the eager/KVStore route, where
+materializing a (vocab, dim) dense gradient would defeat the point.
+
+The row kernels (:func:`adagrad_rows`, :func:`adam_rows`) are pure and
+jit-safe; the ``valid`` mask lets callers feed the padded output of
+``lookup.segment_rowgrads`` directly — padding slots are dropped by
+scattering them out of bounds (jax's documented drop semantics), so a
+padding slot aliasing row 0 can never race a real row-0 update.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer import register, Optimizer, AdaGrad, Adam
+
+__all__ = ["RowSparseAdaGrad", "LazyAdam", "adagrad_rows", "adam_rows"]
+
+
+def _safe_rows(rows, valid, vocab):
+    """Redirect padding slots out of bounds: jax scatters DROP
+    out-of-bounds updates, so invalid slots vanish instead of writing
+    stale values over a real row they alias."""
+    if valid is None:
+        return rows
+    return jnp.where(valid, rows, jnp.int32(vocab))
+
+
+def adagrad_rows(w, hist, rows, g, lr, wd, eps, valid=None):
+    """AdaGrad on `rows` only; identical math to AdaGrad._update
+    restricted to the touched rows (wd included — lazy semantics decay
+    only rows the batch used). Returns (w, hist)."""
+    w_rows = jnp.take(w, rows, axis=0).astype(jnp.float32)
+    h_rows = jnp.take(hist, rows, axis=0)
+    g = g + wd * w_rows
+    h_new = h_rows + jnp.square(g)
+    w_new = w_rows - lr * g / (jnp.sqrt(h_new) + eps)
+    tgt = _safe_rows(rows, valid, w.shape[0])
+    return (w.at[tgt].set(w_new.astype(w.dtype)),
+            hist.at[tgt].set(h_new))
+
+
+def adam_rows(w, m, v, rows, g, lr, wd, t, beta1, beta2, eps, valid=None):
+    """Adam on `rows` only, bias-corrected by the global step `t`;
+    identical math to Adam._update restricted to the touched rows.
+    Returns (w, m, v)."""
+    w_rows = jnp.take(w, rows, axis=0).astype(jnp.float32)
+    m_rows = jnp.take(m, rows, axis=0)
+    v_rows = jnp.take(v, rows, axis=0)
+    g = g + wd * w_rows
+    m_new = beta1 * m_rows + (1 - beta1) * g
+    v_new = beta2 * v_rows + (1 - beta2) * jnp.square(g)
+    tf = t.astype(jnp.float32)
+    mhat = m_new / (1 - beta1 ** tf)
+    vhat = v_new / (1 - beta2 ** tf)
+    w_new = w_rows - lr * mhat / (jnp.sqrt(vhat) + eps)
+    tgt = _safe_rows(rows, valid, w.shape[0])
+    return (w.at[tgt].set(w_new.astype(w.dtype)),
+            m.at[tgt].set(m_new), v.at[tgt].set(v_new))
+
+
+class _RowSparseMixin:
+    """The shared eager lazy path: gather touched rows + per-row state,
+    run the row kernel, scatter back — one jitted computation, cached
+    per (shape, nnz) like SGD's sparse_step."""
+
+    lazy_update = True
+
+    def _row_kernel(self, w, state, rows, g32, lr, wd, t):
+        raise NotImplementedError
+
+    def _update_sparse(self, index, weight, grad, state, skip=None):
+        if (not self.lazy_update
+                or (self.multi_precision
+                    and weight._data.dtype in (jnp.float16, jnp.bfloat16))):
+            return Optimizer._update_sparse(self, index, weight, grad, state,
+                                            skip=skip)
+        self._update_count(index)
+        lr, wd = self._get_lr_wd(index)
+        t = self._index_update_count[index]
+        has_clip = self.clip_gradient is not None
+        has_skip = skip is not None
+        key = ("rsp", weight.shape, str(weight._data.dtype), int(grad.nnz),
+               has_clip, has_skip)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def sparse_step(w, s, rows, g, lr_, wd_, t_, rs_, cl_, sk_):
+                g32 = g.astype(jnp.float32) * rs_
+                if cl_ is not None:
+                    g32 = jnp.clip(g32, -cl_, cl_)
+                new_w, new_s = self._row_kernel(w, s, rows, g32, lr_, wd_, t_)
+                if sk_ is not None:
+                    new_w = jnp.where(sk_, w, new_w)
+                    new_s = jax.tree_util.tree_map(
+                        lambda ns, os: jnp.where(sk_, os, ns), new_s, s)
+                return new_w, new_s
+
+            fn = jax.jit(sparse_step)
+            self._jit_cache[key] = fn
+        cl = jnp.float32(self.clip_gradient) if has_clip else None
+        new_w, new_state = fn(weight._data, state,
+                              grad.indices.astype(jnp.int32), grad._data,
+                              jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
+                              jnp.float32(self.rescale_grad), cl, skip)
+        weight._data = new_w
+        from ..profiler.counters import counter
+        counter("embedding.sparse_updates", "embedding").increment()
+        counter("embedding.sparse_rows_updated",
+                "embedding").increment(int(grad.nnz))
+        return new_state
+
+
+@register("rowsparseadagrad")
+class RowSparseAdaGrad(_RowSparseMixin, AdaGrad):
+    """AdaGrad with the lazy row-sparse update path (dense rule inherited
+    verbatim, so FusedTrainStep fuses it like stock AdaGrad)."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-7, lazy_update=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, eps=eps, **kwargs)
+        self.lazy_update = lazy_update
+
+    def _row_kernel(self, w, state, rows, g32, lr, wd, t):
+        (hist,) = state
+        new_w, new_hist = adagrad_rows(w, hist, rows, g32, lr, wd,
+                                       self.float_stable_eps)
+        return new_w, (new_hist,)
+
+
+@register("lazyadam")
+class LazyAdam(_RowSparseMixin, Adam):
+    """Adam with the lazy row-sparse update path (global-step bias
+    correction; untouched rows' moments stay stale by design)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.lazy_update = lazy_update
+
+    def _row_kernel(self, w, state, rows, g32, lr, wd, t):
+        m, v = state
+        new_w, new_m, new_v = adam_rows(w, m, v, rows, g32, lr, wd, t,
+                                        self.beta1, self.beta2, self.epsilon)
+        return new_w, (new_m, new_v)

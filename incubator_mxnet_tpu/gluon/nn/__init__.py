@@ -224,18 +224,26 @@ SiLU = Swish
 
 
 class Embedding(HybridBlock):
+    """Index handling follows the embedding subsystem's shared policy
+    (embedding/lookup.normalize_ids): ids are rounded to int32 and
+    `oor_policy` ('clip' or 'error') pins the out-of-range behavior that
+    used to be backend-dependent (docs/embedding.md)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
-                 weight_initializer=None, sparse_grad=False, prefix=None, params=None):
+                 weight_initializer=None, sparse_grad=False,
+                 oor_policy="clip", prefix=None, params=None):
         super().__init__(prefix, params)
         self._input_dim = input_dim
         self._output_dim = output_dim
         self._sparse_grad = sparse_grad
+        self._oor_policy = oor_policy
         self.weight = self.params.get("weight", shape=(input_dim, output_dim),
                                       dtype=dtype, init=weight_initializer)
 
     def forward(self, x):
-        return nd.embedding(x, self.weight.data(),
-                            sparse_grad=self._sparse_grad)
+        return nd.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                            sparse_grad=self._sparse_grad,
+                            oor_policy=self._oor_policy)
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ from .ssd import (SSD, SSDLoss, ssd_512_resnet18_v1, ssd_512_resnet50_v1,
                   ssd_300_resnet18_v1)
 from .transformer_lm import (TransformerLM, lm_loss, transformer_lm_small,
                              transformer_lm_base)
+from .dlrm import DLRM, dlrm_loss, dlrm_small
 
 _MODELS = {}
 for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
@@ -38,7 +39,8 @@ for _name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
               "bert_12_768_12", "bert_24_1024_16",
               "ssd_512_resnet18_v1", "ssd_512_resnet50_v1",
               "ssd_300_resnet18_v1",
-              "transformer_lm_small", "transformer_lm_base"]:
+              "transformer_lm_small", "transformer_lm_base",
+              "dlrm_small"]:
     _MODELS[_name] = globals()[_name]
 
 

@@ -219,6 +219,21 @@ FAMILY_TABLES = {
         "fleet/fleet.replicas_healthy": "gauge",
         "fleet/fleet.forward_ms": "histogram",
     },
+    # docs/embedding.md — sharded tables, dedup lookup, row-sparse
+    # updates (PR 19)
+    "embedding": {
+        "embedding/embedding.lookups": "counter",
+        "embedding/embedding.dedup_lookups": "counter",
+        "embedding/embedding.oor_ids": "counter",
+        "embedding/embedding.sparse_updates": "counter",
+        "embedding/embedding.sparse_rows_updated": "counter",
+        "embedding/embedding.tables": "gauge",
+        "embedding/embedding.table_bytes_logical": "gauge",
+        "embedding/embedding.table_bytes_per_device": "gauge",
+        "embedding/embedding.ids_per_step": "gauge",
+        "embedding/embedding.rows_touched_per_step": "gauge",
+        "embedding/embedding.dedup_rate": "gauge",
+    },
     # docs/mxlint.md — static analyzer + strict-mode jit auditor (PR 14)
     "mxlint": {
         "mxlint/mxlint.strict": "gauge",

@@ -1216,8 +1216,17 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
     return _unary(f, indices, "one_hot")
 
 
-def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, oor_policy="clip"):
     """Parity: nd.Embedding — lookup rows of `weight` by integer `data`.
+
+    Index handling is the embedding subsystem's ONE policy
+    (embedding/lookup.normalize_ids): non-integer carriers are rounded
+    (not truncated) to int32, and out-of-range ids follow `oor_policy` —
+    ``"clip"`` clamps into ``[0, vocab)``, ``"error"`` raises on concrete
+    arrays (clamps inside a trace); occurrences are counted on
+    ``embedding/embedding.oor_ids``. Before this, both behaviors were
+    whatever the backend's take() did — backend-dependent garbage.
 
     sparse_grad=True makes the weight's gradient a RowSparseNDArray holding
     only the looked-up rows (parity: Embedding(sparse_grad=True) →
@@ -1231,10 +1240,18 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_
                                  else None)
         return _sym_call("Embedding", data=data, weight=weight,
                          input_dim=in_dim, output_dim=out_dim)
+    from ..embedding import lookup as _emb_lookup
     data = _as_nd(data)
+    vocab = int(input_dim if input_dim is not None else weight.shape[0])
     if sparse_grad and not isinstance(data._data, jax.core.Tracer):
+        data = _apply(
+            lambda i: _emb_lookup.normalize_ids(i, vocab, policy=oor_policy),
+            [data], name="normalize_ids")
         return _sparse_embedding(data, weight)
-    return _apply(lambda i, w: jnp.take(w, i.astype(jnp.int32), axis=0),
+    return _apply(lambda i, w: jnp.take(
+                      w, _emb_lookup.normalize_ids(i, vocab,
+                                                   policy=oor_policy),
+                      axis=0),
                   [data, weight], name="embedding")
 
 

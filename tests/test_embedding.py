@@ -1,0 +1,377 @@
+"""mxtpu.embedding tier-1 (ISSUE 19): dedup lookup equivalence vs plain
+gather, the shared OOR-id policy (gluon.nn.Embedding index bugfix rides
+the same normalize_ids), row-sparse optimizer parity vs the dense
+reference on overlapping/duplicate ids, bit-parity of the sharded
+(4-fake-device model axis) DLRM step vs single-device, and the
+resharding detector on REAL compiled lookup HLO (quiet on a
+vocab-annotated table, fires on a deliberately dp-pinned one)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.embedding import (EmbeddingBag, LazyAdam,
+                                           RowSparseAdaGrad,
+                                           ShardedEmbedding, dedup_capacity,
+                                           dedup_lookup, embed,
+                                           normalize_ids, segment_rowgrads)
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.ndarray import sparse as ndsparse
+from incubator_mxnet_tpu.parallel import FusedTrainStep, make_mesh, sharding
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends without a process-global mesh."""
+    sharding.clear_mesh()
+    yield
+    sharding.clear_mesh()
+
+
+# ---------------------------------------------------------------- lookup
+
+class TestNormalizeIds:
+    def test_float_carrier_rounds_not_truncates(self):
+        # the historical bug: 2.9999998 (a float32 that *means* 3) must
+        # land on row 3 — astype(int32) alone truncates it to 2
+        ids = jnp.asarray([2.9999998, 0.0, 5.0000002], jnp.float32)
+        out = normalize_ids(ids, 16)
+        assert out.dtype == jnp.int32
+        assert out.tolist() == [3, 0, 5]
+
+    def test_int_dtypes_cast_to_int32(self):
+        out = normalize_ids(jnp.asarray([1, 2], jnp.int16), 16)
+        assert out.dtype == jnp.int32 and out.tolist() == [1, 2]
+
+    def test_clip_policy_clips_and_counts(self):
+        from incubator_mxnet_tpu.profiler.counters import counters
+        before = counters().get("embedding/embedding.oor_ids", 0)
+        out = normalize_ids(jnp.asarray([-3, 7, 99], jnp.int32), 8,
+                            policy="clip")
+        assert out.tolist() == [0, 7, 7]
+        assert counters()["embedding/embedding.oor_ids"] == before + 2
+
+    def test_error_policy_raises_on_concrete_oor(self):
+        with pytest.raises(ValueError, match="outside"):
+            normalize_ids(jnp.asarray([99], jnp.int32), 8, policy="error")
+        # in-range ids pass through untouched under "error"
+        assert normalize_ids(jnp.asarray([7], jnp.int32), 8,
+                             policy="error").tolist() == [7]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            normalize_ids(jnp.asarray([0], jnp.int32), 8, policy="wat")
+
+
+class TestDedupLookup:
+    def test_matches_plain_gather_with_duplicates(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(32, 6).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, 32, size=(4, 9)), jnp.int32)
+        cap = dedup_capacity(ids.size, 32)
+        out = dedup_lookup(w, ids, cap)
+        ref = jnp.take(w, ids, axis=0)
+        assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_capacity_clamp_is_lossless(self):
+        # min(n_ids, vocab) always covers every distinct id
+        assert dedup_capacity(1000, 32) == 32
+        assert dedup_capacity(8, 32) == 8
+        assert dedup_capacity(1000, 32, capacity=16) == 16
+
+    def test_embed_dedup_on_off_identical(self):
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, 16, size=(3, 5)).astype(np.float32))
+        a = embed(ids, w, 16, dedup=True)
+        b = embed(ids, w, 16, dedup=False)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_embed_is_jit_safe(self):
+        rng = np.random.RandomState(2)
+        w = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+        ids = jnp.asarray(rng.randint(0, 16, size=(8,)), jnp.int32)
+        f = jax.jit(lambda i, wt: embed(i, wt, 16, dedup=True))
+        assert np.array_equal(np.asarray(f(ids, w)),
+                              np.asarray(jnp.take(w, ids, axis=0)))
+
+
+class TestSegmentRowgrads:
+    def test_sums_duplicates_like_dense_scatter_add(self):
+        rng = np.random.RandomState(3)
+        V, D = 16, 4
+        ids = jnp.asarray([3, 7, 3, 0, 7, 7], jnp.int32)
+        g = jnp.asarray(rng.randn(6, D).astype(np.float32))
+        uniq, rows, valid = segment_rowgrads(ids, g, capacity=6)
+        dense = np.zeros((V, D), np.float32)
+        np.add.at(dense, np.asarray(ids), np.asarray(g))
+        rebuilt = np.zeros((V, D), np.float32)
+        for u, r, v in zip(np.asarray(uniq), np.asarray(rows),
+                           np.asarray(valid)):
+            if v:
+                rebuilt[int(u)] += r
+        np.testing.assert_allclose(rebuilt, dense, rtol=1e-6)
+        # exactly 3 distinct ids are marked valid
+        assert int(np.asarray(valid).sum()) == 3
+
+
+# ---------------------------------------------------------------- blocks
+
+class TestShardedEmbeddingBlock:
+    def test_forward_matches_take_and_annotates_vocab(self):
+        mx.random.seed(0)
+        emb = ShardedEmbedding(32, 8)
+        emb.initialize(init=mx.init.Normal(0.05))
+        assert emb.weight._sharding == P("vocab", None)
+        ids = nd.array(np.random.RandomState(0)
+                       .randint(0, 32, size=(4, 5)).astype(np.float32))
+        out = emb(ids)
+        ref = jnp.take(emb.weight.data()._data,
+                       ids._data.astype(jnp.int32), axis=0)
+        assert np.array_equal(np.asarray(out._data), np.asarray(ref))
+
+    def test_bag_pools_inside_the_op(self):
+        mx.random.seed(0)
+        for mode, red in (("sum", jnp.sum), ("mean", jnp.mean)):
+            bag = EmbeddingBag(16, 4, mode=mode)
+            bag.initialize(init=mx.init.Normal(0.05))
+            ids = nd.array(np.random.RandomState(1)
+                           .randint(0, 16, size=(3, 6)).astype(np.float32))
+            out = bag(ids)
+            ref = red(jnp.take(bag.weight.data()._data,
+                               ids._data.astype(jnp.int32), axis=0), axis=-2)
+            np.testing.assert_allclose(np.asarray(out._data),
+                                       np.asarray(ref), rtol=1e-6)
+
+    def test_gluon_embedding_shares_the_policy(self):
+        """Satellite 1: nn.Embedding normalizes float carriers by
+        rounding and honors the same OOR policy as ShardedEmbedding."""
+        mx.random.seed(0)
+        emb = nn.Embedding(8, 4)
+        emb.initialize(init=mx.init.Normal(0.05))
+        w = emb.weight.data()._data
+        out = emb(nd.array(np.asarray([2.9999998, 99.0], np.float32)))
+        assert np.array_equal(np.asarray(out._data[0]), np.asarray(w[3]))
+        assert np.array_equal(np.asarray(out._data[1]), np.asarray(w[7]))
+        strict = nn.Embedding(8, 4, oor_policy="error")
+        strict.initialize(init=mx.init.Normal(0.05))
+        with pytest.raises(ValueError, match="outside"):
+            strict(nd.array(np.asarray([99.0], np.float32)))
+
+
+# ------------------------------------------------------ sparse optimizers
+
+def _rsp(ids, rows, shape):
+    return ndsparse.RowSparseNDArray(jnp.asarray(rows),
+                                     jnp.asarray(ids, jnp.int32), shape)
+
+
+def _parity_case(seed=0, V=24, D=5, nnz=7):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(V, D).astype(np.float32)
+    ids = rng.choice(V, size=nnz, replace=False).astype(np.int32)
+    rows = rng.randn(nnz, D).astype(np.float32)
+    return w, ids, rows
+
+
+class TestRowSparseOptimizers:
+    def test_registry_names(self):
+        assert isinstance(mx.optimizer.create("rowsparseadagrad"),
+                          RowSparseAdaGrad)
+        assert isinstance(mx.optimizer.create("lazyadam"), LazyAdam)
+
+    @pytest.mark.parametrize("name,dense_name",
+                             [("rowsparseadagrad", "adagrad"),
+                              ("lazyadam", "adam")])
+    def test_bit_parity_with_dense_reference(self, name, dense_name):
+        """The row-sparse scatter update is BIT-identical to the dense
+        update on the same batch (wd=0: a dense grad's zero rows move
+        nothing, so restricting to touched rows is exact)."""
+        w_np, ids, rows = _parity_case()
+        sp = mx.optimizer.create(name, learning_rate=0.05)
+        dn = mx.optimizer.create(dense_name, learning_rate=0.05)
+        w_sp, w_dn = nd.array(w_np.copy()), nd.array(w_np.copy())
+        st_sp = sp.create_state(0, w_sp._data)
+        st_dn = dn.create_state(0, w_dn._data)
+        for step in range(3):
+            rsp = _rsp(ids, rows * (step + 1), w_np.shape)
+            st_sp = sp.update(0, w_sp, rsp, st_sp)
+            st_dn = dn.update(0, w_dn, rsp.todense(), st_dn)
+        assert np.array_equal(np.asarray(w_sp._data), np.asarray(w_dn._data))
+        for a, b in zip(jax.tree_util.tree_leaves(st_sp),
+                        jax.tree_util.tree_leaves(st_dn)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_lazy_semantics_with_weight_decay(self):
+        """wd>0: touched rows match the dense update restricted to those
+        rows; UNTOUCHED rows stay bit-identical (the lazy contract — the
+        dense reference would decay them)."""
+        w_np, ids, rows = _parity_case(seed=1)
+        sp = mx.optimizer.create("rowsparseadagrad", learning_rate=0.05,
+                                 wd=0.01)
+        dn = mx.optimizer.create("adagrad", learning_rate=0.05, wd=0.01)
+        w_sp, w_dn = nd.array(w_np.copy()), nd.array(w_np.copy())
+        st_sp = sp.create_state(0, w_sp._data)
+        st_dn = dn.create_state(0, w_dn._data)
+        st_sp = sp.update(0, w_sp, _rsp(ids, rows, w_np.shape), st_sp)
+        dense_grad = _rsp(ids, rows, w_np.shape).todense()
+        st_dn = dn.update(0, w_dn, dense_grad, st_dn)
+        touched = np.zeros(w_np.shape[0], bool)
+        touched[ids] = True
+        got, ref = np.asarray(w_sp._data), np.asarray(w_dn._data)
+        assert np.array_equal(got[touched], ref[touched])
+        assert np.array_equal(got[~touched], w_np[~touched])
+        # the dense reference DID decay the untouched rows — the two
+        # semantics genuinely differ there, which is what lazy means
+        assert not np.array_equal(ref[~touched], w_np[~touched])
+
+    def test_lazy_update_false_densifies(self):
+        w_np, ids, rows = _parity_case(seed=2)
+        sp = mx.optimizer.create("rowsparseadagrad", learning_rate=0.05,
+                                 wd=0.01, lazy_update=False)
+        dn = mx.optimizer.create("adagrad", learning_rate=0.05, wd=0.01)
+        w_sp, w_dn = nd.array(w_np.copy()), nd.array(w_np.copy())
+        st_sp = sp.create_state(0, w_sp._data)
+        st_dn = dn.create_state(0, w_dn._data)
+        rsp = _rsp(ids, rows, w_np.shape)
+        st_sp = sp.update(0, w_sp, rsp, st_sp)
+        st_dn = dn.update(0, w_dn, rsp.todense(), st_dn)
+        assert np.array_equal(np.asarray(w_sp._data), np.asarray(w_dn._data))
+
+    def test_oor_rows_dropped_not_scattered(self):
+        """Rows flagged invalid (padding / out-of-range) must not touch
+        the table — the OOB-scatter-drop trick, not a clamp to row 0."""
+        from incubator_mxnet_tpu.embedding.optimizers import adagrad_rows
+        V, D = 8, 3
+        w = jnp.zeros((V, D), jnp.float32)
+        hist = jnp.zeros((V, D), jnp.float32)
+        rows = jnp.asarray([0, 2], jnp.int32)
+        g = jnp.ones((2, D), jnp.float32)
+        valid = jnp.asarray([False, True])
+        new_w, _ = adagrad_rows(w, hist, rows, g, lr=0.1, wd=0.0,
+                                eps=1e-7, valid=valid)
+        got = np.asarray(new_w)
+        assert np.array_equal(got[0], np.zeros(D))     # dropped, not row 0
+        assert not np.array_equal(got[2], np.zeros(D))  # the valid row moved
+
+
+# ------------------------------------------------- sharded DLRM bit-parity
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_compile_cache():
+    """Same hazard as tests/test_sharding.py: this jaxlib's CPU backend
+    has mis-deserialized persistent-cache entries for donated sharded
+    fused-step executables. Compile fresh in this module."""
+    from jax._src import compilation_cache as cc
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    cc.reset_cache()
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+    cc.reset_cache()
+
+
+def _dlrm_step(mode=None, n=3):
+    from incubator_mxnet_tpu.models.dlrm import dlrm_loss, dlrm_small
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = dlrm_small(num_tables=4, vocab_size=64, embed_dim=8,
+                     dense_dim=4, bag_size=2, bottom_units=(16,),
+                     top_units=(16,))
+    net.initialize(init=mx.init.Normal(0.05))
+    opt = mx.optimizer.create("rowsparseadagrad", learning_rate=0.05)
+    step = FusedTrainStep(net, lambda o, y: dlrm_loss(o, y).mean(),
+                          opt, sharding=mode)
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(n):
+        dense = rng.randn(16, 4).astype(np.float32)
+        ids = rng.randint(0, 64, size=(16, 8)).astype(np.float32)
+        y = (rng.rand(16) < 0.5).astype(np.float32)
+        losses.append(float(step(nd.array(np.concatenate([dense, ids], 1)),
+                                 nd.array(y))))
+    return losses, step
+
+
+@needs8
+class TestShardedDLRM:
+    def test_mp4_bit_identical_and_table_sharded(self):
+        ref, _ = _dlrm_step()
+        sharding.clear_mesh()
+        sharding.set_mesh(make_mesh({"mp": 4}, devices=jax.devices()[:4]))
+        losses, step = _dlrm_step(mode="auto")
+        assert losses == ref                       # BIT-level, not allclose
+        tables = [p for p in step.params if "embed" in p.name
+                  and "weight" in p.name]
+        assert tables
+        for p in tables:
+            raw = p.data()._data
+            assert "mp" in str(raw.sharding.spec)
+            shard0 = next(s for s in raw.addressable_shards
+                          if s.device == jax.devices()[0])
+            # vocab axis really split 4 ways on device 0
+            assert shard0.data.shape[0] * 4 == p.shape[0]
+
+
+# ------------------------------------------------- resharding detector
+
+def _lookup_lowered(mesh, table_spec, ids_spec):
+    """Lower a jitted dedup-lookup loss (the real kernel shape) under
+    explicit in/out shardings and return the Lowered object."""
+    V, D, N, CAP = 64, 8, 256, 64
+    rng = np.random.RandomState(0)
+    w = jax.device_put(rng.randn(V, D).astype(np.float32),
+                       NamedSharding(mesh, table_spec))
+    ids = jax.device_put(rng.randint(0, V, size=(N,)).astype(np.int32),
+                         NamedSharding(mesh, ids_spec))
+
+    def loss(wt, i):
+        uniq, inv = jnp.unique(i, size=CAP, fill_value=0,
+                               return_inverse=True)
+        rows = jnp.take(wt, uniq, axis=0)
+        out = jnp.take(rows, inv.reshape(i.shape), axis=0)
+        return jnp.sum(out * out)
+
+    f = jax.jit(jax.value_and_grad(loss),
+                in_shardings=(NamedSharding(mesh, table_spec),
+                              NamedSharding(mesh, ids_spec)),
+                out_shardings=(NamedSharding(mesh, P()),
+                               NamedSharding(mesh, table_spec)))
+    return f.lower(w, ids)
+
+
+@needs8
+class TestLookupResharding:
+    def test_quiet_on_vocab_sharded_table(self):
+        """Correctly annotated table (vocab→mp) + replicated ids: XLA
+        spells the sharded gather as masked-gather + all-reduce of a
+        COMPUTED block — the detector must stay quiet."""
+        from incubator_mxnet_tpu import commscope as cs
+        mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
+        lowered = _lookup_lowered(mesh, P("mp", None), P())
+        rec = cs.capture("test_lookup_clean", lowered=lowered,
+                         mesh=mesh, mode="auto")
+        assert rec["collectives"]                  # it IS a sharded program
+        assert rec["resharding_collectives"] == 0
+
+    def test_fires_on_dp_pinned_table(self):
+        """Deliberately dp-pinned table + batch-sharded ids in dp mode:
+        the gather must all-gather a program PARAMETER — the param-gather
+        rule indicts it."""
+        from incubator_mxnet_tpu import commscope as cs
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        lowered = _lookup_lowered(mesh, P("dp", None), P("dp"))
+        with pytest.warns(UserWarning, match="resharding"):
+            rec = cs.capture("test_lookup_dp_pinned", lowered=lowered,
+                             mesh=mesh, mode="dp")
+        assert rec["resharding_collectives"] > 0
+        reasons = {f["reason"] for f in rec["resharding"]}
+        assert "param-gather" in reasons or "unexpected-kind" in reasons

@@ -131,6 +131,16 @@ if timeout 1800 bash tools/memscope_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) memscope smoke FAILED (continuing; memory observability suspect)" >> "$LOG"
 fi
+# embedding smoke (CPU-only mp4 mesh): 50 recsys/DLRM steps with the
+# vocab-sharded tables, dedup lookup, and row-sparse AdaGrad — loss
+# must fall, per-device table bytes must beat replicated, the lookup
+# collective must attribute to the mp axis, and the resharding
+# detector must stay quiet on the annotated layout
+if timeout 1200 bash tools/embedding_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) embedding smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) embedding smoke FAILED (continuing; embedding subsystem suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

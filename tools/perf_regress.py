@@ -70,6 +70,12 @@ DEFAULT_BUSY_THRESHOLD = 0.10
 # fallback): >10% growth is a memory regression — the number that eats
 # the autotuner's batch headroom and ends runs in RESOURCE_EXHAUSTED
 DEFAULT_PEAK_THRESHOLD = 0.10
+# dedup rate (extra.embedding.dedup_rate, recsys artifacts): for a
+# fixed record stream the id distribution is deterministic, so like the
+# collective inventory this has no timing scatter — and a drop is a
+# silent comms blowup (the sharded gather's payload scales with
+# 1 - dedup_rate)
+DEFAULT_DEDUP_THRESHOLD = 0.10
 DEFAULT_NOISE_MULT = 2.0
 
 
@@ -167,6 +173,12 @@ def load_artifact(path):
     kc = sl.get("knee_concurrency") if isinstance(sl, dict) else None
     rec["knee_concurrency"] = (int(kc) if isinstance(kc, int)
                                and not isinstance(kc, bool) else None)
+    # embedding dedup rate (recsys artifacts) — None when the run
+    # carried no extra.embedding (gate skipped: both-sides contract)
+    emb = extra.get("embedding") or {}
+    dr = emb.get("dedup_rate") if isinstance(emb, dict) else None
+    rec["dedup_rate"] = (float(dr) if isinstance(dr, (int, float))
+                         and not isinstance(dr, bool) else None)
     # the knob config the run ACTUALLY resolved to (extra.autotune.
     # resolved — present on every post-autotune training artifact,
     # tuned or not; `winner` is the fallback for tuned artifacts that
@@ -213,7 +225,8 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
             noise_mult=DEFAULT_NOISE_MULT,
             coll_threshold=DEFAULT_COLL_THRESHOLD,
             busy_threshold=DEFAULT_BUSY_THRESHOLD,
-            peak_threshold=DEFAULT_PEAK_THRESHOLD):
+            peak_threshold=DEFAULT_PEAK_THRESHOLD,
+            dedup_threshold=DEFAULT_DEDUP_THRESHOLD):
     """Compare two loaded records → (regressions, notes): lists of
     human-readable strings. Lower-is-worse metrics (value, mfu) regress
     on a relative DROP beyond the effective threshold; p99 and the
@@ -364,6 +377,25 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         notes.append(f"note: only the {side} carries a serve_load knee "
                      f"— knee context skipped (needs a sweep on both "
                      f"sides)")
+    bdr, cdr = baseline.get("dedup_rate"), candidate.get("dedup_rate")
+    if bdr is not None and cdr is not None and bdr > 0:
+        drop = (bdr - cdr) / bdr
+        # no noise widening: for a fixed record stream the dedup rate is
+        # deterministic — any drop is a code change, not run-to-run jitter
+        line = (f"dedup rate: {bdr:.4f} -> {cdr:.4f} "
+                f"({-drop:+.2%} vs threshold -{dedup_threshold:.1%})")
+        if drop > dedup_threshold:
+            regressions.append(
+                "REGRESSION " + line + " (the lookup dedup stopped "
+                "compressing the sharded gather — the per-step "
+                "collective bytes blow up with it; see docs/embedding.md)")
+        else:
+            notes.append("ok " + line)
+    elif (bdr is None) != (cdr is None):
+        side = "candidate" if bdr is None else "baseline"
+        notes.append(f"note: only the {side} carries an embedding dedup "
+                     f"rate — dedup gate skipped (needs extra.embedding "
+                     f"on both sides)")
     cr = candidate.get("resharding")
     if cr:
         br = baseline.get("resharding")
@@ -402,7 +434,8 @@ def trajectory(paths, threshold, p99_threshold, noise_mult,
                candidate_path=None,
                coll_threshold=DEFAULT_COLL_THRESHOLD,
                busy_threshold=DEFAULT_BUSY_THRESHOLD,
-               peak_threshold=DEFAULT_PEAK_THRESHOLD):
+               peak_threshold=DEFAULT_PEAK_THRESHOLD,
+               dedup_threshold=DEFAULT_DEDUP_THRESHOLD):
     """Directory mode: newest usable artifact vs the median of all
     earlier usable ones, thresholds widened by the observed spread.
     Returns (exit_code, lines)."""
@@ -447,7 +480,8 @@ def trajectory(paths, threshold, p99_threshold, noise_mult,
                           noise_mult=noise_mult,
                           coll_threshold=coll_threshold,
                           busy_threshold=busy_threshold,
-                          peak_threshold=peak_threshold)
+                          peak_threshold=peak_threshold,
+                          dedup_threshold=dedup_threshold)
     lines.extend(notes + regs)
     return (1 if regs else 0), lines
 
@@ -490,6 +524,11 @@ def main(argv=None) -> int:
                          "memory bytes (default 0.10; skipped unless "
                          "BOTH sides carry memscope data from the same "
                          "instrument)")
+    ap.add_argument("--dedup-threshold", type=float,
+                    default=DEFAULT_DEDUP_THRESHOLD,
+                    help="relative drop threshold for the embedding "
+                         "lookup dedup rate (default 0.10; skipped "
+                         "unless BOTH sides carry extra.embedding)")
     args = ap.parse_args(argv)
 
     if args.dir:
@@ -503,7 +542,8 @@ def main(argv=None) -> int:
                                candidate_path=args.candidate,
                                coll_threshold=args.coll_threshold,
                                busy_threshold=args.busy_threshold,
-                               peak_threshold=args.peak_threshold)
+                               peak_threshold=args.peak_threshold,
+                               dedup_threshold=args.dedup_threshold)
         for ln in lines:
             print(ln)
         print("perf_regress: " + ("REGRESSION" if rc else "OK"))
@@ -527,7 +567,8 @@ def main(argv=None) -> int:
                           p99_threshold=args.p99_threshold,
                           coll_threshold=args.coll_threshold,
                           busy_threshold=args.busy_threshold,
-                          peak_threshold=args.peak_threshold)
+                          peak_threshold=args.peak_threshold,
+                          dedup_threshold=args.dedup_threshold)
     for ln in notes + regs:
         print(ln)
     print("perf_regress: " + ("REGRESSION" if regs else "OK"))

@@ -54,7 +54,7 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_resilience_extra",
            "check_autotune_extra", "check_mxlint_extra", "check_io_extra",
-           "check_file"]
+           "check_embedding_extra", "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -100,6 +100,9 @@ AUTOTUNE_FAMILIES = _families.family_table("autotune")
 MXLINT_FAMILIES = _families.family_table("mxlint")
 # fleet.* — continuous batching + replica fleet (docs/serving.md)
 FLEET_FAMILIES = _families.family_table("fleet")
+# embedding.* — sharded tables, dedup lookup, row-sparse updates
+# (docs/embedding.md)
+EMBEDDING_FAMILIES = _families.family_table("embedding")
 
 # sharding modes a BENCH extra.sharding may declare (parallel/sharding.py)
 SHARDING_MODES = ("dp", "fsdp", "auto")
@@ -1617,6 +1620,42 @@ def check_sharding_extra(sh) -> list:
     return errors
 
 
+def check_embedding_extra(em) -> list:
+    """Validate an `extra.embedding` BENCH section (BENCH_MODEL=recsys
+    runs; emitted by mxtpu.embedding.bench_extra): the table census
+    (logical vs per-device bytes — sharded means per-device <=
+    logical), the dedup accounting (rate in [0, 1], rows touched never
+    above ids seen), and the closed out-of-range-id policy."""
+    if em is None:
+        return []
+    if not isinstance(em, dict):
+        return [f"must be an object, got {type(em).__name__}"]
+    errors = []
+    for key in ("tables", "table_bytes_logical", "table_bytes_per_device",
+                "rows_total", "ids_per_step", "rows_touched_per_step",
+                "oor_ids", "lookups"):
+        v = em.get(key)
+        if not _is_num(v) or v < 0:
+            errors.append(f"{key} must be numeric >= 0, got {v!r}")
+    logical = em.get("table_bytes_logical")
+    per_dev = em.get("table_bytes_per_device")
+    if _is_num(logical) and _is_num(per_dev) and per_dev > logical:
+        errors.append(f"table_bytes_per_device={per_dev} exceeds the "
+                      f"replicated footprint table_bytes_logical={logical}")
+    rate = em.get("dedup_rate")
+    if not _is_num(rate) or not (0.0 <= rate <= 1.0):
+        errors.append(f"dedup_rate must be in [0, 1], got {rate!r}")
+    ids = em.get("ids_per_step")
+    rows = em.get("rows_touched_per_step")
+    if _is_num(ids) and _is_num(rows) and rows > ids:
+        errors.append(f"rows_touched_per_step={rows} exceeds "
+                      f"ids_per_step={ids}")
+    if em.get("oor_policy") not in ("clip", "error"):
+        errors.append(f"oor_policy {em.get('oor_policy')!r} not in "
+                      f"('clip', 'error')")
+    return errors
+
+
 # ---------------------------------------------------------------------------
 # bench result JSON (BENCH_*.json with serving stats)
 # ---------------------------------------------------------------------------
@@ -1740,6 +1779,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.io: {e}"
                for e in check_io_extra(
                    (doc.get("extra") or {}).get("io"))]
+    errors += [f"extra.embedding: {e}"
+               for e in check_embedding_extra(
+                   (doc.get("extra") or {}).get("embedding"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
