@@ -16,8 +16,11 @@ scrapeable:
   rewrites `prom_path`. Counters are monotonic across samples by the
   registry contract, which `tools/trace_check.py` validates.
 * :func:`start_http` — stdlib HTTP server exposing `/metrics`
-  (Prometheus), `/json` (latest sample) and `/memory` (full
-  memory_summary), for pull-based scraping during live runs.
+  (Prometheus), `/json` (latest sample), `/memory` (full
+  memory_summary) and `/events?n=N` (the tail of this process's open
+  ``mxtpu.events`` log plus which scopes are armed — the fleetscope
+  collector's per-process pull surface), for pull-based scraping
+  during live runs.
 
 The reference stack's counterpart is MXBoard/monitoring riding on
 mx.profiler counters; the pull/push split follows Prometheus practice.
@@ -62,6 +65,10 @@ def sample() -> dict:
         if _memory.memory_enabled() else None
     out = {
         "ts": time.time(),
+        # wall/monotonic pair, same discipline as mxtpu.events/2: a
+        # puller estimating clock offset from "ts" can detect an NTP
+        # step between two pulls by comparing the deltas
+        "mono": time.monotonic(),
         "counters": {k: v for k, (v, _) in snap.items()},
         "kinds": {k: kind for k, (_, kind) in snap.items()},
     }
@@ -220,10 +227,44 @@ atexit.register(_atexit_stop_sampler)
 # HTTP endpoint (pull-based scraping)
 # ---------------------------------------------------------------------------
 
+def _events_doc(query: str) -> dict:
+    """The ``/events`` body: this process's open ``mxtpu.events`` log
+    tail (bounded, ``?n=N`` capped at 256) plus which scopes are armed
+    — everything the fleetscope collector needs from one pull."""
+    n = 64
+    for part in query.split("&"):
+        if part.startswith("n="):
+            try:
+                n = max(1, min(256, int(part[2:])))
+            except ValueError:
+                pass
+    from ..healthmon import events as _hm_events
+    log = _hm_events.current_log()
+    path = log.path if log is not None else None
+    tail = []
+    if path is not None:
+        from ..fleetscope.collector import events_tail
+        tail = events_tail(path, n=n)
+    armed = {}
+    try:
+        import incubator_mxnet_tpu as _mx
+        for scope in ("healthmon", "servescope", "fleetscope",
+                      "devicescope", "memscope"):
+            mod = getattr(_mx, scope, None)
+            fn = getattr(mod, "enabled", None)
+            if callable(fn):
+                armed[scope] = bool(fn())
+    except Exception:  # noqa: BLE001 — armed flags are context, not truth
+        pass
+    return {"ts": time.time(), "mono": time.monotonic(),
+            "path": path, "tail": tail, "health": armed}
+
+
 def start_http(port: int = 0, host: str = "127.0.0.1"):
     """Serve /metrics (Prometheus), /json (latest sample), /memory
-    (memory_summary). Returns (server, bound_port); port 0 picks a free
-    one. The server runs in a daemon thread."""
+    (memory_summary), /events (events tail + armed scopes). Returns
+    (server, bound_port); port 0 picks a free one. The server runs in a
+    daemon thread."""
     global _HTTP
     stop_http()        # a forgotten prior server must not leak its port
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -239,6 +280,10 @@ def start_http(port: int = 0, host: str = "127.0.0.1"):
                     ctype = "application/json"
                 elif self.path.startswith("/memory"):
                     body = json.dumps(_memory.memory_summary()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/events"):
+                    _, _, query = self.path.partition("?")
+                    body = json.dumps(_events_doc(query)).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

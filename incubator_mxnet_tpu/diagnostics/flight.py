@@ -120,14 +120,19 @@ class FlightRecorder:
 
     # -- recording (hot path: no lock, deque append is atomic) ------------
     def append(self, kind: str, name: str, args=None):
-        ev = {"ts": time.time(), "kind": kind, "name": name}
+        # wall/monotonic pair (mxtpu.events/2 discipline): cross-process
+        # merges order within a process by mono so NTP steps can't
+        # reorder the ring
+        ev = {"ts": time.time(), "mono": time.monotonic(),
+              "kind": kind, "name": name}
         if args:
             ev["args"] = args
         self.events.append(ev)
 
     def op_event(self, name):
         """Minimal per-dispatch event (installed as ndarray._flight_hook)."""
-        self.events.append({"ts": time.time(), "kind": "op",
+        self.events.append({"ts": time.time(),
+                            "mono": time.monotonic(), "kind": "op",
                             "name": name or "op"})
 
     # -- dumping -----------------------------------------------------------

@@ -49,12 +49,15 @@ class Replica:
     load score read identically over the HTTP wire either way."""
 
     def __init__(self, name, server=None, proc=None, host=None,
-                 port=None):
+                 port=None, diag_port=None):
         self.name = str(name)
         self.server = server
         self.proc = proc               # worker subprocess (spawn mode)
         self._host = host
         self._port = port
+        self.diag_port = diag_port     # diagnostics.export HTTP port, if
+        #                                the worker started one (the
+        #                                fleetscope collector's pull target)
         self.cache_stats = None        # worker-reported warmup cache hits
         self.draining = False          # router-side exclusion (deploys)
         self.outstanding = 0           # router-held in-flight forwards
@@ -134,6 +137,18 @@ class Replica:
         return float(hf) if isinstance(hf, (int, float)) \
             and not isinstance(hf, bool) else None
 
+    def servescope_p99(self):
+        """This replica's current e2e p99 (ms) from the last deep
+        health's servescope brief — report-only pod context (the
+        ``mxdiag.py pod`` straggler flag compares these ACROSS
+        replicas; a slow replica still serves). None when servescope
+        isn't armed on the replica or no poll has landed."""
+        checks = (self.last_health or {}).get("checks") or {}
+        brief = checks.get("servescope_p99") or {}
+        p99 = brief.get("e2e_p99_ms")
+        return float(p99) if isinstance(p99, (int, float)) \
+            and not isinstance(p99, bool) else None
+
     def live_queue_depth(self) -> int:
         """The freshest queue depth available — the in-process batcher
         when we own the server object, else one probe over the wire
@@ -166,6 +181,8 @@ class Replica:
             "queue_depth": self.queue_depth(),
             "resharding_flagged": self.resharding_flagged(),
             "headroom": self.headroom(),
+            "p99_ms": self.servescope_p99(),
+            "diag_port": self.diag_port,
             "consecutive_failures": self.consecutive_failures,
             "in_process": self.server is not None,
             "pid": self.proc.pid if self.proc is not None else None,

@@ -48,6 +48,7 @@ import sys
 import threading
 import time
 
+from .. import fleetscope as _fs
 from .. import profiler as _prof
 from ..diagnostics import flight as _flight
 from ..healthmon import events as _events
@@ -163,7 +164,9 @@ class ReplicaSet:
             fields = dict(tok.split("=", 1) for tok in line.split()
                           if "=" in tok)
             rep = Replica(name, proc=proc, host=fields.get("host"),
-                          port=int(fields.get("port", 0)))
+                          port=int(fields.get("port", 0)),
+                          diag_port=(int(fields["diag_port"])
+                                     if "diag_port" in fields else None))
             rep.cache_stats = {
                 k: int(fields.get(f"cache_{k}", 0))
                 for k in ("hits", "misses", "stores")}
@@ -225,6 +228,7 @@ class ReplicaSet:
         old = rep.proc
         rep.proc = fresh.proc
         rep._host, rep._port = fresh._host, fresh._port
+        rep.diag_port = fresh.diag_port
         rep.cache_stats = fresh.cache_stats
         rep.last_health, rep.health_code = None, None
         rep.consecutive_failures = 0
@@ -324,13 +328,18 @@ class Router:
         with self._lock:
             rep.outstanding = max(0, rep.outstanding - 1)
 
-    def _forward(self, rep, body):
+    def _forward(self, rep, body, traceparent=None):
         """One forward on this thread's keep-alive connection to `rep`;
         a stale kept-alive socket gets ONE fresh-connection retry, any
-        other failure propagates to the caller's failover loop."""
+        other failure propagates to the caller's failover loop. The
+        optional ``traceparent`` is the router's OWN span context — the
+        replica's servescope span becomes its child."""
         conns = getattr(self._local, "conns", None)
         if conns is None:
             conns = self._local.conns = {}
+        headers = {"Content-Type": "application/json"}
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         for attempt in (0, 1):
             conn = conns.get(rep.name)
             if conn is None:
@@ -344,7 +353,7 @@ class Router:
                 conns[rep.name] = conn
             try:
                 conn.request("POST", "/predict", body=body,
-                             headers={"Content-Type": "application/json"})
+                             headers=headers)
                 resp = conn.getresponse()
                 return resp.status, resp.read()
             except Exception:
@@ -357,11 +366,25 @@ class Router:
                     raise
         raise RuntimeError("unreachable")
 
-    def handle_predict(self, body):
+    def handle_predict(self, body, traceparent=None):
         """Route one /predict body; returns ``(status, reply_dict)``.
         Tries up to ``forward_retries + 1`` distinct replicas before
         giving up — a replica that fails mid-forward is failed over,
-        not surfaced to the client."""
+        not surfaced to the client.
+
+        When fleetscope is armed the router is the ROOT hop: it accepts
+        the client's ``traceparent`` (or mints a fresh trace — a
+        malformed header is counted and re-minted, never guessed) and
+        forwards its own child span to the replica, so one request is
+        one trace across processes."""
+        fs = _fs._FS
+        rctx = None
+        if fs is not None:
+            # upstream view (the client's span, or a synthesized
+            # client-edge root when the header is absent/malformed);
+            # the router's own span is always its child
+            rctx = fs.accept(traceparent).child()
+        t_start = time.perf_counter()
         tried = set()
         for attempt in range(self.forward_retries + 1):
             rep = self._pick()
@@ -372,7 +395,9 @@ class Router:
             tried.add(rep.name)
             t0 = time.perf_counter()
             try:
-                status, raw = self._forward(rep, body)
+                status, raw = self._forward(
+                    rep, body,
+                    rctx.header() if rctx is not None else None)
             except Exception:  # noqa: BLE001 — transport failure: fail over
                 _c("fleet.routed_errors").increment()
                 rep.consecutive_failures += 1
@@ -381,9 +406,9 @@ class Router:
                 continue
             finally:
                 self._release(rep)
+            forward_ms = (time.perf_counter() - t0) * 1e3
             _c("fleet.routed").increment()
-            _prof.observe("fleet.forward_ms",
-                          (time.perf_counter() - t0) * 1e3, "fleet")
+            _prof.observe("fleet.forward_ms", forward_ms, "fleet")
             with self._lock:
                 self.dispatch_counts[rep.name] = \
                     self.dispatch_counts.get(rep.name, 0) + 1
@@ -396,10 +421,37 @@ class Router:
                        "message": "replica returned non-JSON",
                        "replica": rep.name}
                 status = 502
+            if rctx is not None:
+                if isinstance(doc, dict):
+                    doc.setdefault("trace_id", rctx.trace_id)
+                self._trace_event(rctx, rep.name, status, forward_ms,
+                                  (time.perf_counter() - t_start) * 1e3)
             return status, doc
         _c("fleet.no_replica_available").increment()
+        if rctx is not None:
+            # the trace still records the failed route: an unjoined
+            # router-side record is a datum the join rate must count
+            self._trace_event(rctx, None, 503, None,
+                              (time.perf_counter() - t_start) * 1e3)
         return 503, {"error": "NoReplicaAvailable",
                      "message": "no healthy admitting replica"}
+
+    @staticmethod
+    def _trace_event(rctx, replica, status, forward_ms, e2e_ms):
+        """The router side of the cross-process join: one
+        ``fleetscope.request`` record per routed request, carrying the
+        router span + the two router-clock walls the wire-gap math
+        needs (forward wall vs replica-reported e2e is a difference of
+        perf_counter durations — clock-skew free)."""
+        args = {"trace_id": rctx.trace_id, "span_id": rctx.span_id,
+                "parent_id": rctx.parent_id, "replica": replica,
+                "status": status, "e2e_ms": round(e2e_ms, 3)}
+        if forward_ms is not None:
+            args["forward_ms"] = round(forward_ms, 3)
+        if _flight._REC is not None:
+            _flight.record("fleetscope", "fleetscope.request", dict(args))
+        if _events._LOG is not None:
+            _events.emit("fleetscope", "fleetscope.request", args=args)
 
     # -- aggregate surfaces ----------------------------------------------
     def health(self):
@@ -531,7 +583,9 @@ class Router:
                         return
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length)
-                    code, doc = router.handle_predict(body)
+                    tp = (self.headers.get("traceparent")
+                          if _fs._FS is not None else None)
+                    code, doc = router.handle_predict(body, traceparent=tp)
                     self._reply(code, doc)
                 except Exception as e:  # noqa: BLE001
                     self._safe_500(e)

@@ -34,9 +34,20 @@ Spec keys: ``model`` (model-zoo name), ``classes``, ``model_kwargs``,
 ``input_shape`` (per-sample), ``dtype``, ``quantize``
 (``int8``/``bf16``/absent), ``batcher``, ``cache_dir`` (shared
 `CompileCache` directory), ``host``, ``server`` (ModelServer kwargs:
-``max_delay_ms`` / ``queue_limit`` / ``default_timeout_ms``), and
+``max_delay_ms`` / ``queue_limit`` / ``default_timeout_ms``),
 ``events`` (``{path, run_id, rank}`` — opens this worker's own
-``mxtpu.events/1`` log, mergeable with ``mxdiag.py merge``).
+``mxtpu.events/2`` log, mergeable with ``mxdiag.py merge``; a literal
+``{pid}`` in the path is replaced with the worker's PID so replicas
+sharing one spec dict never write over each other — the parent knows
+each child's PID and can find the file),
+``servescope`` (truthy — arm request-lifecycle spans in this worker;
+``True`` samples every request, a number is the servescope sample
+rate/stride), ``fleetscope`` (truthy — arm cross-process trace
+propagation so forwarded ``traceparent`` headers join this worker's
+servescope spans),
+and ``export`` (truthy — start a ``diagnostics.export`` HTTP server on
+a free port and report it as ``diag_port=P`` in the readiness line;
+the fleetscope collector's pull target).
 """
 from __future__ import annotations
 
@@ -93,8 +104,24 @@ def main(argv=None) -> int:
 
     ev = spec.get("events") or {}
     if ev.get("path"):
-        _events.open_log(ev["path"], run_id=ev.get("run_id", "fleet"),
+        # one spec dict is shared by every replica; {pid} keeps their
+        # events logs apart (the parent joins back via the child PID)
+        path = str(ev["path"]).replace("{pid}", str(os.getpid()))
+        _events.open_log(path, run_id=ev.get("run_id", "fleet"),
                          rank=int(ev.get("rank", 0)))
+    if spec.get("servescope"):
+        from .. import servescope as _servescope
+        sv = spec["servescope"]
+        _servescope.enable(sample=None if sv is True else sv)
+    if spec.get("fleetscope"):
+        from .. import fleetscope as _fleetscope
+        _fleetscope.enable()
+    diag_port = None
+    if spec.get("export"):
+        # the fleetscope collector's pull target: this worker's own
+        # counters/events over the diagnostics.export HTTP surface
+        from ..diagnostics import export as _export
+        _, diag_port = _export.start_http(port=0)
 
     model = build_model(spec)
     srv = ModelServer(model, host=spec.get("host") or "127.0.0.1",
@@ -107,11 +134,13 @@ def main(argv=None) -> int:
     def cache_count(name):
         return int(snap.get(f"fleet/fleet.compile_cache_{name}", 0))
 
-    # the ONE readiness line the parent handshake parses
+    # the ONE readiness line the parent handshake parses (diag_port only
+    # when the spec asked for an export server — absent means absent)
+    diag = f" diag_port={diag_port}" if diag_port is not None else ""
     print(f"{READY_TAG} ready host={host} port={port} pid={os.getpid()} "
           f"cache_hits={cache_count('hits')} "
           f"cache_misses={cache_count('misses')} "
-          f"cache_stores={cache_count('stores')}", flush=True)
+          f"cache_stores={cache_count('stores')}{diag}", flush=True)
 
     stop_evt = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -1,0 +1,309 @@
+"""Clock-aligned telemetry collection across fleet processes.
+
+The Router (serving) and rank 0 (training, over the elastic wire)
+cannot trust the other processes' wall clocks: merged timelines built
+from raw ``ts`` fields interleave by whichever NTP daemon spoke last.
+The collector pulls each process's counters snapshot, ``mxtpu.events``
+tail, and health flags over the existing ``diagnostics.export`` HTTP
+surface, and estimates the per-process clock offset from the
+request/response midpoint — the classic NTP estimate::
+
+    offset ≈ server_ts − (t_send + t_recv) / 2        (server − local)
+    |error| ≤ (t_recv − t_send) / 2  =  rtt / 2
+
+The bound is tight exactly when the two wire legs are symmetric; a
+fully asymmetric route (all the rtt on one leg) reaches the bound but
+never exceeds it, so a merged timeline is trustworthy to ± rtt/2 per
+process. Events additionally carry a ``mono`` companion stamp
+(``mxtpu.events/2``) so an NTP step *inside* one process cannot
+reorder that process's own records in the merge.
+
+Discipline (the house rules for every scope):
+
+* **never raise** — a dead, torn, or slow replica produces a counted
+  pull error (``fleetscope.pull_errors``) and a ``last_error`` string
+  in the ring, never an exception on the control plane;
+* **bounded** — per-process history is a ``deque(maxlen=ring)``;
+  events tails are capped at ``tail`` records per pull;
+* **off-path** — nothing here runs unless something constructed a
+  Collector; the serving/routing hot paths only ever check
+  ``fleetscope._FS``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import urllib.request
+
+from ..profiler.counters import counter as _counter
+from ..profiler.counters import observe as _observe
+from ..profiler.counters import set_gauge as _set_gauge
+
+__all__ = ["Collector", "estimate_offset", "events_tail",
+           "merge_process_events", "join_traces"]
+
+
+def estimate_offset(t_send: float, t_recv: float, server_ts: float):
+    """NTP-style offset of the remote clock relative to ours.
+
+    Returns ``(offset_s, bound_s)``: the midpoint estimate
+    ``server_ts - (t_send + t_recv)/2`` and its worst-case error bound
+    ``rtt/2`` (reached only by a fully asymmetric route). ``remote_wall
+    ≈ local_wall + offset``."""
+    rtt = max(0.0, t_recv - t_send)
+    return server_ts - (t_send + t_recv) / 2.0, rtt / 2.0
+
+
+def events_tail(path, n: int = 64) -> list:
+    """Last ``n`` parsed records of an ``mxtpu.events`` JSONL file.
+    Unparseable lines are dropped (the validator's job is elsewhere);
+    any IO error yields an empty tail — tails are telemetry, not
+    truth."""
+    try:
+        with open(path, "rb") as f:
+            # bounded read from the end: tails must not scale with the
+            # log (a long run's events file is unbounded)
+            try:
+                f.seek(-min(256 * 1024, _size(f)), 2)
+            except OSError:
+                pass
+            raw = f.read().decode("utf-8", "replace")
+    except OSError:
+        return []
+    out = []
+    for ln in raw.splitlines()[-int(n):]:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def _size(f) -> int:
+    cur = f.tell()
+    f.seek(0, 2)
+    size = f.tell()
+    f.seek(cur)
+    return size
+
+
+def merge_process_events(per_process, offsets=None) -> list:
+    """Merge per-process event lists into one clock-aligned timeline.
+
+    ``per_process``: {process_name: [event records]} — records are
+    ``mxtpu.events`` dicts (``ts`` wall seconds, optional ``mono``).
+    ``offsets``: {process_name: offset_s} as estimated by the
+    collector (``remote ≈ local + offset``); missing processes merge
+    uncorrected.
+
+    Two-level ordering, NTP-step safe: WITHIN a process, records order
+    by their ``mono`` companion (wall steps cannot reorder them), and
+    each record's corrected wall time is clamped non-decreasing in
+    that order; ACROSS processes, the corrected wall clocks interleave.
+    Returns new records with ``ts`` rewritten to the collector's clock
+    and the original preserved as ``ts_raw`` (+ ``src``)."""
+    offsets = offsets or {}
+    merged = []
+    for name, recs in per_process.items():
+        off = float(offsets.get(name, 0.0))
+        local = [dict(r) for r in recs if isinstance(r, dict)]
+        # mono is authoritative within the process when present
+        local.sort(key=lambda r: (r.get("mono")
+                                  if isinstance(r.get("mono"), (int, float))
+                                  else r.get("ts", 0.0)))
+        last = None
+        for r in local:
+            ts = r.get("ts")
+            corrected = (float(ts) - off) if isinstance(ts, (int, float)) \
+                else 0.0
+            if last is not None and corrected < last:
+                corrected = last       # an NTP step inside the process
+            last = corrected
+            r["ts_raw"] = ts
+            r["ts"] = corrected
+            r.setdefault("src", name)
+            merged.append(r)
+    merged.sort(key=lambda r: r["ts"])
+    return merged
+
+
+def join_traces(router_records, replica_records) -> dict:
+    """Join router-side ``fleetscope.request`` records with replica-side
+    ``serving.request`` records on ``trace_id``.
+
+    Returns {trace_id: {"router": rec|None, "replica": rec|None,
+    "replica_name": str|None}} over every trace either side saw. The
+    caller derives the join rate and the wire gap; unjoined traces stay
+    in the map — counted, never guessed away."""
+    traces = {}
+    for rec in router_records:
+        args = rec.get("args") or {}
+        tid = args.get("trace_id")
+        if isinstance(tid, str) and tid:
+            slot = traces.setdefault(tid, {"router": None, "replica": None,
+                                           "replica_name": None})
+            slot["router"] = rec
+            if isinstance(args.get("replica"), str):
+                slot["replica_name"] = args["replica"]
+    for rec in replica_records:
+        args = rec.get("args") or {}
+        tid = args.get("trace_id")
+        if isinstance(tid, str) and tid:
+            slot = traces.setdefault(tid, {"router": None, "replica": None,
+                                           "replica_name": None})
+            slot["replica"] = rec
+    return traces
+
+
+class Collector:
+    """Periodic puller of per-process telemetry over diagnostics.export.
+
+    ``targets``: list of {"name": str, "host": str, "port": int} rows
+    pointing at each process's export HTTP server (fleet workers print
+    ``diag_port`` in their READY line; see fleet/worker.py). Every poll
+    GETs ``/json`` (counters + the remote wall clock for the offset
+    estimate) and ``/events?n=tail`` (events tail + armed flags)."""
+
+    def __init__(self, targets, interval_s: float = 2.0, ring: int = 64,
+                 tail: int = 64, timeout_s: float = 3.0):
+        self.targets = [dict(t) for t in targets]
+        self.interval_s = float(interval_s)
+        self.tail = int(tail)
+        self.timeout_s = float(timeout_s)
+        self.rings = {t["name"]: collections.deque(maxlen=int(ring))
+                      for t in self.targets}
+        self.errors = {t["name"]: None for t in self.targets}
+        self._c_pulls = _counter("fleetscope.pulls", "fleetscope")
+        self._c_errors = _counter("fleetscope.pull_errors",
+                                    "fleetscope")
+        _set_gauge("fleetscope.processes", len(self.targets),
+                   "fleetscope")
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- one pull (never raises) -----------------------------------------
+    def _get_json(self, host, port, path):
+        t0 = time.time()
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}",
+                timeout=self.timeout_s) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+        t1 = time.time()
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: not a JSON object")
+        return doc, t0, t1
+
+    def poll_one(self, target) -> dict | None:
+        """Pull one process; append to its ring. Returns the entry, or
+        None on a counted failure (dead/torn/slow — the reason lands in
+        ``self.errors[name]``)."""
+        name = target["name"]
+        try:
+            doc, t0, t1 = self._get_json(target["host"], target["port"],
+                                         "/json")
+            server_ts = doc.get("ts")
+            if not isinstance(server_ts, (int, float)):
+                raise ValueError("/json carries no numeric 'ts'")
+            offset, bound = estimate_offset(t0, t1, float(server_ts))
+            entry = {
+                "name": name,
+                "t_mid": (t0 + t1) / 2.0,
+                "offset_s": offset,
+                "offset_bound_s": bound,
+                "rtt_s": max(0.0, t1 - t0),
+                "counters": doc.get("counters") or {},
+                "kinds": doc.get("kinds") or {},
+            }
+            try:
+                ev, _, _ = self._get_json(target["host"], target["port"],
+                                          f"/events?n={self.tail}")
+                entry["events_tail"] = ev.get("tail") or []
+                entry["health"] = ev.get("health") or {}
+            except Exception as e:   # noqa: BLE001 — tail is optional
+                entry["events_tail"] = []
+                entry["health"] = {"tail_error":
+                                   f"{type(e).__name__}: {e}"}
+            self.rings[name].append(entry)
+            self.errors[name] = None
+            self._c_pulls.increment()
+            _observe("fleetscope.pull_ms",
+                     entry["rtt_s"] * 1000.0, "fleetscope")
+            return entry
+        except Exception as e:   # noqa: BLE001 — NEVER raise: a dead
+            # replica is a datum, not a control-plane crash
+            self.errors[name] = f"{type(e).__name__}: {e}"
+            self._c_errors.increment()
+            return None
+
+    def poll_once(self) -> list:
+        """Pull every target once; returns the successful entries."""
+        return [e for t in self.targets
+                if (e := self.poll_one(t)) is not None]
+
+    # -- background loop --------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-fleetscope-collector")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:   # noqa: BLE001 — belt over braces
+                pass
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.timeout_s + 1.0)
+
+    # -- views -------------------------------------------------------------
+    def offsets(self) -> dict:
+        """{name: latest offset_s} over processes with >= 1 good pull."""
+        out = {}
+        for name, ring in self.rings.items():
+            if ring:
+                out[name] = ring[-1]["offset_s"]
+        return out
+
+    def snapshot(self) -> dict:
+        """One JSON-able view: per-process latest pull + history depth +
+        last error (the pod renderer's input)."""
+        procs = {}
+        for t in self.targets:
+            name = t["name"]
+            ring = self.rings[name]
+            last = ring[-1] if ring else None
+            procs[name] = {
+                "host": t["host"], "port": t["port"],
+                "pulls": len(ring),
+                "last_error": self.errors[name],
+                "offset_s": last["offset_s"] if last else None,
+                "offset_bound_s": (last["offset_bound_s"]
+                                   if last else None),
+                "rtt_s": last["rtt_s"] if last else None,
+                "events_tail_len": (len(last.get("events_tail") or [])
+                                    if last else 0),
+                "health": (last.get("health") if last else None),
+            }
+        return {"interval_s": self.interval_s, "processes": procs}
+
+    def merged_timeline(self) -> list:
+        """The clock-aligned merge of every process's latest events
+        tail (see :func:`merge_process_events`)."""
+        per_process = {}
+        for name, ring in self.rings.items():
+            if ring:
+                per_process[name] = ring[-1].get("events_tail") or []
+        return merge_process_events(per_process, self.offsets())

@@ -1,0 +1,95 @@
+"""W3C-traceparent-style trace context: mint, parse, propagate.
+
+One request through the fleet is ONE trace. The Router mints (or
+accepts from the client) a ``trace_id``, opens its own span, and
+forwards a child context on the ``traceparent`` header of the proxied
+``POST /predict``; the replica's ModelServer threads the arriving
+context into its servescope request span. Every hop keeps the 128-bit
+``trace_id`` and re-mints the 64-bit ``span_id``, so the offline join
+(`tools/mxdiag.py trace`, `tools/serve_load.py`'s ``extra.fleetscope``)
+can reassemble router admit → wire → replica queue_wait → coalesce →
+device_exec → respond from per-process event logs alone.
+
+Header format (the W3C trace-context wire form)::
+
+    traceparent: 00-<32 hex trace_id>-<16 hex span_id>-<2 hex flags>
+
+Parsing is strict and total: anything malformed returns ``None`` —
+callers COUNT the malformation (``fleetscope.ctx_malformed``) and mint
+a fresh trace, they never guess at a half-parsed id. All-zero ids are
+malformed per the W3C spec (they mean "no trace")."""
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["TraceContext", "mint", "parse", "mint_span_id",
+           "TRACEPARENT_RE"]
+
+# strict wire shape: version 00 only (the only version we emit; an
+# unknown version is treated as malformed — counted, re-minted)
+TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+_ZERO_TRACE = "0" * 32
+_ZERO_SPAN = "0" * 16
+
+
+def mint_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """One hop's view of a trace: the shared ``trace_id``, this hop's
+    ``span_id``, and the upstream hop's ``parent_id`` (None at the
+    root)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id, span_id, parent_id=None, sampled=True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = bool(sampled)
+
+    def child(self) -> "TraceContext":
+        """A downstream hop: same trace, fresh span, this span as
+        parent."""
+        return TraceContext(self.trace_id, mint_span_id(),
+                            parent_id=self.span_id, sampled=self.sampled)
+
+    def header(self) -> str:
+        return (f"00-{self.trace_id}-{self.span_id}-"
+                f"{'01' if self.sampled else '00'}")
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, parent_id={self.parent_id!r})")
+
+
+def mint(sampled: bool = True) -> TraceContext:
+    """A fresh root context (new 128-bit trace, new 64-bit span)."""
+    return TraceContext(os.urandom(16).hex(), mint_span_id(),
+                        parent_id=None, sampled=sampled)
+
+
+def parse(header) -> TraceContext | None:
+    """Strictly parse a ``traceparent`` header value.
+
+    Returns None for anything that is not a well-formed, non-zero,
+    version-00 traceparent — the caller counts and re-mints, never
+    guesses. The parsed context's span becomes the *parent* view: the
+    accepting hop should call :meth:`TraceContext.child` (or re-mint
+    its own span) before emitting."""
+    if not isinstance(header, str):
+        return None
+    m = TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id, flags = m.groups()
+    if trace_id == _ZERO_TRACE or span_id == _ZERO_SPAN:
+        return None
+    # flags: only the sampled bit is defined; anything else is opaque
+    sampled = bool(int(flags, 16) & 0x01)
+    return TraceContext(trace_id, span_id, parent_id=None,
+                        sampled=sampled)

@@ -16,8 +16,9 @@ just slow". Three pieces (see docs/observability.md):
   (+ opt-in every-N-steps gradient global-norm), EWMA step-time
   regression detector, and a stall thread that triggers a
   flight-recorder dump with per-rank last-known state;
-* **structured event log** (:mod:`.events`) — ``mxtpu.events/1`` JSONL
-  with run_id/rank/step correlation ids, threaded through Trainer step
+* **structured event log** (:mod:`.events`) — ``mxtpu.events/2`` JSONL
+  with run_id/rank/step correlation ids (+ a wall/monotonic timestamp
+  pair for NTP-step-safe cross-process merges), threaded through Trainer step
   phases, kvstore collectives, serving batches, and every watchdog
   alert; merge per-rank files with ``tools/mxdiag.py merge``.
 

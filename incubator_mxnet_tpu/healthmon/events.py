@@ -1,4 +1,4 @@
-"""Structured event log — the ``mxtpu.events/1`` JSONL stream.
+"""Structured event log — the ``mxtpu.events/2`` JSONL stream.
 
 Flight dumps answer "what just happened in THIS process"; the event log
 is the cross-rank correlation surface: every record carries the same
@@ -10,9 +10,9 @@ where "one request" is "one step on every rank".
 
 Records are newline-JSON, one self-describing object per line::
 
-    {"schema": "mxtpu.events/1", "ts": <epoch s>, "run_id": "...",
-     "rank": 0, "step": 12, "kind": "trainer", "name": "step",
-     "args": {...}}
+    {"schema": "mxtpu.events/2", "ts": <epoch s>, "mono": <monotonic s>,
+     "run_id": "...", "rank": 0, "step": 12, "kind": "trainer",
+     "name": "step", "args": {...}}
 
 ``kind`` groups the emitting subsystem (``trainer``, ``collective``,
 ``serving``, ``alert``, ``healthmon``, ``lifecycle``); ``step`` is null
@@ -20,6 +20,14 @@ for records outside the training loop (serving batches, watchdog fires
 before the first step). Timestamps are monotone WITHIN a file (enforced
 under the writer lock) so `tools/trace_check.py` can validate ordering,
 and the merge tool's sort is stable across ranks.
+
+Schema history: ``/2`` added the ``mono`` companion stamp
+(``time.monotonic()``, same process-local clock fleetscope's collector
+aligns) so a cross-process merge survives an NTP step — the wall clock
+can jump mid-run, the monotonic clock cannot, and a merged pod
+timeline orders each process's records by ``mono`` before
+interleaving. ``/1`` records (wall-only) still validate: readers key
+on the ``mxtpu.events/`` prefix and treat ``mono`` as optional.
 
 Hot-path discipline mirrors diagnostics.flight: one module global
 (``_LOG``) is THE fast-path predicate — subsystems guard with
@@ -38,7 +46,7 @@ import time
 __all__ = ["SCHEMA", "EventLog", "open_log", "close_log", "emit",
            "log_enabled", "current_log"]
 
-SCHEMA = "mxtpu.events/1"
+SCHEMA = "mxtpu.events/2"
 
 # module global: None = log off (THE fast-path predicate)
 _LOG = None
@@ -77,8 +85,11 @@ class EventLog:
             if ts < self._last_ts:
                 ts = self._last_ts
             self._last_ts = ts
-            rec = {"schema": SCHEMA, "ts": ts, "run_id": self.run_id,
-                   "rank": self.rank,
+            # monotonic companion (schema /2): the wall clock can step
+            # under NTP mid-run; cross-process merges order each
+            # process's records by this stamp before interleaving
+            rec = {"schema": SCHEMA, "ts": ts, "mono": time.monotonic(),
+                   "run_id": self.run_id, "rank": self.rank,
                    "step": (int(step) if step is not None else None),
                    "kind": kind, "name": name}
             if args:

@@ -234,6 +234,20 @@ FAMILY_TABLES = {
         "embedding/embedding.rows_touched_per_step": "gauge",
         "embedding/embedding.dedup_rate": "gauge",
     },
+    # docs/fleetscope.md — cross-process trace context + clock-aligned
+    # telemetry collection (PR 20)
+    "fleetscope": {
+        "fleetscope/fleetscope.ctx_minted": "counter",
+        "fleetscope/fleetscope.ctx_accepted": "counter",
+        "fleetscope/fleetscope.ctx_malformed": "counter",
+        "fleetscope/fleetscope.ctx_propagated": "counter",
+        "fleetscope/fleetscope.pulls": "counter",
+        "fleetscope/fleetscope.pull_errors": "counter",
+        "fleetscope/fleetscope.telem_reports": "counter",
+        "fleetscope/fleetscope.telem_errors": "counter",
+        "fleetscope/fleetscope.processes": "gauge",
+        "fleetscope/fleetscope.pull_ms": "histogram",
+    },
     # docs/mxlint.md — static analyzer + strict-mode jit auditor (PR 14)
     "mxlint": {
         "mxlint/mxlint.strict": "gauge",

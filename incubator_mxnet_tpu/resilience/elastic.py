@@ -37,6 +37,15 @@ ps-lite scheduler was; rank 0's own calls short-circuit in-process.
 Telemetry: ``resilience.rank_departures`` / ``resilience.rank_joins``
 counters on every member that observes the change, plus
 ``resilience.rank_departed`` / ``resilience.rank_joined`` events.
+
+Fleetscope rides this wire for TRAINING runs (serving uses the
+collector's HTTP pull instead): members push bounded telemetry
+snapshots with :meth:`ElasticGroup.report_telemetry` — the coordinator
+cannot initiate a connection to a member on this wire, so collection is
+member-push — and each reply carries the coordinator's wall clock, from
+which the member estimates its clock offset (NTP midpoint, ± rtt/2)
+and includes it in its NEXT report. Rank 0 keeps per-rank bounded
+rings; :meth:`pod_telemetry` returns the merged view.
 """
 from __future__ import annotations
 
@@ -99,6 +108,10 @@ class ElasticGroup:
                                       "resilience")
         self._c_joins = _counter("resilience.rank_joins", "resilience")
         self._closed = False
+        # fleetscope clock alignment: offset of the COORDINATOR's wall
+        # clock relative to ours, refreshed by every telemetry report
+        self._telem_offset = None
+        self._telem_bound = None
         if self.rank == 0:
             self._listener = socket.socket(socket.AF_INET,
                                            socket.SOCK_STREAM)
@@ -236,6 +249,43 @@ class ElasticGroup:
     def members(self):
         return self._call("info")["members"]
 
+    # -- fleetscope telemetry (member-push over the membership wire) ------
+    def report_telemetry(self, counters=None, events_tail=None,
+                         health=None):
+        """Push one bounded telemetry snapshot to the coordinator and
+        refresh this rank's clock-offset estimate from the reply's
+        coordinator wall clock (NTP midpoint, error ≤ rtt/2). The
+        offset rides along on the NEXT report so rank 0's merged view
+        is clock-aligned without a second protocol. Never raises: a
+        failed push is a counted ``fleetscope.telem_errors`` datum.
+        Returns ``{"offset_s", "offset_bound_s"}`` or None."""
+        from ..fleetscope.collector import estimate_offset
+        payload = {"ts": time.time(), "mono": time.monotonic(),
+                   "counters": counters, "events_tail": events_tail,
+                   "health": health,
+                   "offset_s": self._telem_offset,
+                   "offset_bound_s": self._telem_bound}
+        t_send = time.time()
+        try:
+            resp = self._call("telem", self.rank, payload)
+        except Exception:   # noqa: BLE001 — telemetry never breaks a run
+            _counter("fleetscope.telem_errors", "fleetscope").increment()
+            return None
+        t_recv = time.time()
+        co_ts = resp.get("coordinator_ts")
+        if isinstance(co_ts, (int, float)):
+            self._telem_offset, self._telem_bound = estimate_offset(
+                t_send, t_recv, float(co_ts))
+        _counter("fleetscope.telem_reports", "fleetscope").increment()
+        return {"offset_s": self._telem_offset,
+                "offset_bound_s": self._telem_bound}
+
+    def pod_telemetry(self):
+        """The coordinator's per-rank telemetry rings: {rank: [reports,
+        oldest first]} plus the per-rank clock offsets it last saw —
+        the ``mxdiag.py pod`` input for training runs."""
+        return self._call("telem_snap")
+
     def leave(self):
         """Graceful drain: this rank is removed without waiting out a
         round deadline, and survivors re-form WITHOUT rolling back (a
@@ -331,6 +381,8 @@ class _Coordinator:
         self._last_good = None   # (step, path)
         self._max_step = 0
         self._started = False
+        # fleetscope: bounded per-rank telemetry rings (member-push)
+        self._telem = {}         # rank -> deque of reports
 
     def handle(self, op, args):
         if op == "join":
@@ -351,7 +403,38 @@ class _Coordinator:
                         "pending": sorted(self._pending),
                         "last_good": self._last_good,
                         "max_step": self._max_step}
+        if op == "telem":
+            rank, payload = args
+            return self._telem_push(int(rank), payload)
+        if op == "telem_snap":
+            return self._telem_snapshot()
         raise ValueError(f"unknown elastic op {op!r}")
+
+    def _telem_push(self, rank, payload):
+        """Store one member telemetry report (bounded ring) and reply
+        with the coordinator's wall clock — the member's offset
+        estimate needs nothing more than this round trip."""
+        rec = dict(payload) if isinstance(payload, dict) else {}
+        rec["rank"] = rank
+        rec["received_ts"] = time.time()
+        with self._lock:
+            import collections
+            ring = self._telem.get(rank)
+            if ring is None:
+                ring = self._telem[rank] = collections.deque(maxlen=16)
+            ring.append(rec)
+        return {"coordinator_ts": time.time(), "generation": self._gen}
+
+    def _telem_snapshot(self):
+        with self._lock:
+            reports = {r: list(ring) for r, ring in self._telem.items()}
+        offsets = {}
+        for r, ring in reports.items():
+            if ring:
+                off = ring[-1].get("offset_s")
+                if isinstance(off, (int, float)):
+                    offsets[r] = off
+        return {"reports": reports, "offsets": offsets}
 
     def _admit(self, rank, active_from):
         """Shared admission bookkeeping. Dropping any stale

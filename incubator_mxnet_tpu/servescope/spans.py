@@ -76,7 +76,7 @@ class RequestSpan:
     __slots__ = ("request_id", "t_admit", "gather_start", "t_dispatched",
                  "t_device_done", "t_respond", "bucket", "real",
                  "batch_id", "batch_index", "timings", "status",
-                 "slotted")
+                 "slotted", "trace_id", "span_id", "parent_id")
 
     def __init__(self, request_id: int, t_admit: float):
         self.request_id = request_id
@@ -92,6 +92,13 @@ class RequestSpan:
         self.timings = None
         self.status = "admitted"
         self.slotted = False
+        # fleetscope cross-process trace context (None unless a
+        # traceparent reached the server while fleetscope was armed):
+        # trace_id joins this span to the router's fleetscope.request
+        # record, parent_id is the upstream hop's span
+        self.trace_id = None
+        self.span_id = None
+        self.parent_id = None
 
 
 def components_of(span: RequestSpan) -> dict:
@@ -210,6 +217,13 @@ def _emit(span, comp):
             "bucket": span.bucket, "batch_id": span.batch_id}
     if span.slotted:
         args["slotted"] = True
+    if span.trace_id is not None:
+        # the cross-process join key: mxdiag.py trace / serve_load's
+        # extra.fleetscope match this against the router's record
+        args["trace_id"] = span.trace_id
+        args["span_id"] = span.span_id
+        if span.parent_id is not None:
+            args["parent_id"] = span.parent_id
     if comp is not None:
         args["e2e_ms"] = round(comp["e2e_ms"], 3)
         for key in COMPONENTS:

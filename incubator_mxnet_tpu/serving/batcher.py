@@ -40,6 +40,7 @@ import time
 
 import numpy as np
 
+from .. import fleetscope as _fs
 from .. import profiler as _prof
 from .. import servescope as _ss
 from ..diagnostics import flight as _flight
@@ -59,7 +60,7 @@ class Request:
     error) and sets the event; the submitting thread blocks in `wait`."""
 
     __slots__ = ("x", "enqueued_at", "deadline", "batch_size",
-                 "batch_id", "batch_index", "span",
+                 "batch_id", "batch_index", "span", "trace_id",
                  "_event", "_result", "_error")
 
     def __init__(self, x, timeout_ms):
@@ -71,6 +72,7 @@ class Request:
         self.batch_id = None            # dispatch sequence number
         self.batch_index = None         # our row within that batch
         self.span = None                # servescope lifecycle span (sampled)
+        self.trace_id = None            # fleetscope context (reply echo)
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -178,10 +180,16 @@ class DynamicBatcher:
         return len(self._q)        # len(deque) is GIL-atomic; no lock
 
     # -- admission --------------------------------------------------------
-    def submit(self, x, timeout_ms=None) -> Request:
+    def submit(self, x, timeout_ms=None, traceparent=None) -> Request:
         """Enqueue one SINGLE-SAMPLE request (shape = model.input_shape,
         or (1,) + input_shape). Raises instead of queueing when invalid,
-        closed, or over capacity."""
+        closed, or over capacity.
+
+        ``traceparent`` is an optional W3C trace-context header from the
+        upstream hop (router or client); when fleetscope is armed the
+        request's servescope span joins that trace (same trace_id, fresh
+        span_id, parent = the upstream span). A replica never mints a
+        root here — an absent header just means an untraced request."""
         x = np.asarray(x)
         if x.ndim == len(self.model.input_shape) + 1 and x.shape[0] == 1:
             x = x[0]
@@ -198,6 +206,16 @@ class DynamicBatcher:
         if ss is not None:
             # sampled lifecycle span: admitted at the enqueue timestamp
             req.span = _ss.spans.begin(req.enqueued_at, ss.sample_every)
+        fs = _fs._FS    # same snapshot discipline as servescope above
+        if fs is not None and traceparent is not None:
+            ctx = fs.accept(traceparent, mint_on_missing=False)
+            if ctx is not None:
+                req.trace_id = ctx.trace_id
+                fs.c_propagated.increment()
+                if req.span is not None:
+                    req.span.trace_id = ctx.trace_id
+                    req.span.parent_id = ctx.span_id
+                    req.span.span_id = _fs.context.mint_span_id()
         with self._cond:
             if self._closed:
                 _c("serving.rejected_closed").increment()
@@ -334,14 +352,20 @@ class DynamicBatcher:
         _c("serving.batched_requests").increment(n)
         _prof.observe("serving.batch_exec_ms", exec_ms, "serving")
         _prof.observe("serving.batch_size", float(n), "serving")
+        bargs = {"n": n, "bucket": bucket, "batch_id": bid,
+                 "exec_ms": round(exec_ms, 3)}
+        if _fs._FS is not None:
+            # member trace ids: which cross-process traces this coalesced
+            # dispatch served (bounded — a batch never exceeds the largest
+            # compiled bucket, but cap anyway so the record stays small)
+            traces = [r.trace_id for r in live
+                      if r.trace_id is not None][:64]
+            if traces:
+                bargs["traces"] = traces
         if _flight._REC is not None:
-            _flight.record("serving", "serving.batch",
-                           {"n": n, "bucket": bucket, "batch_id": bid,
-                            "exec_ms": round(exec_ms, 3)})
+            _flight.record("serving", "serving.batch", dict(bargs))
         if _events._LOG is not None:
-            _events.emit("serving", "serving.batch",
-                         args={"n": n, "bucket": bucket, "batch_id": bid,
-                               "exec_ms": round(exec_ms, 3)})
+            _events.emit("serving", "serving.batch", args=bargs)
         self.last_response_ts = time.time()
         done = time.perf_counter()
         # a deadline that expired DURING batch execution is a rejection,

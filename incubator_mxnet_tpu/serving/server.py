@@ -40,6 +40,7 @@ import time
 
 import numpy as np
 
+from .. import fleetscope as _fs
 from .. import healthmon as _healthmon
 from .. import profiler as _prof
 from .. import resilience as _resilience
@@ -161,6 +162,11 @@ class ModelServer:
                     except (ValueError, TypeError) as e:
                         raise InvalidInputError(str(e)) from e
                     t0 = time.perf_counter()
+                    # fleetscope: the upstream hop's W3C trace context
+                    # rides the standard header; read only while armed
+                    # (off = this one predicate on the request path)
+                    tp = (self.headers.get("traceparent")
+                          if _fs._FS is not None else None)
                     # swap-safe admission: a hot swap may close the
                     # batcher we read between the read and the submit —
                     # when a NEW batcher has already been published,
@@ -171,7 +177,8 @@ class ModelServer:
                         b = server.batcher
                         try:
                             req = b.submit(
-                                x, timeout_ms=doc.get("timeout_ms"))
+                                x, timeout_ms=doc.get("timeout_ms"),
+                                traceparent=tp)
                             break
                         except ServerClosedError:
                             if server.batcher is b:
@@ -184,14 +191,17 @@ class ModelServer:
                         (doc.get("timeout_ms")
                          or b.default_timeout_ms) / 1e3 + 30.0)
                     out = outs[0] if len(outs) == 1 else outs
-                    self._reply(200, {
+                    reply = {
                         "output": (out.tolist() if isinstance(out, np.ndarray)
                                    else [o.tolist() for o in out]),
                         "batch_size": req.batch_size,
                         "batch_id": req.batch_id,
                         "batch_index": req.batch_index,
                         "latency_ms": round(
-                            (time.perf_counter() - t0) * 1e3, 3)})
+                            (time.perf_counter() - t0) * 1e3, 3)}
+                    if req.trace_id is not None:
+                        reply["trace_id"] = req.trace_id
+                    self._reply(200, reply)
                 except ServingError as e:
                     self._reply(e.code, e.to_json())
                 except Exception as e:  # noqa: BLE001

@@ -1,0 +1,488 @@
+"""mxtpu.fleetscope — cross-process distributed tracing.
+
+Covers the fleetscope acceptance surface: strict W3C-traceparent
+parsing (malformed headers counted and re-minted, never guessed),
+the accept() root-vs-mid-trace minting matrix, hand-computed NTP
+midpoint offset estimation with its rtt/2 error bound, the
+clock-aligned merge (injected skew, mono authority under an NTP step
+inside one process), the collector's never-raise discipline against a
+dead target, the off-path zero-overhead predicate, the
+check_fleetscope_extra good/bad schema matrix, serve_load's
+build_fleetscope_extra assembly, and an in-process router → replica
+propagation end-to-end (one request = ONE trace across a real HTTP
+hop, wire gap a skew-free duration difference).
+
+Everything here is in-process and CPU-only; the spawned-worker
+multi-process path is exercised end to end by tools/fleetscope_smoke.sh.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fleetscope, gluon, nd, servescope
+from incubator_mxnet_tpu.fleet import ReplicaSet, Router
+from incubator_mxnet_tpu.fleetscope import (Collector, TraceContext,
+                                            estimate_offset, join_traces,
+                                            merge_process_events, mint,
+                                            parse)
+from incubator_mxnet_tpu.healthmon import events as hm_events
+from incubator_mxnet_tpu.serving import FrozenModel, ModelServer
+
+
+def _mlp(in_units=6, out=3, seed=0):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=in_units, activation="relu"),
+            gluon.nn.Dense(out, in_units=16))
+    net.initialize(init=mx.init.Xavier())
+    rng = np.random.RandomState(seed)
+    for p in net.collect_params().values():
+        p.set_data(nd.array(rng.randn(*p.shape).astype(np.float32) * 0.1))
+    return net
+
+
+def _factory(compile_cache=None):
+    return FrozenModel(_mlp(), input_shape=(6,), batch_buckets=(1, 2, 4),
+                       compile_cache=compile_cache)
+
+
+@pytest.fixture
+def frozen():
+    return _factory()
+
+
+@pytest.fixture
+def armed():
+    """Fleetscope + servescope armed (and always disarmed after)."""
+    servescope.enable()
+    fs = fleetscope.enable()
+    yield fs
+    fleetscope.disable()
+    servescope.disable()
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, f"tools/{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _post(url, doc, headers=None, timeout=30):
+    body = json.dumps(doc).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=body, headers=h)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# context: mint / parse / child
+# ---------------------------------------------------------------------------
+
+def test_mint_parse_roundtrip():
+    ctx = mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    back = parse(ctx.header())
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+
+
+def test_parse_is_strict():
+    good = f"00-{'a' * 32}-{'b' * 16}-01"
+    assert parse(good) is not None
+    # whitespace + case are normalized, per the lenient-read half of
+    # the robustness principle
+    assert parse(f"  {good.upper()}  ") is not None
+    for bad in (None, 42, "", "garbage",
+                f"01-{'a' * 32}-{'b' * 16}-01",      # unknown version
+                f"00-{'a' * 31}-{'b' * 16}-01",      # short trace
+                f"00-{'a' * 32}-{'b' * 15}-01",      # short span
+                f"00-{'g' * 32}-{'b' * 16}-01",      # non-hex
+                f"00-{'0' * 32}-{'b' * 16}-01",      # zero trace
+                f"00-{'a' * 32}-{'0' * 16}-01"):     # zero span
+        assert parse(bad) is None, bad
+
+
+def test_parse_sampled_flag():
+    assert parse(f"00-{'a' * 32}-{'b' * 16}-00").sampled is False
+    assert parse(f"00-{'a' * 32}-{'b' * 16}-01").sampled is True
+
+
+def test_child_keeps_trace_fresh_span():
+    root = mint()
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.span_id != root.span_id
+    assert kid.parent_id == root.span_id
+
+
+def test_accept_matrix(armed):
+    fs = armed
+    base = fs.c_accepted.value, fs.c_malformed.value, fs.c_minted.value
+    # well-formed: accepted, counted
+    ctx = fs.accept(mint().header())
+    assert ctx is not None and fs.c_accepted.value == base[0] + 1
+    # malformed at the root hop: counted AND re-minted (never guessed)
+    ctx = fs.accept("not-a-traceparent")
+    assert ctx is not None
+    assert fs.c_malformed.value == base[1] + 1
+    assert fs.c_minted.value == base[2] + 1
+    # malformed mid-trace: counted, NOT minted (no invented roots)
+    assert fs.accept("still-bad", mint_on_missing=False) is None
+    assert fs.c_malformed.value == base[1] + 2
+    assert fs.c_minted.value == base[2] + 1
+    # absent mid-trace: simply untraced
+    assert fs.accept(None, mint_on_missing=False) is None
+
+
+# ---------------------------------------------------------------------------
+# collector: offset math, merge, never-raise
+# ---------------------------------------------------------------------------
+
+def test_estimate_offset_hand_computed():
+    # sent at 10.0, received at 10.4, server stamped 110.2:
+    # midpoint 10.2 -> offset exactly 100.0, bound rtt/2 = 0.2
+    off, bound = estimate_offset(10.0, 10.4, 110.2)
+    assert off == pytest.approx(100.0)
+    assert bound == pytest.approx(0.2)
+
+
+def test_estimate_offset_asymmetry_stays_in_bound():
+    # true offset 50.0; route fully asymmetric (all 0.4s rtt on the
+    # request leg): server stamps at local 10.4 -> 60.4. The midpoint
+    # estimate is off by 0.2 — exactly the advertised rtt/2 bound,
+    # never past it.
+    off, bound = estimate_offset(10.0, 10.4, 60.4)
+    assert abs(off - 50.0) <= bound + 1e-12
+    # degenerate clock weirdness: rtt clamps at 0, bound 0
+    assert estimate_offset(5.0, 4.0, 10.0)[1] == 0.0
+
+
+def test_merge_aligns_skewed_clocks():
+    # process b's wall clock runs 100 s AHEAD; uncorrected, its records
+    # sort after a's even though they happened first
+    a = [{"ts": 10.0, "mono": 1.0, "name": "a0"},
+         {"ts": 12.0, "mono": 3.0, "name": "a1"}]
+    b = [{"ts": 109.0, "mono": 1.0, "name": "b0"},
+         {"ts": 111.0, "mono": 3.0, "name": "b1"}]
+    merged = merge_process_events({"a": a, "b": b}, offsets={"b": 100.0})
+    assert [r["name"] for r in merged] == ["b0", "a0", "b1", "a1"]
+    b0 = next(r for r in merged if r["name"] == "b0")
+    assert b0["ts"] == pytest.approx(9.0)
+    assert b0["ts_raw"] == pytest.approx(109.0)   # original preserved
+    assert b0["src"] == "b"
+
+
+def test_merge_mono_beats_ntp_step():
+    # an NTP step INSIDE one process makes wall time jump backwards
+    # mid-stream; mono is authoritative within the process, and the
+    # corrected ts clamps non-decreasing so the merge cannot reorder
+    recs = [{"ts": 100.0, "mono": 1.0, "name": "e0"},
+            {"ts": 90.0, "mono": 2.0, "name": "e1"},    # step: -10 s
+            {"ts": 91.0, "mono": 3.0, "name": "e2"}]
+    merged = merge_process_events({"p": recs})
+    assert [r["name"] for r in merged] == ["e0", "e1", "e2"]
+    ts = [r["ts"] for r in merged]
+    assert ts == sorted(ts)
+
+
+def test_events_tail_tolerates_everything(tmp_path):
+    assert fleetscope.events_tail("/nonexistent/nope.jsonl") == []
+    p = tmp_path / "ev.jsonl"
+    p.write_text('{"ts": 1, "name": "ok"}\nnot json\n'
+                 '{"ts": 2, "name": "ok2"}\n')
+    tail = fleetscope.events_tail(str(p), n=10)
+    assert [r["name"] for r in tail] == ["ok", "ok2"]
+    assert len(fleetscope.events_tail(str(p), n=1)) == 1
+
+
+def test_join_traces_counts_unjoined():
+    rtr = [{"name": "fleetscope.request",
+            "args": {"trace_id": "t1", "replica": "r0", "status": 200}},
+           {"name": "fleetscope.request",
+            "args": {"trace_id": "t2", "replica": "r1", "status": 200}}]
+    rep = [{"name": "serving.request", "args": {"trace_id": "t1"}}]
+    joined = join_traces(rtr, rep)
+    assert set(joined) == {"t1", "t2"}
+    assert joined["t1"]["replica"] is not None
+    assert joined["t1"]["replica_name"] == "r0"
+    assert joined["t2"]["replica"] is None   # unjoined stays, counted
+
+
+def test_collector_never_raises_on_dead_target():
+    # a port with no listener: the pull must come back as a counted
+    # error entry, never an exception on the control plane
+    coll = Collector([{"name": "dead", "host": "127.0.0.1", "port": 9}],
+                     timeout_s=0.5)
+    before = coll._c_errors.value
+    assert coll.poll_one(coll.targets[0]) is None
+    assert coll.errors["dead"] is not None
+    assert coll._c_errors.value == before + 1
+    assert coll.poll_once() == []
+    assert coll.snapshot()["processes"]["dead"]["pulls"] == 0
+
+
+# ---------------------------------------------------------------------------
+# off-path discipline
+# ---------------------------------------------------------------------------
+
+def test_off_path_is_one_predicate(frozen):
+    fleetscope.disable()
+    assert fleetscope._FS is None and not fleetscope.enabled()
+    srv = ModelServer(frozen, max_delay_ms=1.0)
+    host, port = srv.start()
+    try:
+        tp = mint()
+        code, doc = _post(f"http://{host}:{port}/predict",
+                          {"data": [0.0] * 6},
+                          headers={"traceparent": tp.header()})
+        assert code == 200
+        # off: the header is never parsed, nothing echoes back
+        assert "trace_id" not in doc
+    finally:
+        srv.stop()
+
+
+def test_server_echoes_trace_id(frozen, armed):
+    srv = ModelServer(frozen, max_delay_ms=1.0)
+    host, port = srv.start()
+    try:
+        tp = mint()
+        code, doc = _post(f"http://{host}:{port}/predict",
+                          {"data": [0.0] * 6},
+                          headers={"traceparent": tp.header()})
+        assert code == 200
+        assert doc.get("trace_id") == tp.trace_id
+        # malformed header: counted, and NOT echoed (a mid-trace hop
+        # never invents a trace)
+        bad_before = armed.c_malformed.value
+        code, doc = _post(f"http://{host}:{port}/predict",
+                          {"data": [0.0] * 6},
+                          headers={"traceparent": "bogus"})
+        assert code == 200 and "trace_id" not in doc
+        assert armed.c_malformed.value == bad_before + 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: router -> replica over a real HTTP hop, one trace
+# ---------------------------------------------------------------------------
+
+def test_fleet_propagation_e2e(frozen, armed, tmp_path):
+    ev_path = tmp_path / "events.jsonl"
+    hm_events.open_log(str(ev_path), run_id="fs-e2e", rank=0)
+    rset = ReplicaSet(_factory, n=2,
+                      server_kwargs={"max_delay_ms": 1.0})
+    rset.start()
+    router = Router(rset)
+    host, port = router.start()
+    sent = {}
+    try:
+        for i in range(6):
+            tp = mint()
+            code, doc = _post(f"http://{host}:{port}/predict",
+                              {"data": [float(i)] * 6},
+                              headers={"traceparent": tp.header()})
+            assert code == 200
+            # the router echoes the CLIENT's trace id back
+            assert doc.get("trace_id") == tp.trace_id
+            sent[tp.trace_id] = doc.get("replica")
+    finally:
+        router.stop()
+        rset.stop()
+        hm_events.close_log()
+
+    recs = [json.loads(ln) for ln in ev_path.read_text().splitlines()]
+    assert all(str(r["schema"]).startswith("mxtpu.events/") for r in recs)
+    rtr = [r for r in recs if r["name"] == "fleetscope.request"]
+    rep = [r for r in recs if r["name"] == "serving.request"
+           and (r.get("args") or {}).get("trace_id")]
+    joined = join_traces(rtr, rep)
+    for tid in sent:
+        slot = joined[tid]
+        assert slot["router"] is not None and slot["replica"] is not None
+        ra, pa = slot["router"]["args"], slot["replica"]["args"]
+        # one trace, parent-linked across the hop
+        assert pa["parent_id"] == ra["span_id"]
+        assert slot["replica_name"] == sent[tid]
+        # the wire gap is a difference of DURATIONS: router-observed
+        # forward always covers the replica-observed e2e
+        assert ra["forward_ms"] >= pa["e2e_ms"] - 0.5
+    # batch records carry their member traces for the coalesce join
+    batches = [r for r in recs if r["name"] == "serving.batch"]
+    batched = {t for r in batches
+               for t in (r["args"].get("traces") or [])}
+    assert set(sent) <= batched
+
+
+def test_trace_and_pod_render(frozen, armed, tmp_path, capsys):
+    ev_path = tmp_path / "events.jsonl"
+    hm_events.open_log(str(ev_path), run_id="fs-render", rank=0)
+    rset = ReplicaSet(_factory, n=1,
+                      server_kwargs={"max_delay_ms": 1.0})
+    rset.start()
+    router = Router(rset)
+    host, port = router.start()
+    tp = mint()
+    try:
+        code, doc = _post(f"http://{host}:{port}/predict",
+                          {"data": [0.5] * 6},
+                          headers={"traceparent": tp.header()})
+        assert code == 200
+    finally:
+        router.stop()
+        rset.stop()
+        hm_events.close_log()
+    mxdiag = _load_tool("mxdiag")
+    assert mxdiag.main(["trace", tp.trace_id, str(ev_path)]) == 0
+    out = capsys.readouterr().out
+    assert tp.trace_id in out and "wire gap" in out
+    # pod over a synthetic serve_load artifact
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps({
+        "metric": "serve_load_x_qps_at_knee", "value": 1.0,
+        "extra": {"fleetscope": {
+            "client_minted": 4, "sampled": 4, "joined": 3,
+            "unjoined_forwards": 1, "join_rate": 0.75,
+            "wire_gap_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+            "per_replica": [
+                {"name": "r0", "traces": 2, "e2e_p99_ms": 5.0},
+                {"name": "r1", "traces": 1, "e2e_p99_ms": 50.0}],
+            "replica_spread": 10.0}}}))
+    assert mxdiag.main(["pod", str(bench)]) == 0
+    out = capsys.readouterr().out
+    assert "straggler" in out and "join rate 75.0%" in out
+
+
+# ---------------------------------------------------------------------------
+# tooling contract: check_fleetscope_extra + build_fleetscope_extra
+# ---------------------------------------------------------------------------
+
+def _good_fs_extra():
+    return {"client_minted": 10, "sampled": 8, "joined": 6,
+            "unjoined_forwards": 2, "join_rate": 0.75,
+            "wire_gap_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+            "per_replica": [{"name": "r0", "traces": 3,
+                             "e2e_p99_ms": 4.0, "wire_gap_p50_ms": 1.0},
+                            {"name": "r1", "traces": 3}],
+            "replica_spread": 1.25}
+
+
+def test_check_fleetscope_extra_good():
+    tc = _load_tool("trace_check")
+    assert tc.check_fleetscope_extra(_good_fs_extra()) == []
+    assert tc.check_fleetscope_extra(None) == []
+    # the optional blocks may be absent entirely (single-server mode)
+    minimal = {"client_minted": 2, "sampled": 2, "joined": 2,
+               "unjoined_forwards": 0, "join_rate": 1.0}
+    assert tc.check_fleetscope_extra(minimal) == []
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda d: d.update(joined=9), "exceeds"),
+    (lambda d: d.update(join_rate=0.5), "disagrees"),
+    (lambda d: d.update(join_rate=1.5), "join_rate"),
+    (lambda d: d.update(sampled=-1), "sampled"),
+    (lambda d: d.update(client_minted=None), "client_minted"),
+    (lambda d: d["wire_gap_ms"].update(p50=9.0), "ordered"),
+    (lambda d: d["wire_gap_ms"].update(p50=-5.0, p95=-2.0, p99=-1.5),
+     "negative"),
+    (lambda d: d["per_replica"].append({"name": "r0", "traces": 1}),
+     "duplicate"),
+    (lambda d: d["per_replica"].append({"name": "", "traces": 1}),
+     "name"),
+    (lambda d: d.update(replica_spread=0.5), "replica_spread"),
+])
+def test_check_fleetscope_extra_bad(mutate, needle):
+    tc = _load_tool("trace_check")
+    doc = _good_fs_extra()
+    mutate(doc)
+    errs = tc.check_fleetscope_extra(doc)
+    assert errs and any(needle in e for e in errs), errs
+
+
+def test_build_fleetscope_extra_assembly():
+    sl = _load_tool("serve_load")
+    rtr = [{"name": "fleetscope.request",
+            "args": {"trace_id": f"t{i}", "replica": f"r{i % 2}",
+                     "status": 200, "forward_ms": 10.0 + i,
+                     "e2e_ms": 10.5 + i}}
+           for i in range(4)]
+    rtr.append({"name": "fleetscope.request",          # failed forward:
+                "args": {"trace_id": "t9", "status": 503,   # not sampled
+                         "e2e_ms": 1.0}})
+    rep = [{"name": "serving.request",
+            "args": {"trace_id": f"t{i}", "e2e_ms": 7.0 + i}}
+           for i in range(3)]                          # t3 stays unjoined
+    fs = sl.build_fleetscope_extra(6, rtr, rep)
+    assert fs["client_minted"] == 6
+    assert fs["sampled"] == 4 and fs["joined"] == 3
+    assert fs["unjoined_forwards"] == 1
+    assert fs["join_rate"] == pytest.approx(0.75)
+    assert fs["wire_gap_ms"]["p50"] == pytest.approx(3.0)
+    names = {r["name"]: r for r in fs["per_replica"]}
+    assert names["r0"]["traces"] == 2 and names["r1"]["traces"] == 1
+    assert fs["replica_spread"] >= 1.0
+    # the section it emits is exactly what the validator enforces
+    tc = _load_tool("trace_check")
+    assert tc.check_fleetscope_extra(fs) == []
+
+
+def test_build_fleetscope_extra_empty():
+    sl = _load_tool("serve_load")
+    fs = sl.build_fleetscope_extra(0, [], [])
+    assert fs["sampled"] == 0 and fs["join_rate"] == 0.0
+    assert "wire_gap_ms" not in fs and "per_replica" not in fs
+    tc = _load_tool("trace_check")
+    assert tc.check_fleetscope_extra(fs) == []
+
+
+def test_elastic_telemetry_push_and_pod_view():
+    """The training-side transport: members PUSH bounded telemetry over
+    the membership wire (rank 0 cannot dial in), the coordinator's
+    reply clock seeds the member's offset estimate, and the offset
+    rides along on the NEXT report into pod_telemetry()."""
+    from incubator_mxnet_tpu.profiler.counters import counter
+    from incubator_mxnet_tpu.resilience import ElasticGroup
+
+    g0 = ElasticGroup(rank=0, sync_timeout_s=5.0)
+    g1 = ElasticGroup(rank=1, addr=g0.addr, sync_timeout_s=5.0)
+    try:
+        g0.join()
+        g1.join()
+        # first report: no offset yet; the reply's coordinator_ts
+        # produces one (same host, so it is ~0 with a small rtt bound)
+        r1 = g1.report_telemetry(counters={"io.records_read": 5},
+                                 events_tail=[{"name": "x"}],
+                                 health={"ok": True})
+        assert r1 is not None
+        assert isinstance(r1["offset_s"], float)
+        assert r1["offset_bound_s"] >= 0
+        assert abs(r1["offset_s"]) <= r1["offset_bound_s"] + 1.0
+        # second report CARRIES the estimate to the coordinator
+        g1.report_telemetry(counters={"io.records_read": 9})
+
+        pod = g0.pod_telemetry()
+        ring = pod["reports"][1]
+        assert len(ring) == 2
+        assert ring[0]["counters"] == {"io.records_read": 5}
+        assert ring[0]["rank"] == 1 and "received_ts" in ring[0]
+        assert ring[0]["offset_s"] is None          # pre-estimate
+        assert ring[1]["offset_s"] == pytest.approx(r1["offset_s"])
+        assert pod["offsets"][1] == pytest.approx(r1["offset_s"])
+    finally:
+        g1.leave()
+        g0.leave()
+    # the wire is gone: the push is a counted datum, never a raise
+    before = counter("fleetscope.telem_errors", "fleetscope").value
+    assert g1.report_telemetry(counters={}) is None
+    assert counter("fleetscope.telem_errors",
+                   "fleetscope").value == before + 1

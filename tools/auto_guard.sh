@@ -143,6 +143,17 @@ if timeout 1200 bash tools/embedding_smoke.sh >> "$LOG" 2>&1; then
 else
   echo "$(date -u +%F' '%T) embedding smoke FAILED (continuing; embedding subsystem suspect)" >> "$LOG"
 fi
+# fleetscope smoke (CPU-only 2-replica spawned fleet): every request
+# carries a client-minted traceparent end to end — >= 95% of traces
+# must join router-to-replica, the wire-gap + replica-span accounting
+# must reconstruct the router e2e, the collector must pull every
+# replica with a bounded clock offset, and mxdiag trace/pod must
+# render the merged story from the artifacts alone
+if timeout 1200 bash tools/fleetscope_smoke.sh >> "$LOG" 2>&1; then
+  echo "$(date -u +%F' '%T) fleetscope smoke OK" >> "$LOG"
+else
+  echo "$(date -u +%F' '%T) fleetscope smoke FAILED (continuing; cross-process tracing suspect)" >> "$LOG"
+fi
 while true; do
   ts=$(date -u +%H:%M)
   timeout 300 python -c "

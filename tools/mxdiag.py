@@ -10,11 +10,12 @@ Sampler time series (`metrics.jsonl`): first/last sample, counter deltas
 and rates over the covered window.
 
 `merge`: interleave SEVERAL ranks' flight dumps and/or structured event
-logs (`mxtpu.events/1` JSONL) into one time-ordered cross-rank timeline,
+logs (`mxtpu.events/` JSONL) into one time-ordered cross-rank timeline,
 each line tagged with its rank — the post-mortem view for distributed
 failures ("rank 1 went quiet 40 s before rank 0's collective timed
 out"). `-o merged.jsonl` additionally writes the merged timeline as
-`mxtpu.events/1` records (validated by tools/trace_check.py).
+`mxtpu.events/2` records (validated by tools/trace_check.py), carrying
+each record's `mono` companion through when present.
 
 `perf`: the MFU-decomposition report from a BENCH json
 (`extra.perfscope`) — step budget with per-component shares (the
@@ -58,6 +59,22 @@ wait, and devicescope's measured input-starvation split with the
 one-line triage ("starved 31% of idle: 80% decode → raise io_workers,
 not prefetch depth").
 
+`trace`: ONE request's cross-process span tree, joined on the
+fleetscope `trace_id` across event logs from different processes — the
+router's `fleetscope.request` record (admit → forward → respond) over
+the replica's `serving.request` span (queue_wait / coalesce_delay /
+pad_overhead / device_exec / respond), with the **wire gap** (router
+forward wall minus replica e2e — a difference of perf_counter
+durations, so clock skew cannot enter it) explicit between them, and
+the `serving.batch` record the request coalesced into.
+
+`pod`: the fleet-wide trace aggregate from a serve_load --fleet BENCH
+json (`extra.fleetscope`) — join accounting (client-minted / sampled /
+joined, unjoined forwards counted), wire-gap percentiles, the
+per-replica trace table with straggler flags (report-only context for
+the router's least-loaded score), and the collector's per-process
+clock-offset estimates ± rtt/2.
+
 `tune`: the autotune report from a BENCH json (`extra.autotune`) —
 cache hit/miss verdict, the trial table with measured busy fraction /
 step wall / MFU / score provenance per config, the pruning reasons
@@ -75,6 +92,9 @@ Usage:
     python tools/mxdiag.py serve BENCH.json
     python tools/mxdiag.py fleet BENCH.json [--events EVENTS.jsonl]
     python tools/mxdiag.py tune BENCH.json
+    python tools/mxdiag.py trace TRACE_ID events.jsonl \\
+        events_replica_*.jsonl
+    python tools/mxdiag.py pod BENCH.json
     python tools/mxdiag.py merge events_rank0.jsonl events_rank1.jsonl \\
         mxtpu_flight_123.json [-o merged.jsonl] [--tail N]
 """
@@ -1206,7 +1226,8 @@ def _load_timeline(path: str, fallback_rank: int):
                     "run_id": rec.get("run_id"),
                     "step": rec.get("step"), "kind": rec.get("kind", "?"),
                     "name": rec.get("name", "?"),
-                    "args": rec.get("args"), "src": path})
+                    "args": rec.get("args"), "src": path,
+                    "mono": rec.get("mono")})
         return rank, run_id, records
     with open(path) as f:
         doc = json.load(f)
@@ -1219,7 +1240,8 @@ def _load_timeline(path: str, fallback_rank: int):
         records.append({"ts": ev.get("ts", 0), "rank": rank, "step": None,
                         "kind": ev.get("kind", "?"),
                         "name": ev.get("name", "?"),
-                        "args": ev.get("args"), "src": path})
+                        "args": ev.get("args"), "src": path,
+                        "mono": ev.get("mono")})
     return rank, None, records
 
 
@@ -1249,10 +1271,14 @@ def merge_timelines(paths, out_path=None):
             for r in merged:
                 ts = max(float(r["ts"]), last_ts)   # keep the schema's
                 last_ts = ts                        # monotonic-ts contract
-                rec = {"schema": "mxtpu.events/1", "ts": ts,
+                rec = {"schema": "mxtpu.events/2", "ts": ts,
                        "run_id": r.get("run_id") or fallback_rid,
                        "rank": int(r["rank"]), "step": r["step"],
                        "kind": r["kind"], "name": r["name"]}
+                if isinstance(r.get("mono"), (int, float)):
+                    # mono is only meaningful WITHIN its source process;
+                    # carried through so a re-merge can still use it
+                    rec["mono"] = r["mono"]
                 if r.get("args"):
                     rec["args"] = r["args"]
                 f.write(json.dumps(rec) + "\n")
@@ -1483,6 +1509,209 @@ def _merge_main(argv) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# trace / pod: the fleetscope cross-process views
+# ---------------------------------------------------------------------------
+
+_SPAN_COMPONENTS = ("queue_wait_ms", "coalesce_delay_ms",
+                    "pad_overhead_ms", "device_exec_ms", "respond_ms")
+
+
+def print_trace(trace_id: str, records) -> int:
+    """Render ONE request's cross-process span tree from merged event
+    records: the router's ``fleetscope.request`` hop over the replica's
+    ``serving.request`` span, the wire gap between them explicit, and
+    the ``serving.batch`` dispatch the request coalesced into."""
+    def _args(r):
+        return r.get("args") or {}
+
+    routers = [r for r in records if r.get("name") == "fleetscope.request"
+               and _args(r).get("trace_id") == trace_id]
+    replicas = [r for r in records if r.get("name") == "serving.request"
+                and _args(r).get("trace_id") == trace_id]
+    batches = [r for r in records if r.get("name") == "serving.batch"
+               and trace_id in (_args(r).get("traces") or [])]
+    if not routers and not replicas:
+        print(f"trace: no records carry trace_id {trace_id!r} "
+              f"(is fleetscope armed on both sides?)", file=sys.stderr)
+        return 1
+    srcs = sorted({r.get("src", "?") for r in routers + replicas + batches})
+    print(f"== trace {trace_id} ==")
+    print(f"  {len(routers)} router + {len(replicas)} replica + "
+          f"{len(batches)} batch record(s) across {len(srcs)} file(s)")
+    rc = 0
+    for rr in routers:
+        a = _args(rr)
+        fw = a.get("forward_ms")
+        fw_s = f", forward {fw:.2f} ms" if isinstance(fw, (int, float)) \
+            else ""
+        print(f"  router span {a.get('span_id', '?')}  "
+              f"replica={a.get('replica')}  status={a.get('status')}  "
+              f"e2e {a.get('e2e_ms', 0.0):.2f} ms{fw_s}   "
+              f"[{rr.get('src', '?')}]")
+        # the replica-side child(ren) of THIS hop: parent == router span
+        children = [pr for pr in replicas
+                    if _args(pr).get("parent_id") == a.get("span_id")]
+        orphans = [pr for pr in replicas if pr not in children]
+        for pr in children:
+            p = _args(pr)
+            e2e = p.get("e2e_ms")
+            if isinstance(fw, (int, float)) and isinstance(e2e,
+                                                           (int, float)):
+                print(f"    |- wire gap {fw - e2e:.2f} ms  (router "
+                      f"forward - replica e2e: duration difference, "
+                      f"clock-skew free)")
+            comp = " | ".join(
+                f"{k[:-3]} {p[k]:.2f}" for k in _SPAN_COMPONENTS
+                if isinstance(p.get(k), (int, float)))
+            e2e_s = f"e2e {e2e:.2f} ms" if isinstance(e2e, (int, float)) \
+                else f"status={p.get('status')}"
+            print(f"    `- replica span {p.get('span_id', '?')} "
+                  f"(parent {p.get('parent_id', '?')})  "
+                  f"bucket={p.get('bucket')} batch={p.get('batch_id')}  "
+                  f"{e2e_s}   [{pr.get('src', '?')}]")
+            if comp:
+                print(f"         {comp}")
+            for br in batches:
+                b = _args(br)
+                if b.get("batch_id") == p.get("batch_id"):
+                    shared = len(b.get("traces") or []) - 1
+                    print(f"         batch {b.get('batch_id')}: "
+                          f"n={b.get('n')} bucket={b.get('bucket')} "
+                          f"exec {b.get('exec_ms')} ms"
+                          + (f", co-batched with {shared} other "
+                             f"traced request(s)" if shared > 0 else ""))
+        if not children and replicas:
+            rc = 1
+            print(f"    << BROKEN JOIN: {len(orphans)} replica record(s) "
+                  f"with this trace_id but parent != router span "
+                  f"{a.get('span_id')!r}")
+        elif not children:
+            print(f"    (no replica-side span arrived — an unjoined "
+                  f"forward: replica not sampling, or its events log "
+                  f"was not given here)")
+    for pr in (replicas if not routers else []):
+        p = _args(pr)
+        print(f"  replica span {p.get('span_id', '?')} (parent "
+              f"{p.get('parent_id', '?')})  e2e "
+              f"{p.get('e2e_ms', 0.0):.2f} ms — no router record "
+              f"(router events log not given here?)   "
+              f"[{pr.get('src', '?')}]")
+    return rc
+
+
+def _trace_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py trace",
+        description="one request's cross-process span tree, joined on "
+                    "the fleetscope trace_id across event logs")
+    ap.add_argument("trace_id", help="32-hex fleetscope trace id (from "
+                                     "a reply's trace_id field or an "
+                                     "events record)")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl files from BOTH sides "
+                         "(router's and each replica's)")
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_timelines(args.paths)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 1
+    return print_trace(args.trace_id.strip().lower(), merged)
+
+
+# straggler flag threshold: a replica whose trace p99 exceeds this
+# multiple of the fleet median gets flagged (report-only — the router's
+# least-loaded score is the control loop, this is the explanation)
+_POD_STRAGGLER_MULT = 1.5
+
+
+def print_pod(doc) -> int:
+    """Render the fleet-wide trace aggregate (``extra.fleetscope``) from
+    a serve_load --fleet BENCH json: join accounting, wire-gap
+    percentiles, per-replica table with straggler flags, and the
+    collector's clock-offset estimates."""
+    extra = doc.get("extra") or {}
+    fs = extra.get("fleetscope")
+    if not isinstance(fs, dict):
+        print("pod: no extra.fleetscope section (serve_load runs with "
+              "fleetscope armed; --fleet N adds the per-replica rows)",
+              file=sys.stderr)
+        return 1
+    print(f"== pod: cross-process trace aggregate "
+          f"({extra.get('model', doc.get('metric', '?'))}) ==")
+    rate = fs.get("join_rate")
+    print(f"  traces: {fs.get('client_minted')} client-minted, "
+          f"{fs.get('sampled')} sampled, {fs.get('joined')} joined "
+          + (f"(join rate {rate:.1%})" if isinstance(rate, (int, float))
+             else "") + f", {fs.get('unjoined_forwards')} unjoined "
+          f"forward(s) — counted, never guessed")
+    gap = fs.get("wire_gap_ms")
+    if isinstance(gap, dict):
+        print(f"  wire gap: p50 {gap.get('p50')} / p95 {gap.get('p95')} "
+              f"/ p99 {gap.get('p99')} ms  (router forward - replica "
+              f"e2e: clock-skew free)")
+    rows = fs.get("per_replica") or []
+    if rows:
+        p99s = sorted(r["e2e_p99_ms"] for r in rows
+                      if isinstance(r.get("e2e_p99_ms"), (int, float)))
+        median = p99s[(len(p99s) - 1) // 2] if p99s else None
+        print(f"  {'replica':<14} {'traces':>7} {'e2e p99 ms':>11} "
+              f"{'wire gap p50':>13}")
+        for r in rows:
+            p99 = r.get("e2e_p99_ms")
+            flag = ""
+            if isinstance(p99, (int, float)) and median \
+                    and p99 > _POD_STRAGGLER_MULT * median:
+                flag = (f"   << straggler ({p99 / median:.2f}x the "
+                        f"median p99; report-only)")
+            p99_s = f"{p99:.3f}" if isinstance(p99, (int, float)) else "-"
+            g = r.get("wire_gap_p50_ms")
+            g_s = f"{g:.3f}" if isinstance(g, (int, float)) else "-"
+            print(f"  {r.get('name', '?'):<14} {r.get('traces', 0):>7} "
+                  f"{p99_s:>11} {g_s:>13}{flag}")
+        spread = fs.get("replica_spread")
+        if isinstance(spread, (int, float)):
+            print(f"  replica spread (max/median p99): {spread:.2f}"
+                  + ("  — balanced" if spread <= _POD_STRAGGLER_MULT
+                     else "  — investigate the flagged replica"))
+    coll = fs.get("collector")
+    if isinstance(coll, dict):
+        procs = coll.get("processes") or {}
+        print(f"  collector: {len(procs)} process(es), "
+              f"interval {coll.get('interval_s')} s")
+        for name in sorted(procs):
+            p = procs[name]
+            off, bound = p.get("offset_s"), p.get("offset_bound_s")
+            if isinstance(off, (int, float)):
+                skew = (f"clock offset {off * 1e3:+.2f} ms "
+                        f"+/- {bound * 1e3:.2f} ms"
+                        if isinstance(bound, (int, float))
+                        else f"clock offset {off * 1e3:+.2f} ms")
+            else:
+                skew = "no successful pull"
+            err = f"  last_error={p.get('last_error')}" \
+                if p.get("last_error") else ""
+            print(f"    {name:<12} {p.get('pulls', 0):>3} pull(s)  "
+                  f"{skew}{err}")
+    return 0
+
+
+def _pod_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxdiag.py pod",
+        description="fleet-wide trace aggregate from a serve_load "
+                    "--fleet BENCH json (extra.fleetscope)")
+    ap.add_argument("path", help="BENCH json (serve_load.py output)")
+    args = ap.parse_args(argv)
+    try:
+        doc = _load_bench(args.path)
+    except (OSError, ValueError) as e:
+        print(f"pod: {e}", file=sys.stderr)
+        return 1
+    return print_pod(doc)
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "merge":
@@ -1501,6 +1730,10 @@ def main(argv=None) -> int:
         return _serve_main(argv[1:])
     if argv and argv[0] == "fleet":
         return _fleet_main(argv[1:])
+    if argv and argv[0] == "trace":
+        return _trace_main(argv[1:])
+    if argv and argv[0] == "pod":
+        return _pod_main(argv[1:])
     if argv and argv[0] == "tune":
         return _tune_main(argv[1:])
     if argv and argv[0] == "recover":

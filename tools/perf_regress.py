@@ -206,6 +206,15 @@ def load_artifact(path):
     rec["steps_lost"] = (int(sl_tot)
                          if isinstance(sl_tot, (int, float))
                          and not isinstance(sl_tot, bool) else None)
+    # fleetscope trace-join rate (extra.fleetscope): observability
+    # coverage, NOT performance — a drop means spans stopped joining
+    # (sampling change, a propagation break), so compare() reports it
+    # as context under the both-sides contract, never as a perf verdict
+    fsc = extra.get("fleetscope") or {}
+    jr = fsc.get("join_rate") if isinstance(fsc, dict) else None
+    rec["trace_join_rate"] = (float(jr)
+                              if isinstance(jr, (int, float))
+                              and not isinstance(jr, bool) else None)
     return rec, None
 
 
@@ -377,6 +386,25 @@ def compare(baseline, candidate, threshold=DEFAULT_THRESHOLD,
         notes.append(f"note: only the {side} carries a serve_load knee "
                      f"— knee context skipped (needs a sweep on both "
                      f"sides)")
+    # fleetscope trace-join rate: observability COVERAGE context, never
+    # a perf verdict — the QPS/p99 gates above own the perf claim, this
+    # says whether the cross-process spans behind them still join
+    bjr, cjr = baseline.get("trace_join_rate"), \
+        candidate.get("trace_join_rate")
+    if bjr is not None and cjr is not None:
+        if cjr < bjr - 0.05:
+            notes.append(f"note: fleetscope trace-join rate dropped "
+                         f"({bjr:.1%} -> {cjr:.1%}) — spans stopped "
+                         f"joining (sampling change or a propagation "
+                         f"break); coverage context, not a perf verdict")
+        else:
+            notes.append(f"ok fleetscope trace-join rate: {cjr:.1%} "
+                         f"(baseline {bjr:.1%})")
+    elif (bjr is None) != (cjr is None):
+        side = "candidate" if bjr is None else "baseline"
+        notes.append(f"note: only the {side} carries a fleetscope "
+                     f"join rate — trace-coverage context skipped "
+                     f"(needs fleetscope armed on both sides)")
     bdr, cdr = baseline.get("dedup_rate"), candidate.get("dedup_rate")
     if bdr is not None and cdr is not None and bdr > 0:
         drop = (bdr - cdr) / bdr

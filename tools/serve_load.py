@@ -24,7 +24,17 @@ trace_check-valid BENCH json:
   (``queue_wait + coalesce_delay + pad_overhead + device_exec +
   respond`` per bucket, with roofline + resharding verdicts attached —
   ``check_servescope_extra`` validates it, ``mxdiag.py serve`` renders
-  it).
+  it);
+* ``extra.fleetscope`` — cross-process trace accounting: every client
+  request carries a freshly minted W3C ``traceparent`` header, and the
+  section reports how many traces the serving side actually joined
+  (``client_minted`` / ``sampled`` / ``joined`` / ``join_rate``, with
+  ``unjoined_forwards`` counted — never guessed away). In --fleet mode
+  it adds the **wire-gap** percentiles (router-observed forward time
+  minus replica-observed total: a difference of durations, so clock
+  skew cannot enter it), per-replica trace p99s, and the
+  ``replica_spread`` straggler ratio — ``check_fleetscope_extra``
+  validates it, ``mxdiag.py trace``/``pod`` render the raw records.
 
 A server that dies mid-sweep (every request of a level failing, or a
 dead /healthz) produces a self-describing ``{"status": "env_failure"}``
@@ -46,6 +56,16 @@ name grows a ``_fleetN`` suffix so perf_regress's both-sides contract
 compares fleet runs against fleet baselines, never against the
 single-server trajectory. Replica scaling is a multi-core claim: on a
 1-core host the fleet only measures its own routing overhead.
+
+In --fleet mode each worker is spawned with ``servescope``/
+``fleetscope``/``export`` armed and its own ``mxtpu.events/2`` log
+(``<events>_replica_<pid>.jsonl``); the router's ``fleetscope.request``
+records land in the harness's events file, and after the sweep the two
+sides are joined on ``trace_id`` (one request = ONE trace: router admit
+→ wire → replica queue_wait → coalesce → device_exec → respond). A
+:class:`~incubator_mxnet_tpu.fleetscope.Collector` polls every
+replica's ``diagnostics.export`` endpoint during the sweep; its
+clock-offset snapshot rides along under ``extra.fleetscope.collector``.
 
 Usage:
     python tools/serve_load.py [--model lenet] [--ramp 4,8,16,32,64]
@@ -69,6 +89,7 @@ import time
 
 __all__ = ["find_knee", "run_level", "sweep", "build_result",
            "merge_serving_stats", "write_env_failure", "ServerDied",
+           "read_event_records", "build_fleetscope_extra",
            "main", "DEFAULT_RAMP", "KNEE_QPS_GAIN", "KNEE_P99_MULT"]
 
 DEFAULT_RAMP = "4,8,16,32,64"
@@ -334,6 +355,107 @@ def build_result(model_name: str, levels, knee_idx: int, reason: str,
     }
 
 
+def read_event_records(path, name=None) -> list:
+    """Every parsed record of an ``mxtpu.events`` JSONL file, optionally
+    filtered by record ``name``. Unlike the collector's bounded live
+    tail this reads the WHOLE file: the harness owns these files and
+    they are sweep-sized. IO errors yield ``[]`` — post-run accounting,
+    not truth."""
+    out = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and (name is None
+                                              or rec.get("name") == name):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def build_fleetscope_extra(client_minted: int, router_records,
+                           replica_records) -> dict:
+    """Assemble the ``extra.fleetscope`` BENCH section from router-side
+    ``fleetscope.request`` records and replica-side ``serving.request``
+    records (the shape ``check_fleetscope_extra`` enforces).
+
+    * ``sampled`` — router-observed SUCCESSFUL forwards (status 200):
+      the join denominator;
+    * ``joined`` — sampled traces whose replica-side span arrived;
+      ``unjoined_forwards`` is the remainder, counted — never guessed;
+    * ``wire_gap_ms`` — per joined trace, router ``forward_ms`` minus
+      replica ``e2e_ms``. Both are perf_counter DURATIONS, so the
+      difference is clock-skew free (docs/fleetscope.md);
+    * ``per_replica`` / ``replica_spread`` — replica-observed trace p99
+      per replica and max/median across them (the straggler signal the
+      pod view renders)."""
+    from incubator_mxnet_tpu.fleetscope import join_traces
+    traces = join_traces(router_records, replica_records)
+    sampled = joined = 0
+    gaps, by_rep = [], {}
+    for slot in traces.values():
+        rtr = slot["router"]
+        if rtr is None:
+            continue
+        rargs = rtr.get("args") or {}
+        if rargs.get("status") != 200:
+            continue
+        sampled += 1
+        rep = slot["replica"]
+        if rep is None:
+            continue
+        joined += 1
+        agg = by_rep.setdefault(slot["replica_name"] or "?",
+                                {"n": 0, "e2e": [], "gaps": []})
+        agg["n"] += 1
+        pargs = rep.get("args") or {}
+        e2e, fw = pargs.get("e2e_ms"), rargs.get("forward_ms")
+        if isinstance(e2e, (int, float)):
+            agg["e2e"].append(float(e2e))
+            if isinstance(fw, (int, float)):
+                gap = float(fw) - float(e2e)
+                gaps.append(gap)
+                agg["gaps"].append(gap)
+    out = {
+        "client_minted": int(client_minted),
+        "sampled": sampled,
+        "joined": joined,
+        "unjoined_forwards": sampled - joined,
+        "join_rate": round(joined / sampled, 6) if sampled else 0.0,
+    }
+    if gaps:
+        gaps.sort()
+        out["wire_gap_ms"] = {k: round(_percentile(gaps, q), 3)
+                              for k, q in (("p50", 0.50), ("p95", 0.95),
+                                           ("p99", 0.99))}
+    rows, p99s = [], []
+    for name in sorted(by_rep):
+        agg = by_rep[name]
+        row = {"name": name, "traces": agg["n"]}
+        if agg["e2e"]:
+            row["e2e_p99_ms"] = round(
+                _percentile(sorted(agg["e2e"]), 0.99), 3)
+            p99s.append(row["e2e_p99_ms"])
+        if agg["gaps"]:
+            row["wire_gap_p50_ms"] = round(
+                _percentile(sorted(agg["gaps"]), 0.50), 3)
+        rows.append(row)
+    if rows:
+        out["per_replica"] = rows
+    if p99s:
+        p99s.sort()
+        # lower median: with 2 replicas the upper median IS the max and
+        # the straggler ratio would pin at 1.0
+        median = p99s[(len(p99s) - 1) // 2]
+        if median > 0:
+            out["replica_spread"] = round(p99s[-1] / median, 4)
+    return out
+
+
 def write_env_failure(path: str, metric: str, error: str) -> dict:
     """The self-describing environment-failure artifact (bench.py's
     preflight convention): perf_regress skips it, the trajectory stays
@@ -400,8 +522,8 @@ def main(argv=None) -> int:
     if _root not in sys.path:
         sys.path.insert(0, _root)
     import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import (commscope, devicescope, perfscope,
-                                     servescope, serving)
+    from incubator_mxnet_tpu import (commscope, devicescope, fleetscope,
+                                     perfscope, servescope, serving)
     from incubator_mxnet_tpu.healthmon import events as hm_events
     from incubator_mxnet_tpu.models import get_model
 
@@ -418,6 +540,9 @@ def main(argv=None) -> int:
     perfscope.enable()
     commscope.enable()
     servescope.enable(sample=args.sample)
+    # fleetscope: every client request carries a minted traceparent, and
+    # the router/server side joins it (extra.fleetscope reports the rate)
+    fleetscope.enable()
     run_id = f"serveload-{os.getpid()}-{int(time.time())}"
     hm_events.open_log(events_path, run_id=run_id, rank=0)
 
@@ -430,7 +555,9 @@ def main(argv=None) -> int:
         net.initialize(init=mx.init.Xavier())
         return net.freeze(input_shape=shape, compile_cache=compile_cache)
 
-    rset = router = srv = None
+    rset = router = srv = coll = None
+    replica_events_tmpl = (os.path.splitext(events_path)[0]
+                           + "_replica_{pid}.jsonl")
     buckets_list = []
     if fleet_n:
         from incubator_mxnet_tpu import fleet as fleet_mod
@@ -438,13 +565,21 @@ def main(argv=None) -> int:
             (os.path.splitext(args.out)[0] + "_cache")
         # spawned workers: each replica is its own PROCESS (own GIL —
         # in-process replicas cannot out-scale one bare server), warmed
-        # through the shared on-disk AOT cache
+        # through the shared on-disk AOT cache. servescope/fleetscope in
+        # the spec arm replica-side spans + trace joining; export gives
+        # the fleetscope collector its pull target; {pid} keeps the
+        # per-replica events logs apart (worker substitutes its PID)
         spec = {"model": args.model,
                 "classes": 10 if args.model == "lenet" else 1000,
                 "model_kwargs": kwargs,
                 "input_shape": list(shape),
                 "batcher": "continuous",
                 "cache_dir": cache_dir,
+                "servescope": True,
+                "fleetscope": True,
+                "export": True,
+                "events": {"path": replica_events_tmpl,
+                           "run_id": run_id, "rank": 0},
                 "server": {"max_delay_ms": args.max_delay_ms,
                            "queue_limit": max(256, ramp[-1] * 4),
                            "default_timeout_ms": args.timeout_ms}}
@@ -454,6 +589,13 @@ def main(argv=None) -> int:
         rset.start()
         router = fleet_mod.Router(rset)
         host, port = router.start()
+        targets = [{"name": rep.name, "host": rep.host,
+                    "port": rep.diag_port}
+                   for rep in rset.replicas if rep.diag_port]
+        if targets:
+            # clock-offset estimation + live counters over each worker's
+            # diagnostics.export endpoint, for the whole sweep
+            coll = fleetscope.Collector(targets, interval_s=1.0).start()
         try:
             _, r0 = rset.replicas[0].http_get("/stats")
             buckets_list = list(r0.get("buckets") or [])
@@ -495,6 +637,10 @@ def main(argv=None) -> int:
     # registry, so server-side counters are already fleet-aggregated)
     fleet_lock = threading.Lock()
     fleet_lats = {}
+    # client-side trace accounting: every request mints a fresh
+    # traceparent; "echo" counts replies whose trace_id matches (the
+    # single-server join — fleet mode joins the events files instead)
+    fs_counts = {"minted": 0, "ok": 0, "echo": 0}
 
     def send(i):
         conn = getattr(tls, "conn", None)
@@ -505,10 +651,17 @@ def main(argv=None) -> int:
             import socket as _socket
             conn.sock.setsockopt(_socket.IPPROTO_TCP,
                                  _socket.TCP_NODELAY, 1)
+        headers = {"Content-Type": "application/json"}
+        tp = None
+        if fleetscope.enabled():
+            tp = fleetscope.mint()
+            headers["traceparent"] = tp.header()
+            with fleet_lock:
+                fs_counts["minted"] += 1
         t0 = time.perf_counter()
         try:
             conn.request("POST", "/predict", body=bodies[i % len(bodies)],
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             r = conn.getresponse()
             data = r.read()
             if r.status != 200:
@@ -519,12 +672,21 @@ def main(argv=None) -> int:
             finally:
                 tls.conn = None
             raise
+        doc = None
+        if fleet_n or tp is not None:
+            try:
+                doc = json.loads(data)
+            except ValueError:
+                doc = None
+        if tp is not None:
+            with fleet_lock:
+                fs_counts["ok"] += 1
+                if isinstance(doc, dict) \
+                        and doc.get("trace_id") == tp.trace_id:
+                    fs_counts["echo"] += 1
         if fleet_n:
             dt_ms = (time.perf_counter() - t0) * 1e3
-            try:
-                rep = json.loads(data).get("replica")
-            except ValueError:
-                rep = None
+            rep = doc.get("replica") if isinstance(doc, dict) else None
             if rep:
                 with fleet_lock:
                     fleet_lats.setdefault(rep, []).append(dt_ms)
@@ -547,6 +709,8 @@ def main(argv=None) -> int:
               f"{e}", file=sys.stderr)
         write_env_failure(args.out, metric, str(e))
         hm_events.close_log()
+        if coll is not None:
+            coll.stop()
         if router is not None:
             router.stop()
         if rset is not None:
@@ -615,12 +779,47 @@ def main(argv=None) -> int:
     # the parent has no servescope data to attribute in fleet mode
     servescope_extra = None if fleet_n else servescope.bench_extra()
     ds_extra = devicescope.bench_extra() if win is not None else None
+    # child PIDs locate the per-replica events files; grab them before
+    # the processes are reaped
+    replica_pids = []
     if fleet_n:
+        replica_pids = [(rep.name, rep.proc.pid)
+                        for rep in rset.replicas if rep.proc is not None]
+        if coll is not None:
+            coll.stop()
         router.stop()
         rset.stop(drain=True)
     else:
         srv.stop()
     hm_events.close_log()
+
+    # join the traces: fleet mode joins the router's fleetscope.request
+    # records (harness events file) against each worker's
+    # serving.request records; single-server mode uses the reply echo
+    # (the server runs in-process — there is no wire gap to measure)
+    fs_extra = None
+    if fleetscope.enabled():
+        if fleet_n:
+            replica_recs = []
+            for _name, pid in replica_pids:
+                replica_recs += read_event_records(
+                    replica_events_tmpl.replace("{pid}", str(pid)),
+                    "serving.request")
+            fs_extra = build_fleetscope_extra(
+                fs_counts["minted"],
+                read_event_records(events_path, "fleetscope.request"),
+                replica_recs)
+            if coll is not None:
+                fs_extra["collector"] = coll.snapshot()
+        else:
+            ok, echo = fs_counts["ok"], fs_counts["echo"]
+            fs_extra = {
+                "client_minted": fs_counts["minted"],
+                "sampled": ok,
+                "joined": echo,
+                "unjoined_forwards": ok - echo,
+                "join_rate": round(echo / ok, 6) if ok else 0.0,
+            }
 
     meta = {"run_id": run_id, "events_file": events_path,
             "buckets": buckets_list,
@@ -628,6 +827,8 @@ def main(argv=None) -> int:
             "level_requests": args.level_requests}
     if fleet_meta is not None:
         meta["fleet"] = fleet_meta
+    if fs_extra is not None:
+        meta["fleetscope"] = fs_extra
     doc = build_result(bench_name, levels, knee_idx, reason, stats,
                        servescope_extra=servescope_extra,
                        devicescope_extra=ds_extra,
@@ -642,13 +843,26 @@ def main(argv=None) -> int:
     att = (servescope_extra or {}).get("advice")
     if att:
         print(f"serve_load: attribution: {att}")
+    if fs_extra is not None:
+        gap = (fs_extra.get("wire_gap_ms") or {}).get("p50")
+        print(f"serve_load: fleetscope: {fs_extra['joined']}/"
+              f"{fs_extra['sampled']} traces joined (join_rate "
+              f"{fs_extra['join_rate']:.3f}, "
+              f"{fs_extra['client_minted']} client-minted"
+              + (f", wire gap p50 {gap:.2f} ms" if gap is not None
+                 else "") + ")")
     print(f"serve_load: wrote {args.out} (events: {events_path})")
 
     # self-check: the artifact must validate before anything gates on it
+    # (fleet mode: every replica's events file too)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import trace_check
     errors = trace_check.check_file(args.out) \
         + trace_check.check_file(events_path)
+    for _name, pid in replica_pids:
+        p = replica_events_tmpl.replace("{pid}", str(pid))
+        if os.path.exists(p):
+            errors += trace_check.check_file(p)
     if errors:
         for e in errors:
             print(f"serve_load: ARTIFACT INVALID: {e}", file=sys.stderr)

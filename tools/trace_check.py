@@ -54,7 +54,8 @@ __all__ = ["check_trace", "check_events", "check_flight", "check_prom",
            "check_servescope_extra", "check_serve_load_extra",
            "check_sharding_extra", "check_resilience_extra",
            "check_autotune_extra", "check_mxlint_extra", "check_io_extra",
-           "check_embedding_extra", "check_file"]
+           "check_embedding_extra", "check_fleetscope_extra",
+           "check_file"]
 
 FLIGHT_SCHEMA_PREFIX = "mxtpu.flight/"
 EVENTS_SCHEMA_PREFIX = "mxtpu.events/"
@@ -103,6 +104,9 @@ FLEET_FAMILIES = _families.family_table("fleet")
 # embedding.* — sharded tables, dedup lookup, row-sparse updates
 # (docs/embedding.md)
 EMBEDDING_FAMILIES = _families.family_table("embedding")
+# fleetscope.* — cross-process trace context + clock-aligned collection
+# (docs/fleetscope.md)
+FLEETSCOPE_FAMILIES = _families.family_table("fleetscope")
 
 # sharding modes a BENCH extra.sharding may declare (parallel/sharding.py)
 SHARDING_MODES = ("dp", "fsdp", "auto")
@@ -345,6 +349,8 @@ def check_healthmon_kinds(kinds: dict) -> list:
               ("autotune/", AUTOTUNE_FAMILIES, "AUTOTUNE_FAMILIES"),
               ("mxlint/", MXLINT_FAMILIES, "MXLINT_FAMILIES"),
               ("fleet/", FLEET_FAMILIES, "FLEET_FAMILIES"),
+              ("fleetscope/", FLEETSCOPE_FAMILIES,
+               "FLEETSCOPE_FAMILIES"),
               ("sharding/", SHARDING_FAMILIES, "SHARDING_FAMILIES"))
     for k, kind in sorted(kinds.items()):
         for prefix, table, tname in tables:
@@ -369,7 +375,9 @@ def check_events_jsonl(path: str) -> list:
     """Validate a healthmon structured event log (or a `mxdiag merge`
     output): every record a JSON object with the versioned schema tag,
     the run_id/rank/step correlation ids, non-empty kind/name, and
-    non-decreasing timestamps."""
+    non-decreasing timestamps. Schema /2 added a ``mono`` companion
+    stamp (NTP-step-safe merges); it stays OPTIONAL here so /1 records
+    (wall-only) keep validating — when present it must be numeric."""
     try:
         with open(path) as f:
             raw_lines = [ln for ln in f.read().splitlines() if ln.strip()]
@@ -401,6 +409,12 @@ def check_events_jsonl(path: str) -> list:
                 errors.append(f"line {i}: ts went backwards "
                               f"({rec['ts']} < {last_ts})")
             last_ts = rec["ts"]
+        if "mono" in rec and not _is_num(rec["mono"]):
+            # monotone ordering is per-process, so a merged multi-process
+            # file can't demand non-decreasing mono — numeric is the
+            # contract here
+            errors.append(f"line {i}: 'mono' must be numeric when "
+                          f"present, got {rec['mono']!r}")
         if not isinstance(rec.get("run_id"), str) or not rec["run_id"]:
             errors.append(f"line {i}: missing/empty 'run_id'")
         rank = rec.get("rank")
@@ -1575,6 +1589,94 @@ def check_fleet_extra(fl) -> list:
     return errors
 
 
+def check_fleetscope_extra(fs) -> list:
+    """Validate an `extra.fleetscope` BENCH section (tools/serve_load.py
+    runs with cross-process tracing armed): trace accounting that adds
+    up (joined never exceeds the sampled denominator, a join rate in
+    [0, 1] that agrees with the counts, unjoined forwards counted — not
+    guessed away), ordered wire-gap percentiles (durations, so clock
+    skew cannot make them meaningfully negative), and per-replica rows
+    with unique names."""
+    if fs is None:
+        return []
+    if not isinstance(fs, dict):
+        return [f"must be an object, got {type(fs).__name__}"]
+    errors = []
+    counts = {}
+    for key in ("client_minted", "sampled", "joined",
+                "unjoined_forwards"):
+        v = fs.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{key} must be an int >= 0, got {v!r}")
+        else:
+            counts[key] = v
+    if "sampled" in counts and "joined" in counts \
+            and counts["joined"] > counts["sampled"]:
+        errors.append(f"joined={counts['joined']} exceeds "
+                      f"sampled={counts['sampled']}")
+    rate = fs.get("join_rate")
+    if not _is_num(rate) or not (0.0 <= rate <= 1.0):
+        errors.append(f"join_rate must be in [0, 1], got {rate!r}")
+    elif "sampled" in counts and "joined" in counts and counts["sampled"]:
+        want = counts["joined"] / counts["sampled"]
+        if abs(rate - want) > 1e-6:
+            errors.append(f"join_rate={rate} disagrees with "
+                          f"joined/sampled={want:.6f}")
+    gap = fs.get("wire_gap_ms")
+    if gap is not None:
+        if not isinstance(gap, dict):
+            errors.append("wire_gap_ms must be an object of percentiles")
+        else:
+            pcts = [gap.get(k) for k in ("p50", "p95", "p99")]
+            if not all(_is_num(p) for p in pcts):
+                errors.append(f"wire_gap_ms needs numeric p50/p95/p99, "
+                              f"got {pcts!r}")
+            elif not (pcts[0] <= pcts[1] <= pcts[2]):
+                errors.append(f"wire_gap_ms percentiles must be ordered, "
+                              f"got {pcts!r}")
+            elif pcts[0] < -1.0:
+                # the gap is a DIFFERENCE OF DURATIONS (router-observed
+                # forward minus replica-observed total), so no clock
+                # offset enters it; anything past scheduling noise
+                # negative means the join mixed up its sides
+                errors.append(f"wire_gap_ms.p50={pcts[0]} < -1 ms — a "
+                              f"duration difference cannot be this "
+                              f"negative")
+    rows = fs.get("per_replica")
+    if rows is not None:
+        if not isinstance(rows, list):
+            return errors + ["per_replica must be a list"]
+        names = set()
+        for i, row in enumerate(rows):
+            where = f"per_replica[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where}: not an object")
+                continue
+            name = row.get("name")
+            if not isinstance(name, str) or not name:
+                errors.append(f"{where}: needs a non-empty 'name'")
+            elif name in names:
+                errors.append(f"{where}: duplicate replica name {name!r}")
+            else:
+                names.add(name)
+            t = row.get("traces")
+            if not isinstance(t, int) or isinstance(t, bool) or t < 0:
+                errors.append(f"{where}: traces must be an int >= 0, "
+                              f"got {t!r}")
+            for key in ("e2e_p99_ms", "wire_gap_p50_ms"):
+                v = row.get(key)
+                if v is not None and not _is_num(v):
+                    errors.append(f"{where}: {key} must be numeric or "
+                                  f"absent, got {v!r}")
+    spread = fs.get("replica_spread")
+    if spread is not None and (not _is_num(spread) or spread < 1.0):
+        # max/median of per-replica p99 — >= 1 by construction once
+        # any replica has traces
+        errors.append(f"replica_spread must be >= 1 when present, "
+                      f"got {spread!r}")
+    return errors
+
+
 def check_sharding_extra(sh) -> list:
     """Validate an `extra.sharding` BENCH section (bench.py BENCH_MESH
     runs): a positive mesh shape, a mode from the closed taxonomy, and
@@ -1782,6 +1884,9 @@ def check_bench_json(path: str) -> list:
     errors += [f"extra.embedding: {e}"
                for e in check_embedding_extra(
                    (doc.get("extra") or {}).get("embedding"))]
+    errors += [f"extra.fleetscope: {e}"
+               for e in check_fleetscope_extra(
+                   (doc.get("extra") or {}).get("fleetscope"))]
     serving = (doc.get("extra") or {}).get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
